@@ -1,0 +1,325 @@
+"""Public API: init / remote / get / put / wait / actors / cluster info.
+
+Mirrors the reference's `python/ray/_private/worker.py` public surface
+(`ray.init:1115`, `get:2391`, `put:2538`, `wait:2600`, `get_actor:2722`,
+`remote:2929`, `shutdown:1659`).
+"""
+
+from __future__ import annotations
+
+import atexit
+import functools
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu.core.actor import ActorClass, ActorHandle
+from ray_tpu.core.ids import ActorID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.task_spec import SchedulingStrategy
+
+logger = logging.getLogger(__name__)
+
+_worker = None
+_node = None
+_init_lock = threading.RLock()
+
+
+def _global_worker():
+    if _worker is not None:
+        return _worker
+    # Inside a worker process the CoreWorker was created by worker_main.
+    from ray_tpu.core.worker import current_worker
+
+    w = current_worker()
+    if w is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return w
+
+
+def is_initialized() -> bool:
+    if _worker is not None:
+        return True
+    from ray_tpu.core.worker import current_worker
+
+    return current_worker() is not None
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    object_store_memory: Optional[int] = None,
+    ignore_reinit_error: bool = False,
+    log_level: str = "WARNING",
+) -> dict:
+    """Start (or connect to) a cluster and connect this process as a driver.
+
+    With no address, boots a head node in-process: GCS + raylet threads,
+    worker subprocesses on demand (cf. reference `ray.init` local-cluster
+    start, SURVEY §3.1). With `address="host:port"` (a GCS address),
+    connects to an existing cluster as a driver only.
+    """
+    global _worker, _node
+    with _init_lock:
+        if _worker is not None:
+            if ignore_reinit_error:
+                return {"gcs_address": _worker.gcs_address}
+            raise RuntimeError("ray_tpu.init() called twice; use ignore_reinit_error=True")
+
+        logging.basicConfig(level=log_level)
+        from ray_tpu.core.worker import CoreWorker, set_current_worker
+
+        if address is None:
+            from ray_tpu.core.node import HeadNode
+
+            _node = HeadNode(
+                num_cpus=num_cpus,
+                resources=resources,
+                labels=labels,
+                object_store_memory=object_store_memory,
+            )
+            _node.start()
+            gcs_address = _node.gcs_address
+            raylet_address = _node.raylet_address
+        else:
+            gcs_address = address
+            # find a raylet to attach to: ask GCS for nodes
+            from ray_tpu.core import rpc as _rpc
+
+            c = _rpc.connect_with_retry(gcs_address)
+            nodes_ = c.call("get_all_nodes")
+            c.close()
+            alive = [n for n in nodes_ if n["alive"]]
+            if not alive:
+                raise ConnectionError("no alive nodes in cluster")
+            raylet_address = alive[0]["address"]
+
+        _worker = CoreWorker(
+            mode="driver", raylet_address=raylet_address, gcs_address=gcs_address)
+        set_current_worker(_worker)
+        atexit.register(shutdown)
+        return {"gcs_address": gcs_address, "raylet_address": raylet_address}
+
+
+def shutdown() -> None:
+    global _worker, _node
+    with _init_lock:
+        if _worker is not None:
+            try:
+                _worker.shutdown()
+            except Exception:
+                pass
+            from ray_tpu.core.worker import set_current_worker
+
+            set_current_worker(None)
+            _worker = None
+        if _node is not None:
+            try:
+                _node.stop()
+            except Exception:
+                pass
+            _node = None
+        try:
+            atexit.unregister(shutdown)
+        except Exception:
+            pass
+
+
+# ------------------------------------------------------------------ remote
+
+
+class RemoteFunction:
+    """Wrapper produced by `@remote` on a function
+    (cf. reference `python/ray/remote_function.py:34`)."""
+
+    def __init__(self, fn, options: Optional[dict] = None):
+        self._fn = fn
+        self._opts = dict(options or {})
+        functools.update_wrapper(self, fn)
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = dict(self._opts)
+        merged.update(opts)
+        return RemoteFunction(self._fn, merged)
+
+    def remote(self, *args, **kwargs):
+        w = _global_worker()
+        o = self._opts
+        resources = dict(o.get("resources") or {})
+        if o.get("num_cpus") is not None:
+            resources["CPU"] = float(o["num_cpus"])
+        if o.get("num_tpus") is not None:
+            resources["TPU"] = float(o["num_tpus"])
+        if o.get("num_gpus") is not None:
+            resources["GPU"] = float(o["num_gpus"])
+        scheduling = o.get("scheduling_strategy")
+        if scheduling is None:
+            scheduling = SchedulingStrategy(name=o.get("scheduling", "DEFAULT"))
+            pg = o.get("placement_group")
+            if pg is not None:
+                scheduling.placement_group_id = pg.id
+                scheduling.bundle_index = o.get("placement_group_bundle_index", -1)
+        num_returns = o.get("num_returns", 1)
+        refs = w.submit_task(
+            self._fn, args, kwargs,
+            num_returns=num_returns,
+            resources=resources,
+            scheduling=scheduling,
+            max_retries=o.get("max_retries", 0),
+            retry_exceptions=o.get("retry_exceptions", False),
+            runtime_env=o.get("runtime_env"),
+        )
+        return refs[0] if num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote functions cannot be called directly; use "
+            f"`{self._fn.__name__}.remote(...)`.")
+
+
+def remote(*args, **kwargs):
+    """`@remote` decorator for functions and classes, with or without options."""
+    if len(args) == 1 and not kwargs and (callable(args[0]) or isinstance(args[0], type)):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target)
+        return RemoteFunction(target)
+
+    def decorator(target):
+        if isinstance(target, type):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    return decorator
+
+
+def method(**opts):
+    """Per-method options decorator (parity shim; options resolved call-side)."""
+
+    def decorator(fn):
+        fn._ray_tpu_method_opts = opts
+        return fn
+
+    return decorator
+
+
+# ------------------------------------------------------------------ objects
+
+
+def put(value: Any) -> ObjectRef:
+    return _global_worker().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):
+    w = _global_worker()
+    if isinstance(refs, ObjectRef):
+        return w.get([refs], timeout=timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"get() expects an ObjectRef or list, got {type(refs)}")
+    return w.get(list(refs), timeout=timeout)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    return _global_worker().wait(list(refs), num_returns, timeout, fetch_local)
+
+
+# ------------------------------------------------------------------ actors
+
+
+def get_actor(name: str, namespace: str = "") -> ActorHandle:
+    info = _global_worker().get_actor_info(name=name, namespace=namespace)
+    if info is None or info["state"] == "DEAD":
+        raise ValueError(f"no live actor named '{name}'")
+    return ActorHandle(info["actor_id"], info.get("class_name", ""))
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    _global_worker().kill_actor(actor.actor_id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    # Round-1: cooperative cancellation is not yet wired; parity stub.
+    logger.warning("cancel() is not yet supported; task will run to completion")
+
+
+# ------------------------------------------------------------------ cluster
+
+
+def nodes() -> List[dict]:
+    return _global_worker().gcs.call("get_all_nodes")
+
+
+def cluster_resources() -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for n in nodes():
+        if n["alive"]:
+            for r, q in n["resources_total"].items():
+                total[r] = total.get(r, 0.0) + q
+    return total
+
+
+def available_resources() -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for n in nodes():
+        if n["alive"]:
+            for r, q in n["resources_available"].items():
+                total[r] = total.get(r, 0.0) + q
+    return total
+
+
+class RuntimeContext:
+    def __init__(self, worker):
+        self._w = worker
+
+    @property
+    def job_id(self):
+        return self._w.job_id
+
+    @property
+    def node_id(self):
+        return self._w.node_id
+
+    @property
+    def worker_id(self):
+        return self._w.worker_id
+
+    @property
+    def actor_id(self):
+        return self._w.actor_id
+
+    @property
+    def gcs_address(self):
+        return self._w.gcs_address
+
+    def get(self):
+        return {
+            "job_id": self.job_id,
+            "node_id": self.node_id,
+            "worker_id": self.worker_id,
+            "actor_id": self.actor_id,
+        }
+
+
+def get_runtime_context() -> RuntimeContext:
+    from ray_tpu.core.worker import current_worker
+
+    w = current_worker() or _global_worker()
+    return RuntimeContext(w)
+
+
+def timeline() -> List[dict]:
+    """Chrome-trace events collected so far (see ray_tpu.util.tracing)."""
+    from ray_tpu.util.tracing import get_events
+
+    return get_events()

@@ -1,0 +1,86 @@
+"""In-process multi-node test cluster.
+
+Equivalent of the reference's `ray.cluster_utils.Cluster`
+(`python/ray/cluster_utils.py:99`, `add_node:165`, `remove_node:238`): run
+multiple raylets on one machine so multi-node semantics — spillback, node
+death, cross-node object transfer, placement groups — are testable without
+real hosts. `remove_node` simulates node failure by hard-stopping the raylet
+(its workers are killed), exercising the GCS health-check + actor-restart
+paths.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ray_tpu.core.gcs import GcsServer
+from ray_tpu.core.raylet import Raylet
+
+
+class Cluster:
+    def __init__(self):
+        self.gcs = GcsServer()
+        self.gcs.start()
+        self._raylets: list[Raylet] = []
+        self.head: Optional[Raylet] = None
+
+    @property
+    def gcs_address(self) -> str:
+        return self.gcs.address
+
+    def add_node(
+        self,
+        num_cpus: float = 1,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        object_store_memory: Optional[int] = None,
+    ) -> Raylet:
+        r = dict(resources or {})
+        r.setdefault("CPU", float(num_cpus))
+        raylet = Raylet(
+            gcs_address=self.gcs.address,
+            resources=r,
+            labels=labels,
+            object_store_memory=object_store_memory,
+        )
+        raylet.start()
+        self._raylets.append(raylet)
+        if self.head is None:
+            self.head = raylet
+        return raylet
+
+    def connect(self, **init_kwargs):
+        """Connect the current process as a driver to this cluster."""
+        import ray_tpu
+
+        return ray_tpu.init(address=self.gcs.address, **init_kwargs)
+
+    def remove_node(self, raylet: Raylet) -> None:
+        """Simulate node failure: kill raylet + its workers abruptly."""
+        self._raylets.remove(raylet)
+        if self.head is raylet:
+            self.head = self._raylets[0] if self._raylets else None
+        raylet.stop()
+        # Tell GCS immediately instead of waiting for the health timeout so
+        # tests are fast; the timeout path is tested separately.
+        import ray_tpu.core.rpc as rpc
+
+        try:
+            c = rpc.connect_with_retry(self.gcs.address, timeout=5)
+            c.call("drain_node", {"node_id": raylet.node_id.binary()})
+            c.close()
+        except Exception:
+            pass
+
+    def shutdown(self) -> None:
+        import ray_tpu
+
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        for r in self._raylets:
+            try:
+                r.stop()
+            except Exception:
+                pass
+        self._raylets.clear()
+        self.gcs.stop()

@@ -1,0 +1,92 @@
+"""Env-overridable configuration registry.
+
+Equivalent of the reference's `RAY_CONFIG(type, name, default)` macro table
+(`src/ray/common/ray_config_def.h:1-814`, 199 knobs): every knob defined here
+can be overridden on any process via the `RAY_TPU_<NAME>` environment
+variable, so daemons spawned as subprocesses inherit overrides naturally.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, fields
+from typing import Any
+
+
+def _coerce(value: str, typ: type) -> Any:
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    return value
+
+
+@dataclass
+class Config:
+    # --- object store (cf. ray_config_def.h:213 max_direct_call_object_size) ---
+    max_direct_call_object_size: int = 100 * 1024  # inline objects <= 100 KiB
+    task_rpc_inlined_bytes_limit: int = 10 * 1024 * 1024
+    object_store_memory: int = 2 * 1024 * 1024 * 1024  # per-node shm budget
+    object_spilling_threshold: float = 0.8
+    min_spilling_size: int = 100 * 1024 * 1024
+    object_transfer_chunk_bytes: int = 8 * 1024 * 1024
+
+    # --- health / heartbeats (cf. gcs_health_check_manager.h) ---
+    health_check_period_ms: int = 1000
+    health_check_timeout_ms: int = 10000
+    num_heartbeats_timeout: int = 5
+
+    # --- scheduling (cf. hybrid_scheduling_policy.cc, ray_config_def.h:193) ---
+    scheduler_spread_threshold: float = 0.5
+    worker_lease_timeout_ms: int = 30000
+    max_pending_lease_requests_per_scheduling_category: int = 10
+
+    # --- worker pool (cf. worker_pool.h:156) ---
+    num_prestart_workers: int = 0
+    worker_register_timeout_s: int = 60
+    idle_worker_killing_time_s: int = 300
+    maximum_startup_concurrency: int = 8
+
+    # --- resource reporting / syncer ---
+    resource_broadcast_period_ms: int = 100
+
+    # --- core worker ---
+    task_retry_delay_ms: int = 100
+    max_task_retries_default: int = 0
+    actor_max_restarts_default: int = 0
+    get_check_interval_s: float = 0.05
+
+    # --- rpc ---
+    rpc_connect_timeout_s: float = 30.0
+    rpc_call_timeout_s: float = 0.0  # 0 = no timeout
+
+    # --- logging / session ---
+    session_dir_root: str = "/tmp/ray_tpu"
+    log_to_driver: bool = True
+
+    # --- tpu topology ---
+    tpu_chips_per_host: int = 4  # v5e default host shape
+    tpu_slice_resource_name: str = "TPU"
+
+    def __post_init__(self):
+        for f in fields(self):
+            env = os.environ.get(f"RAY_TPU_{f.name.upper()}")
+            if env is not None:
+                setattr(self, f.name, _coerce(env, type(getattr(self, f.name))))
+
+
+_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _config
+    if _config is None:
+        _config = Config()
+    return _config
+
+
+def reset_config() -> None:
+    global _config
+    _config = None

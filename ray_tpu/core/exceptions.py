@@ -1,0 +1,71 @@
+"""Exception types surfaced by the runtime.
+
+Mirrors the reference's `python/ray/exceptions.py` surface (RayError,
+RayTaskError, RayActorError, WorkerCrashedError, ObjectLostError,
+GetTimeoutError) without its dependency on serialized C++ status codes.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception during execution.
+
+    Wraps the remote traceback so `get()` on the result re-raises with the
+    remote stack attached (cf. reference RayTaskError.as_instanceof_cause).
+    """
+
+    def __init__(self, function_name: str, remote_traceback: str, cause: Exception | None = None):
+        self.function_name = function_name
+        self.remote_traceback = remote_traceback
+        self.cause = cause
+        super().__init__(f"task {function_name} failed:\n{remote_traceback}")
+
+    def __reduce__(self):
+        return (type(self), (self.function_name, self.remote_traceback, self.cause))
+
+    @classmethod
+    def from_exception(cls, function_name: str, exc: Exception) -> "TaskError":
+        tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+        try:
+            import cloudpickle
+
+            cloudpickle.dumps(exc)
+            cause = exc
+        except Exception:
+            cause = None  # unpicklable cause: carry only the traceback text
+        return cls(function_name, tb, cause)
+
+
+class ActorError(TaskError):
+    """An actor method raised an exception."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor is dead (crashed, killed, or out of restarts)."""
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ObjectLostError(RayTpuError):
+    """An object was lost (e.g. node died) and could not be reconstructed."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """`get()` timed out."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Failed to set up the runtime environment for a task/actor."""
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    """Back-pressure: too many in-flight calls to an actor."""

@@ -1,0 +1,529 @@
+"""GCS: the cluster control plane.
+
+Equivalent of the reference's GCS server (`src/ray/gcs/gcs_server/
+gcs_server.h:77`): node membership + health (GcsNodeManager,
+GcsHealthCheckManager), actor lifecycle with restart-on-failure
+(GcsActorManager `gcs_actor_manager.h:281`), placement groups with 2-phase
+reserve/commit (GcsPlacementGroupManager `gcs_placement_group_manager.h:223`),
+jobs, internal KV, pubsub fan-out, and the cluster resource view that backs
+scheduling (GcsResourceManager). Storage is in-memory (the reference's
+default `InMemoryStoreClient`, `gcs_table_storage.h:354`); a persistence
+hook can be added behind the same table interface.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core import rpc
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu.core.scheduler import SchedulingPolicy, NodeView
+from ray_tpu.core.task_spec import ActorCreationSpec, ActorInfo, ActorState
+
+logger = logging.getLogger(__name__)
+
+# Pubsub channels (cf. reference src/ray/protobuf/pubsub.proto:28-46)
+CH_NODES = "nodes"
+CH_ACTORS = "actors"
+CH_RESOURCES = "resources"
+CH_ERRORS = "errors"
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1"):
+        self._server = rpc.RpcServer(host)
+        self._server.register_all(self)
+        self._lock = threading.RLock()
+
+        # node table: node_id(bytes) -> info dict
+        self._nodes: Dict[bytes, dict] = {}
+        self._raylet_clients: Dict[bytes, rpc.RpcClient] = {}
+        self._last_heartbeat: Dict[bytes, float] = {}
+
+        # kv: namespace -> key -> value
+        self._kv: Dict[str, Dict[bytes, Any]] = {}
+
+        # actors
+        self._actors: Dict[ActorID, ActorInfo] = {}
+        self._actor_specs: Dict[ActorID, ActorCreationSpec] = {}
+        self._actor_owners: Dict[ActorID, str] = {}
+        self._named_actors: Dict[tuple, ActorID] = {}  # (namespace, name) -> id
+
+        # placement groups
+        self._pgs: Dict[PlacementGroupID, dict] = {}
+
+        # jobs
+        self._jobs: Dict[bytes, dict] = {}
+
+        # pubsub: channel -> list[ServerConnection]
+        self._subs: Dict[str, List[rpc.ServerConnection]] = {}
+
+        self._policy = SchedulingPolicy()
+        self._shutdown = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ boot
+    def start(self) -> str:
+        self._server.start()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="gcs-health", daemon=True
+        )
+        self._health_thread.start()
+        logger.info("GCS listening on %s", self._server.address)
+        return self._server.address
+
+    @property
+    def address(self) -> str:
+        return self._server.address
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        for c in self._raylet_clients.values():
+            c.close()
+        self._server.stop()
+
+    # ---------------------------------------------------------------- pubsub
+    def _publish(self, channel: str, message: Any) -> None:
+        for conn in list(self._subs.get(channel, [])):
+            if conn.alive:
+                conn.push("pubsub", {"channel": channel, "message": message})
+
+    def rpc_subscribe(self, conn, req_id, payload):
+        channels = payload["channels"]
+        for ch in channels:
+            subs = self._subs.setdefault(ch, [])
+            if conn not in subs:
+                subs.append(conn)
+                conn.on_close.append(lambda c, ch=ch: self._unsub(ch, c))
+        return True
+
+    def _unsub(self, channel: str, conn) -> None:
+        try:
+            self._subs.get(channel, []).remove(conn)
+        except ValueError:
+            pass
+
+    # ----------------------------------------------------------------- nodes
+    def rpc_register_node(self, conn, req_id, payload):
+        node_id: bytes = payload["node_id"]
+        with self._lock:
+            self._nodes[node_id] = {
+                "node_id": node_id,
+                "address": payload["address"],
+                "object_store_address": payload.get("object_store_address", payload["address"]),
+                "resources_total": dict(payload["resources"]),
+                "resources_available": dict(payload["resources"]),
+                "labels": payload.get("labels", {}),
+                "alive": True,
+                "start_time": time.time(),
+            }
+            self._last_heartbeat[node_id] = time.monotonic()
+            try:
+                self._raylet_clients[node_id] = rpc.connect_with_retry(payload["address"], timeout=10)
+            except Exception:
+                logger.exception("GCS could not connect back to raylet %s", payload["address"])
+        self._publish(CH_NODES, {"event": "added", "node": self._public_node(node_id)})
+        self._broadcast_resources()
+        return {"nodes": [self._public_node(n) for n in self._nodes]}
+
+    def _public_node(self, node_id: bytes) -> dict:
+        n = self._nodes[node_id]
+        return {k: n[k] for k in (
+            "node_id", "address", "object_store_address", "resources_total",
+            "resources_available", "labels", "alive")}
+
+    def rpc_heartbeat(self, conn, req_id, payload):
+        node_id = payload["node_id"]
+        with self._lock:
+            self._last_heartbeat[node_id] = time.monotonic()
+            n = self._nodes.get(node_id)
+            if n is not None and "resources_available" in payload:
+                n["resources_available"] = payload["resources_available"]
+        return True
+
+    def rpc_report_resources(self, conn, req_id, payload):
+        """Raylet resource view update (reference RaySyncer role)."""
+        node_id = payload["node_id"]
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n is not None:
+                n["resources_available"] = payload["available"]
+        self._broadcast_resources()
+        return True
+
+    def _broadcast_resources(self) -> None:
+        view = self.cluster_view()
+        self._publish(CH_RESOURCES, view)
+
+    def cluster_view(self) -> dict:
+        with self._lock:
+            return {
+                nid.hex(): {
+                    "address": n["address"],
+                    "object_store_address": n["object_store_address"],
+                    "total": dict(n["resources_total"]),
+                    "available": dict(n["resources_available"]),
+                    "labels": dict(n["labels"]),
+                    "alive": n["alive"],
+                }
+                for nid, n in self._nodes.items()
+            }
+
+    def rpc_get_cluster_view(self, conn, req_id, payload):
+        return self.cluster_view()
+
+    def rpc_get_all_nodes(self, conn, req_id, payload):
+        with self._lock:
+            return [self._public_node(n) for n in self._nodes]
+
+    def rpc_drain_node(self, conn, req_id, payload):
+        """Graceful removal (autoscaler downscale)."""
+        self._mark_node_dead(payload["node_id"], "drained")
+        return True
+
+    def _health_loop(self) -> None:
+        cfg = get_config()
+        period = cfg.health_check_period_ms / 1000.0
+        timeout = cfg.health_check_timeout_ms / 1000.0
+        while not self._shutdown.wait(period):
+            now = time.monotonic()
+            dead = []
+            with self._lock:
+                for nid, last in self._last_heartbeat.items():
+                    if self._nodes.get(nid, {}).get("alive") and now - last > timeout:
+                        dead.append(nid)
+            for nid in dead:
+                logger.warning("node %s missed heartbeats; marking dead", nid.hex()[:8])
+                self._mark_node_dead(nid, "health check failed")
+
+    def _mark_node_dead(self, node_id: bytes, reason: str) -> None:
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n is None or not n["alive"]:
+                return
+            n["alive"] = False
+            client = self._raylet_clients.pop(node_id, None)
+        if client:
+            client.close()
+        self._publish(CH_NODES, {"event": "removed", "node_id": node_id, "reason": reason})
+        self._broadcast_resources()
+        # Fail over actors that lived on the dead node.
+        with self._lock:
+            affected = [a for a in self._actors.values() if a.node_id == node_id and a.state == ActorState.ALIVE]
+        for info in affected:
+            self._handle_actor_failure(info.actor_id, f"node {node_id.hex()[:8]} died: {reason}")
+
+    # ---------------------------------------------------------------- kv
+    def rpc_kv_put(self, conn, req_id, payload):
+        ns = payload.get("namespace", "")
+        with self._lock:
+            table = self._kv.setdefault(ns, {})
+            exists = payload["key"] in table
+            if payload.get("overwrite", True) or not exists:
+                table[payload["key"]] = payload["value"]
+                return True
+            return False
+
+    def rpc_kv_get(self, conn, req_id, payload):
+        ns = payload.get("namespace", "")
+        with self._lock:
+            return self._kv.get(ns, {}).get(payload["key"])
+
+    def rpc_kv_del(self, conn, req_id, payload):
+        ns = payload.get("namespace", "")
+        with self._lock:
+            return self._kv.get(ns, {}).pop(payload["key"], None) is not None
+
+    def rpc_kv_keys(self, conn, req_id, payload):
+        ns = payload.get("namespace", "")
+        prefix = payload.get("prefix", b"")
+        with self._lock:
+            return [k for k in self._kv.get(ns, {}) if k.startswith(prefix)]
+
+    def rpc_kv_exists(self, conn, req_id, payload):
+        ns = payload.get("namespace", "")
+        with self._lock:
+            return payload["key"] in self._kv.get(ns, {})
+
+    # ---------------------------------------------------------------- jobs
+    def rpc_register_job(self, conn, req_id, payload):
+        with self._lock:
+            self._jobs[payload["job_id"]] = {
+                "job_id": payload["job_id"],
+                "driver_address": payload.get("driver_address", ""),
+                "start_time": time.time(),
+                "status": "RUNNING",
+            }
+        return True
+
+    def rpc_mark_job_finished(self, conn, req_id, payload):
+        with self._lock:
+            j = self._jobs.get(payload["job_id"])
+            if j:
+                j["status"] = payload.get("status", "SUCCEEDED")
+                j["end_time"] = time.time()
+        return True
+
+    def rpc_get_jobs(self, conn, req_id, payload):
+        with self._lock:
+            return list(self._jobs.values())
+
+    # ---------------------------------------------------------------- actors
+    def rpc_register_actor(self, conn, req_id, payload):
+        """Register + schedule an actor (cf. gcs_actor_manager.cc:246,271)."""
+        spec: ActorCreationSpec = payload["spec"]
+        owner_address: str = payload.get("owner_address", "")
+        with self._lock:
+            if spec.name:
+                key = (spec.namespace, spec.name)
+                if key in self._named_actors:
+                    existing = self._named_actors[key]
+                    if self._actors[existing].state != ActorState.DEAD:
+                        return {"error": f"actor name '{spec.name}' already taken"}
+                self._named_actors[key] = spec.actor_id
+            info = ActorInfo(
+                actor_id=spec.actor_id,
+                name=spec.name,
+                namespace=spec.namespace,
+                state=ActorState.PENDING,
+                max_restarts=spec.max_restarts,
+                class_name=payload.get("class_name", ""),
+            )
+            self._actors[spec.actor_id] = info
+            self._actor_specs[spec.actor_id] = spec
+            self._actor_owners[spec.actor_id] = owner_address
+        ok = self._schedule_actor(spec.actor_id)
+        if not ok:
+            err = (f"no feasible node for actor resources {spec.resources} "
+                   f"(cluster: {self.cluster_view()})")
+            with self._lock:
+                info = self._actors[spec.actor_id]
+                info.state = ActorState.DEAD
+                info.death_cause = err
+            self._publish(CH_ACTORS, {"actor_id": spec.actor_id, "state": "DEAD",
+                                      "address": "", "death_cause": err})
+            return {"error": err}
+        return {"ok": True}
+
+    def _schedule_actor(self, actor_id: ActorID) -> bool:
+        """Pick a node for the actor and ask its raylet to create it
+        (cf. GcsActorScheduler::Schedule, gcs_actor_scheduler.cc:49)."""
+        with self._lock:
+            spec = self._actor_specs[actor_id]
+            views = [
+                NodeView(nid, n["resources_total"], n["resources_available"], n["labels"])
+                for nid, n in self._nodes.items()
+                if n["alive"]
+            ]
+        target = self._policy.select_node(views, spec.resources, spec.scheduling, prefer_node=None,
+                                          pg_table=self._pgs)
+        if target is None:
+            return False
+        with self._lock:
+            client = self._raylet_clients.get(target)
+            info = self._actors[actor_id]
+            info.node_id = target
+        if client is None:
+            return False
+        try:
+            client.notify("create_actor", {"spec": spec})
+        except Exception:
+            logger.exception("failed to dispatch actor creation to %s", target.hex()[:8])
+            return False
+        return True
+
+    def rpc_actor_creation_done(self, conn, req_id, payload):
+        actor_id = payload["actor_id"]
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None:
+                return False
+            if payload.get("success", True):
+                info.state = ActorState.ALIVE
+                info.address = payload["address"]
+                info.node_id = payload["node_id"]
+            else:
+                info.state = ActorState.DEAD
+                info.death_cause = payload.get("error", "creation failed")
+        self._publish(CH_ACTORS, {"actor_id": actor_id, "state": info.state.value,
+                                  "address": info.address, "death_cause": info.death_cause})
+        return True
+
+    def rpc_actor_failed(self, conn, req_id, payload):
+        self._handle_actor_failure(payload["actor_id"], payload.get("reason", "worker died"))
+        return True
+
+    def _handle_actor_failure(self, actor_id: ActorID, reason: str) -> None:
+        """Restart budget logic (cf. gcs_actor_manager.cc:1149 reschedule)."""
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None or info.state == ActorState.DEAD:
+                return
+            can_restart = info.max_restarts == -1 or info.num_restarts < info.max_restarts
+            if can_restart:
+                info.num_restarts += 1
+                info.state = ActorState.RESTARTING
+                info.address = ""
+            else:
+                info.state = ActorState.DEAD
+                info.death_cause = reason
+        if info.state == ActorState.RESTARTING:
+            self._publish(CH_ACTORS, {"actor_id": actor_id, "state": info.state.value,
+                                      "address": "", "death_cause": ""})
+            if not self._schedule_actor(actor_id):
+                with self._lock:
+                    info.state = ActorState.DEAD
+                    info.death_cause = f"restart failed: {reason}"
+                self._publish(CH_ACTORS, {"actor_id": actor_id, "state": info.state.value,
+                                          "address": "", "death_cause": info.death_cause})
+        else:
+            self._publish(CH_ACTORS, {"actor_id": actor_id, "state": info.state.value,
+                                      "address": "", "death_cause": info.death_cause})
+
+    def rpc_get_actor_info(self, conn, req_id, payload):
+        with self._lock:
+            if "name" in payload:
+                aid = self._named_actors.get((payload.get("namespace", ""), payload["name"]))
+                if aid is None:
+                    return None
+            else:
+                aid = payload["actor_id"]
+            info = self._actors.get(aid)
+            if info is None:
+                return None
+            return {
+                "actor_id": info.actor_id,
+                "name": info.name,
+                "state": info.state.value,
+                "address": info.address,
+                "node_id": info.node_id,
+                "num_restarts": info.num_restarts,
+                "death_cause": info.death_cause,
+                "class_name": info.class_name,
+            }
+
+    def rpc_list_actors(self, conn, req_id, payload):
+        with self._lock:
+            return [
+                {"actor_id": a.actor_id, "name": a.name, "state": a.state.value,
+                 "address": a.address, "class_name": a.class_name,
+                 "num_restarts": a.num_restarts}
+                for a in self._actors.values()
+            ]
+
+    def rpc_kill_actor(self, conn, req_id, payload):
+        actor_id = payload["actor_id"]
+        no_restart = payload.get("no_restart", True)
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None:
+                return False
+            node_id = info.node_id
+            client = self._raylet_clients.get(node_id) if node_id else None
+            if no_restart:
+                info.max_restarts = info.num_restarts  # exhaust budget
+                info.state = ActorState.DEAD
+                info.death_cause = "killed via ray.kill()"
+                info.address = ""
+        if client is not None:
+            try:
+                client.notify("kill_actor_worker", {"actor_id": actor_id})
+            except Exception:
+                pass
+        if no_restart:
+            self._publish(CH_ACTORS, {"actor_id": actor_id, "state": "DEAD",
+                                      "address": "", "death_cause": "killed via ray.kill()"})
+        else:
+            self._handle_actor_failure(actor_id, "killed via ray.kill(no_restart=False)")
+        return True
+
+    # ------------------------------------------------------------ placement
+    def rpc_create_placement_group(self, conn, req_id, payload):
+        """2-phase bundle reservation (cf. gcs_placement_group_scheduler.h)."""
+        pg_id: PlacementGroupID = payload["pg_id"]
+        bundles: List[Dict[str, float]] = payload["bundles"]
+        strategy: str = payload["strategy"]
+        name = payload.get("name")
+        with self._lock:
+            views = [
+                NodeView(nid, n["resources_total"], n["resources_available"], n["labels"])
+                for nid, n in self._nodes.items()
+                if n["alive"]
+            ]
+        placement = self._policy.place_bundles(views, bundles, strategy)
+        if placement is None:
+            self._pgs[pg_id] = {"state": "PENDING", "bundles": bundles,
+                                "strategy": strategy, "name": name, "placement": None}
+            return {"ok": False, "error": "infeasible"}
+        # Phase 1: prepare on each raylet; rollback on any failure.
+        prepared = []
+        ok = True
+        for idx, node_id in enumerate(placement):
+            client = self._raylet_clients.get(node_id)
+            if client is None:
+                ok = False
+                break
+            try:
+                r = client.call("prepare_bundle", {
+                    "pg_id": pg_id, "bundle_index": idx, "resources": bundles[idx]}, timeout=10)
+            except Exception:
+                r = False
+            if not r:
+                ok = False
+                break
+            prepared.append((idx, node_id))
+        if not ok:
+            for idx, node_id in prepared:
+                c = self._raylet_clients.get(node_id)
+                if c:
+                    try:
+                        c.notify("return_bundle", {"pg_id": pg_id, "bundle_index": idx})
+                    except Exception:
+                        pass
+            return {"ok": False, "error": "prepare failed"}
+        # Phase 2: commit.
+        for idx, node_id in prepared:
+            self._raylet_clients[node_id].notify("commit_bundle", {"pg_id": pg_id, "bundle_index": idx})
+        with self._lock:
+            self._pgs[pg_id] = {
+                "state": "CREATED", "bundles": bundles, "strategy": strategy,
+                "name": name, "placement": placement,
+            }
+        return {"ok": True, "placement": placement}
+
+    def rpc_get_placement_group(self, conn, req_id, payload):
+        with self._lock:
+            pg = self._pgs.get(payload["pg_id"])
+            if pg is None and "name" in payload:
+                for pid, p in self._pgs.items():
+                    if p.get("name") == payload["name"]:
+                        pg = dict(p); pg["pg_id"] = pid
+                        break
+            return pg
+
+    def rpc_remove_placement_group(self, conn, req_id, payload):
+        pg_id = payload["pg_id"]
+        with self._lock:
+            pg = self._pgs.pop(pg_id, None)
+        if pg and pg.get("placement"):
+            for idx, node_id in enumerate(pg["placement"]):
+                c = self._raylet_clients.get(node_id)
+                if c:
+                    try:
+                        c.notify("return_bundle", {"pg_id": pg_id, "bundle_index": idx})
+                    except Exception:
+                        pass
+        return pg is not None
+
+    def rpc_list_placement_groups(self, conn, req_id, payload):
+        with self._lock:
+            return [
+                {"pg_id": pid, "state": p["state"], "strategy": p["strategy"],
+                 "bundles": p["bundles"], "name": p.get("name"),
+                 "placement": p.get("placement")}
+                for pid, p in self._pgs.items()
+            ]

@@ -1,0 +1,63 @@
+"""ObjectRef: a future-like handle to an object in the cluster.
+
+Mirrors the reference's `python/ray/includes/object_ref.pxi` ObjectRef:
+hashable, comparable, awaitable via `get()`, and pickling one registers a
+borrow with the serialization context so the ownership layer can track
+nested/borrowed references (reference `reference_count.h:220`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.core.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_address", "_call_site")
+
+    def __init__(self, object_id: ObjectID, owner_address: Optional[str] = None, call_site: str = ""):
+        self.id = object_id
+        self.owner_address = owner_address
+        self._call_site = call_site
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def task_id(self):
+        return self.id.task_id()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()[:16]})"
+
+    def __reduce__(self):
+        # Record the borrow (no-op outside an active serialize()).
+        from ray_tpu.core import serialization
+
+        serialization.record_contained_ref(self)
+        return (_rebuild_ref, (self.id, self.owner_address, self._call_site))
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the object value."""
+        from ray_tpu.core.api import _global_worker
+        return _global_worker().get_async(self)
+
+
+def _rebuild_ref(object_id, owner_address, call_site):
+    ref = ObjectRef(object_id, owner_address, call_site)
+    # When deserialized inside a running worker, register as borrowed.
+    from ray_tpu.core import worker as _worker_mod
+
+    w = _worker_mod.current_worker()
+    if w is not None:
+        w.reference_counter.add_borrowed(ref)
+    return ref

@@ -1,0 +1,703 @@
+"""Raylet: the per-node manager.
+
+Equivalent of the reference's `NodeManager` + `WorkerPool` + `LocalTaskManager`
+(`src/ray/raylet/node_manager.h:115`, `worker_pool.h:156`,
+`local_task_manager.h:58`): grants workers to queued tasks when resources are
+available, spawns/reuses worker subprocesses, schedules across the cluster
+with the hybrid policy using a resource view streamed from the GCS (the
+reference's RaySyncer role), spills tasks back to other raylets, hosts the
+node's shared-memory object store, and serves inter-node object transfer
+(reference `ObjectManager`/`PullManager`/`PushManager`).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.core import rpc
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_store import SharedObjectStore
+from ray_tpu.core.scheduler import NodeView, SchedulingPolicy
+from ray_tpu.core.task_spec import TaskSpec, TaskType
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: WorkerID
+    conn: rpc.ServerConnection            # registration connection (for pushes)
+    address: str                          # the worker's own core-worker server
+    pid: int
+    proc: Optional[subprocess.Popen] = None
+    actor_id: Optional[ActorID] = None    # dedicated actor worker
+    current_task: Optional[TaskSpec] = None
+    idle_since: float = field(default_factory=time.monotonic)
+    # resources held for the actor's lifetime: (bundle_key | None, demand)
+    actor_charge: Optional[Tuple[Optional[Tuple], Dict[str, float]]] = None
+
+
+@dataclass
+class _QueuedTask:
+    spec: TaskSpec
+    spillback_count: int = 0
+
+
+class Raylet:
+    def __init__(
+        self,
+        gcs_address: str,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        host: str = "127.0.0.1",
+        object_store_memory: Optional[int] = None,
+        worker_env: Optional[Dict[str, str]] = None,
+    ):
+        self.node_id = NodeID.from_random()
+        self.gcs_address = gcs_address
+        cfg = get_config()
+        if resources is None:
+            resources = {"CPU": float(os.cpu_count() or 1)}
+        resources.setdefault("memory", 4 * 1024**3)
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self.labels = dict(labels or {})
+        self.worker_env = dict(worker_env or {})
+
+        self._server = rpc.RpcServer(host)
+        self._server.register_all(self)
+        self.store = SharedObjectStore(capacity=object_store_memory)
+
+        self._lock = threading.RLock()
+        self._policy = SchedulingPolicy()
+        self._queue: deque[_QueuedTask] = deque()
+        self._workers: Dict[WorkerID, WorkerHandle] = {}
+        self._idle_workers: deque[WorkerID] = deque()
+        self._starting: List[subprocess.Popen] = []
+        self._pending_actor_specs: deque = deque()
+
+        # cluster view: node_id hex -> {address, total, available, labels, alive}
+        self._cluster_view: Dict[str, dict] = {}
+        self._raylet_clients: Dict[str, rpc.RpcClient] = {}
+
+        # per-pg bundle reservations: (pg_id, idx) -> remaining resources
+        self._bundles: Dict[Tuple, Dict[str, float]] = {}
+        self._bundles_committed: Dict[Tuple, bool] = {}
+
+        # object pulls in flight: object_id -> list[(conn, req_id)] waiting
+        self._pending_pulls: Dict[ObjectID, List[Tuple]] = {}
+
+        self._gcs: Optional[rpc.RpcClient] = None
+        self._shutdown = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------------ boot
+    def start(self) -> str:
+        self._server.start()
+        self._gcs = rpc.connect_with_retry(self.gcs_address, push_handler=self._on_gcs_push)
+        reply = self._gcs.call("register_node", {
+            "node_id": self.node_id.binary(),
+            "address": self._server.address,
+            "resources": self.resources_total,
+            "labels": self.labels,
+        })
+        for n in reply["nodes"]:
+            self._note_node(n)
+        self._gcs.call("subscribe", {"channels": ["resources", "nodes"]})
+        t = threading.Thread(target=self._heartbeat_loop, name="raylet-heartbeat", daemon=True)
+        t.start()
+        self._threads.append(t)
+        t2 = threading.Thread(target=self._reaper_loop, name="raylet-reaper", daemon=True)
+        t2.start()
+        self._threads.append(t2)
+        logger.info("raylet %s on %s resources=%s", self.node_id.hex()[:8],
+                    self._server.address, self.resources_total)
+        return self._server.address
+
+    @property
+    def address(self) -> str:
+        return self._server.address
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        with self._lock:
+            workers = list(self._workers.values())
+            starting = list(self._starting)
+        for p in starting:
+            try:
+                p.terminate()
+            except Exception:
+                pass
+        for w in workers:
+            if w.proc is not None:
+                try:
+                    w.proc.terminate()
+                except Exception:
+                    pass
+        for w in workers:
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=2)
+                except Exception:
+                    try:
+                        w.proc.kill()
+                    except Exception:
+                        pass
+        if self._gcs:
+            self._gcs.close()
+        for c in self._raylet_clients.values():
+            c.close()
+        self._server.stop()
+        self.store.shutdown()
+
+    # ----------------------------------------------------- gcs pubsub intake
+    def _on_gcs_push(self, method: str, payload):
+        if method != "pubsub":
+            return
+        ch, msg = payload["channel"], payload["message"]
+        if ch == "resources":
+            with self._lock:
+                for hexid, v in msg.items():
+                    if hexid == self.node_id.hex():
+                        continue
+                    self._cluster_view[hexid] = v
+            self._schedule()
+        elif ch == "nodes":
+            if msg.get("event") == "removed":
+                hexid = msg["node_id"].hex()
+                with self._lock:
+                    self._cluster_view.pop(hexid, None)
+                    c = self._raylet_clients.pop(hexid, None)
+                if c:
+                    c.close()
+
+    def _note_node(self, n: dict) -> None:
+        hexid = n["node_id"].hex()
+        if hexid == self.node_id.hex():
+            return
+        with self._lock:
+            self._cluster_view[hexid] = {
+                "address": n["address"],
+                "total": n["resources_total"],
+                "available": n["resources_available"],
+                "labels": n.get("labels", {}),
+                "alive": n.get("alive", True),
+            }
+
+    def _peer(self, address: str) -> rpc.RpcClient:
+        with self._lock:
+            c = self._raylet_clients.get(address)
+            if c is not None and not c.closed:
+                return c
+            c = rpc.connect_with_retry(address, timeout=3)
+            self._raylet_clients[address] = c
+            return c
+
+    def _heartbeat_loop(self) -> None:
+        period = get_config().health_check_period_ms / 1000.0
+        while not self._shutdown.wait(period):
+            try:
+                self._gcs.call("heartbeat", {
+                    "node_id": self.node_id.binary(),
+                    "resources_available": dict(self.resources_available),
+                }, timeout=5)
+            except Exception:
+                if not self._shutdown.is_set():
+                    logger.warning("heartbeat to GCS failed")
+
+    def _report_resources(self) -> None:
+        try:
+            self._gcs.notify("report_resources", {
+                "node_id": self.node_id.binary(),
+                "available": dict(self.resources_available),
+            })
+        except Exception:
+            pass
+
+    # ------------------------------------------------------- worker lifecycle
+    def rpc_register_worker(self, conn, req_id, payload):
+        wid: WorkerID = payload["worker_id"]
+        handle = WorkerHandle(
+            worker_id=wid, conn=conn, address=payload["address"], pid=payload["pid"],
+        )
+        with self._lock:
+            # adopt the Popen if we spawned it
+            for p in self._starting:
+                if p.pid == payload["pid"]:
+                    handle.proc = p
+                    self._starting.remove(p)
+                    break
+            self._workers[wid] = handle
+            conn.on_close.append(lambda c, wid=wid: self._on_worker_disconnect(wid))
+            if payload.get("worker_type") == "driver":
+                return {"node_id": self.node_id.binary(), "gcs_address": self.gcs_address}
+            # a fresh worker: give it a pending actor spec or mark idle
+            if self._pending_actor_specs:
+                spec = self._pending_actor_specs.popleft()
+                self._assign_actor(handle, spec)
+            else:
+                self._idle_workers.append(wid)
+        self._schedule()
+        return {"node_id": self.node_id.binary(), "gcs_address": self.gcs_address}
+
+    def _spawn_worker(self) -> None:
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        env.setdefault("JAX_PLATFORMS", "cpu")  # workers default to CPU JAX
+        # Workers must find ray_tpu even when it is on sys.path but not
+        # installed (driver ran `sys.path.insert`): prepend our package root.
+        import ray_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+        existing = env.get("PYTHONPATH", "")
+        if pkg_root not in existing.split(os.pathsep):
+            env["PYTHONPATH"] = pkg_root + (os.pathsep + existing if existing else "")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main",
+             "--raylet", self._server.address, "--gcs", self.gcs_address,
+             "--node-id", self.node_id.hex()],
+            env=env,
+        )
+        self._starting.append(proc)
+
+    def _on_worker_disconnect(self, wid: WorkerID) -> None:
+        with self._lock:
+            handle = self._workers.pop(wid, None)
+            if handle is None:
+                return
+            try:
+                self._idle_workers.remove(wid)
+            except ValueError:
+                pass
+            spec = handle.current_task
+            actor_id = handle.actor_id
+        if self._shutdown.is_set():
+            return
+        if spec is not None:
+            self._release_resources(spec)
+            self._notify_owner_worker_died(spec)
+        self._release_actor_charge(handle)
+        if actor_id is not None:
+            try:
+                self._gcs.notify("actor_failed", {
+                    "actor_id": actor_id, "reason": f"worker process {handle.pid} died"})
+            except Exception:
+                pass
+        self._schedule()
+
+    def _notify_owner_worker_died(self, spec: TaskSpec) -> None:
+        from ray_tpu.core.exceptions import WorkerCrashedError
+        try:
+            owner = self._peer(spec.owner_address)
+            owner.notify("task_worker_died", {"task_id": spec.task_id})
+        except Exception:
+            logger.warning("could not notify owner of dead worker for task %s", spec.task_id)
+
+    def _reaper_loop(self) -> None:
+        """Reap dead spawned processes + kill long-idle workers."""
+        cfg = get_config()
+        while not self._shutdown.wait(1.0):
+            with self._lock:
+                starting = list(self._starting)
+            for p in starting:
+                if p.poll() is not None:
+                    with self._lock:
+                        try:
+                            self._starting.remove(p)
+                        except ValueError:
+                            pass
+                    logger.warning("worker pid %d exited during startup rc=%s", p.pid, p.returncode)
+            # idle killing
+            now = time.monotonic()
+            to_kill: List[WorkerHandle] = []
+            with self._lock:
+                for wid in list(self._idle_workers):
+                    w = self._workers.get(wid)
+                    if w and w.proc is not None and now - w.idle_since > cfg.idle_worker_killing_time_s:
+                        self._idle_workers.remove(wid)
+                        self._workers.pop(wid, None)
+                        to_kill.append(w)
+            for w in to_kill:
+                try:
+                    w.conn.push("exit", {})
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------ scheduling
+    def rpc_submit_task(self, conn, req_id, payload):
+        spec: TaskSpec = payload["spec"]
+        self._submit(spec, payload.get("spillback_count", 0))
+        return True
+
+    def _submit(self, spec: TaskSpec, spillback_count: int) -> None:
+        with self._lock:
+            self._queue.append(_QueuedTask(spec, spillback_count))
+        self._schedule()
+
+    def _schedule(self) -> None:
+        """Drain the queue: dispatch locally or spill to a better node.
+
+        Mirrors ClusterTaskManager::QueueAndScheduleTask + LocalTaskManager
+        dispatch (`cluster_task_manager.cc:44,418`).
+        """
+        dispatched_any = False
+        with self._lock:
+            pending: deque[_QueuedTask] = deque()
+            while self._queue:
+                qt = self._queue.popleft()
+                spec = qt.spec
+                demand = self._effective_demand(spec)
+                target = self._choose_node(spec, qt.spillback_count)
+                if target is None:
+                    # infeasible anywhere right now — keep queued
+                    pending.append(qt)
+                    continue
+                if target != self.node_id.hex():
+                    if not self._spill_to(target, qt):
+                        pending.append(qt)
+                    continue
+                if not self._resources_ok(spec, demand):
+                    pending.append(qt)
+                    continue
+                handle = self._acquire_worker()
+                if handle is None:
+                    pending.append(qt)
+                    self._maybe_spawn()
+                    continue
+                self._charge_resources(spec, demand)
+                handle.current_task = spec
+                handle.conn.push("execute_task", {"spec": spec})
+                dispatched_any = True
+            self._queue = pending
+        if dispatched_any:
+            self._report_resources()
+
+    def _effective_demand(self, spec: TaskSpec) -> Dict[str, float]:
+        demand = dict(spec.resources)
+        if not demand and spec.task_type == TaskType.NORMAL:
+            demand = {"CPU": 1.0}
+        return demand
+
+    def _choose_node(self, spec: TaskSpec, spillback_count: int) -> Optional[str]:
+        """Returns node hex id, possibly self; None if infeasible."""
+        if spillback_count >= 1 or spec.scheduling.placement_group_id is not None:
+            # spilled tasks run where they land if feasible; PG tasks were
+            # routed to the bundle's node already
+            return self.node_id.hex()
+        demand = self._effective_demand(spec)
+        views = [NodeView(self.node_id.binary(), self.resources_total,
+                          self.resources_available, self.labels)]
+        addr_by_hex = {self.node_id.hex(): self._server.address}
+        for hexid, v in self._cluster_view.items():
+            if not v.get("alive", True):
+                continue
+            views.append(NodeView(bytes.fromhex(hexid), v["total"], v["available"], v.get("labels", {})))
+            addr_by_hex[hexid] = v["address"]
+        chosen = self._policy.select_node(views, demand, spec.scheduling,
+                                          prefer_node=self.node_id.binary())
+        if chosen is None:
+            return None
+        return chosen.hex()
+
+    def _spill_to(self, target_hex: str, qt: _QueuedTask) -> bool:
+        v = self._cluster_view.get(target_hex)
+        if v is None:
+            return False
+        try:
+            peer = self._peer(v["address"])
+            peer.notify("submit_task", {"spec": qt.spec, "spillback_count": qt.spillback_count + 1})
+            return True
+        except Exception:
+            # Mark the target suspect so we do not deterministically re-pick
+            # it while the GCS death notice is still in flight.
+            logger.warning("spillback to %s failed; marking node suspect", target_hex[:8])
+            v["alive"] = False
+            return False
+
+    def _resources_ok(self, spec: TaskSpec, demand: Dict[str, float]) -> bool:
+        pg = spec.scheduling.placement_group_id
+        if pg is not None:
+            key = (pg, max(spec.scheduling.bundle_index, 0))
+            pool = self._bundles.get(key)
+            if pool is None:
+                return False
+            return all(pool.get(r, 0.0) + 1e-9 >= q for r, q in demand.items())
+        return all(self.resources_available.get(r, 0.0) + 1e-9 >= q for r, q in demand.items())
+
+    def _charge_resources(self, spec: TaskSpec, demand: Dict[str, float]) -> None:
+        pg = spec.scheduling.placement_group_id
+        pool = self.resources_available
+        if pg is not None:
+            pool = self._bundles[(pg, max(spec.scheduling.bundle_index, 0))]
+        for r, q in demand.items():
+            pool[r] = pool.get(r, 0.0) - q
+
+    def _release_resources(self, spec: TaskSpec) -> None:
+        demand = self._effective_demand(spec)
+        with self._lock:
+            pg = spec.scheduling.placement_group_id
+            pool = self.resources_available
+            if pg is not None:
+                key = (pg, max(spec.scheduling.bundle_index, 0))
+                pool = self._bundles.get(key)
+                if pool is None:
+                    return
+            for r, q in demand.items():
+                pool[r] = pool.get(r, 0.0) + q
+
+    def _acquire_worker(self) -> Optional[WorkerHandle]:
+        while self._idle_workers:
+            wid = self._idle_workers.popleft()
+            w = self._workers.get(wid)
+            if w is not None and w.conn.alive:
+                return w
+        return None
+
+    def _maybe_spawn(self) -> None:
+        if len(self._starting) < get_config().maximum_startup_concurrency:
+            self._spawn_worker()
+
+    def rpc_task_done(self, conn, req_id, payload):
+        wid: WorkerID = payload["worker_id"]
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is None:
+                return True
+            spec = w.current_task
+            w.current_task = None
+        if spec is not None:
+            self._release_resources(spec)
+        with self._lock:
+            if w.actor_id is None and w.conn.alive:
+                w.idle_since = time.monotonic()
+                self._idle_workers.append(wid)
+        self._schedule()
+        self._report_resources()
+        return True
+
+    # ---------------------------------------------------------------- actors
+    def rpc_create_actor(self, conn, req_id, payload):
+        """Push from GCS: lease a dedicated worker and instantiate."""
+        spec = payload["spec"]
+        with self._lock:
+            handle = self._acquire_worker()
+            if handle is None:
+                self._pending_actor_specs.append(spec)
+                self._maybe_spawn()
+                return True
+            self._assign_actor(handle, spec)
+        return True
+
+    def _assign_actor(self, handle: WorkerHandle, spec) -> None:
+        handle.actor_id = spec.actor_id
+        # charge actor resources against the node (held for actor lifetime,
+        # released on worker death/kill via _release_actor_charge)
+        demand = dict(spec.resources)
+        pg = spec.scheduling.placement_group_id
+        key = None
+        if pg is not None:
+            key = (pg, max(spec.scheduling.bundle_index, 0))
+            pool = self._bundles.get(key)
+            if pool is None:
+                key = None
+                pool = self.resources_available
+        else:
+            pool = self.resources_available
+        for r, q in demand.items():
+            pool[r] = pool.get(r, 0.0) - q
+        handle.actor_charge = (key, demand)
+        handle.conn.push("become_actor", {"spec": spec})
+
+    def _release_actor_charge(self, handle: WorkerHandle) -> None:
+        charge = handle.actor_charge
+        if charge is None:
+            return
+        handle.actor_charge = None
+        key, demand = charge
+        with self._lock:
+            pool = self._bundles.get(key) if key is not None else self.resources_available
+            if pool is None:
+                return
+            for r, q in demand.items():
+                pool[r] = pool.get(r, 0.0) + q
+        self._report_resources()
+
+    def rpc_kill_actor_worker(self, conn, req_id, payload):
+        actor_id = payload["actor_id"]
+        with self._lock:
+            target = None
+            for w in self._workers.values():
+                if w.actor_id == actor_id:
+                    target = w
+                    break
+        if target is not None:
+            target.actor_id = None  # suppress actor_failed report: this is a kill
+            if target.proc is not None:
+                try:
+                    target.proc.kill()
+                except Exception:
+                    pass
+            else:
+                try:
+                    target.conn.push("exit", {})
+                except Exception:
+                    pass
+        return True
+
+    # ------------------------------------------------------------- placement
+    def rpc_prepare_bundle(self, conn, req_id, payload):
+        key = (payload["pg_id"], payload["bundle_index"])
+        resources = payload["resources"]
+        with self._lock:
+            if not all(self.resources_available.get(r, 0.0) + 1e-9 >= q
+                       for r, q in resources.items()):
+                return False
+            for r, q in resources.items():
+                self.resources_available[r] = self.resources_available.get(r, 0.0) - q
+            self._bundles[key] = dict(resources)
+            self._bundles_committed[key] = False
+        self._report_resources()
+        return True
+
+    def rpc_commit_bundle(self, conn, req_id, payload):
+        key = (payload["pg_id"], payload["bundle_index"])
+        with self._lock:
+            self._bundles_committed[key] = True
+        return True
+
+    def rpc_return_bundle(self, conn, req_id, payload):
+        key = (payload["pg_id"], payload["bundle_index"])
+        with self._lock:
+            pool = self._bundles.pop(key, None)
+            self._bundles_committed.pop(key, None)
+            if pool is None:
+                return True
+            # return the bundle's original reservation to the node
+            # (anything still charged inside the bundle is leaked by the
+            # caller's contract: PG removal implies its tasks are done)
+        # recompute: original reservation minus what's still charged = pool
+        # we return the *full* original amount; find it from payload if given
+        resources = payload.get("resources")
+        with self._lock:
+            if resources is None:
+                resources = pool
+            for r, q in resources.items():
+                self.resources_available[r] = self.resources_available.get(r, 0.0) + q
+        self._report_resources()
+        return True
+
+    # ------------------------------------------------------------ object plane
+    def rpc_obj_create(self, conn, req_id, payload):
+        """Worker asks to allocate a segment it will write directly."""
+        object_id, size = payload["object_id"], payload["size"]
+        try:
+            shm = self.store.create(object_id, size)
+            name = shm.name
+            shm.close()
+            return {"ok": True, "name": name}
+        except FileExistsError:
+            return {"ok": False, "exists": True}
+
+    def rpc_obj_seal(self, conn, req_id, payload):
+        self.store.seal(payload["object_id"])
+        self._resolve_pulls(payload["object_id"])
+        return True
+
+    def rpc_obj_put_bytes(self, conn, req_id, payload):
+        object_id = payload["object_id"]
+        try:
+            self.store.put_bytes(object_id, payload["data"])
+        except FileExistsError:
+            pass
+        self._resolve_pulls(object_id)
+        return True
+
+    def rpc_obj_lookup(self, conn, req_id, payload):
+        return self.store.lookup(payload["object_id"])
+
+    def rpc_obj_delete(self, conn, req_id, payload):
+        self.store.delete(payload["object_id"])
+        return True
+
+    def rpc_obj_stats(self, conn, req_id, payload):
+        return self.store.stats()
+
+    def rpc_fetch_object(self, conn, req_id, payload):
+        """Peer raylet requests the object bytes (single-shot transfer)."""
+        data = self.store.read_bytes(payload["object_id"])
+        return data  # None if not here
+
+    def rpc_pull_object(self, conn, req_id, payload):
+        """Worker asks: make object local, reply (name,size) when done.
+
+        `source` is the raylet address believed to hold a copy (from the
+        owner's location table, cf. OwnershipBasedObjectDirectory).
+        """
+        object_id: ObjectID = payload["object_id"]
+        loc = self.store.lookup(object_id)
+        if loc is not None:
+            return loc
+        with self._lock:
+            waiters = self._pending_pulls.setdefault(object_id, [])
+            waiters.append((conn, req_id))
+            first = len(waiters) == 1
+        if first:
+            t = threading.Thread(
+                target=self._do_pull, args=(object_id, payload.get("source")),
+                daemon=True)
+            t.start()
+        return rpc.RpcServer.DEFERRED
+
+    def _do_pull(self, object_id: ObjectID, source: Optional[str]) -> None:
+        err = None
+        try:
+            if source and source != self._server.address:
+                peer = self._peer(source)
+                data = peer.call("fetch_object", {"object_id": object_id},
+                                 timeout=120)
+                if data is not None:
+                    try:
+                        self.store.put_bytes(object_id, data)
+                    except FileExistsError:
+                        pass
+                else:
+                    err = f"object {object_id} not found at {source}"
+            else:
+                err = f"no source for object {object_id}"
+        except Exception as e:
+            err = f"pull failed: {e}"
+        self._resolve_pulls(object_id, err)
+
+    def _resolve_pulls(self, object_id: ObjectID, err: Optional[str] = None) -> None:
+        with self._lock:
+            waiters = self._pending_pulls.pop(object_id, [])
+        if not waiters:
+            return
+        loc = self.store.lookup(object_id)
+        for conn, req_id in waiters:
+            if loc is not None:
+                conn.reply(req_id, loc)
+            else:
+                conn.reply(req_id, err or f"object {object_id} unavailable", is_error=True)
+
+    # ------------------------------------------------------------------ info
+    def rpc_node_info(self, conn, req_id, payload):
+        with self._lock:
+            return {
+                "node_id": self.node_id.binary(),
+                "address": self._server.address,
+                "resources_total": dict(self.resources_total),
+                "resources_available": dict(self.resources_available),
+                "labels": dict(self.labels),
+                "num_workers": len(self._workers),
+                "queued_tasks": len(self._queue),
+            }

@@ -1,0 +1,340 @@
+"""Message-passing RPC layer used by all framework daemons.
+
+Equivalent role to the reference's gRPC wrappers (`src/ray/rpc/`): every
+daemon (GCS, raylet, worker) hosts an `RpcServer`; clients hold persistent
+connections with pipelined request/response plus server->client pushes (the
+push channel is what pubsub and task dispatch ride on, replacing the
+reference's long-poll `src/ray/pubsub/` + streaming gRPC).
+
+Design: an asyncio server running on a dedicated thread per process;
+synchronous thread-safe clients (a reader thread demultiplexes responses and
+pushes). Frames are length-prefixed pickles — the trust model matches the
+reference (cluster-internal, same-user processes).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import pickle
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+# Frame types
+REQ, REP, ERR, PUSH = 0, 1, 2, 3
+
+_HDR = struct.Struct("!BQI")  # type, request_id, method-name length
+
+
+def _encode(msg_type: int, req_id: int, method: str, payload: Any) -> bytes:
+    m = method.encode()
+    body = pickle.dumps(payload, protocol=5)
+    frame = _HDR.pack(msg_type, req_id, len(m)) + m + body
+    return struct.pack("!Q", len(frame)) + frame
+
+
+def _decode(frame: bytes):
+    msg_type, req_id, mlen = _HDR.unpack_from(frame, 0)
+    off = _HDR.size
+    method = frame[off : off + mlen].decode()
+    payload = pickle.loads(frame[off + mlen :])
+    return msg_type, req_id, method, payload
+
+
+class RpcDisconnected(ConnectionError):
+    pass
+
+
+class ServerConnection:
+    """Server-side view of one client connection; supports pushes."""
+
+    def __init__(self, server: "RpcServer", writer: asyncio.StreamWriter, peer: str):
+        self._server = server
+        self._writer = writer
+        self.peer = peer
+        self.ident: Any = None  # set by a `hello` handler if the app wants
+        self.alive = True
+        self.on_close: list[Callable[["ServerConnection"], None]] = []
+
+    def push(self, method: str, payload: Any) -> None:
+        """Send a one-way message to the client (thread-safe)."""
+        data = _encode(PUSH, 0, method, payload)
+        self._server._loop.call_soon_threadsafe(self._write, data)
+
+    def reply(self, req_id: int, payload: Any, is_error: bool = False) -> None:
+        data = _encode(ERR if is_error else REP, req_id, "", payload)
+        self._server._loop.call_soon_threadsafe(self._write, data)
+
+    def _write(self, data: bytes) -> None:
+        if self.alive:
+            try:
+                self._writer.write(data)
+            except Exception:
+                self.alive = False
+
+
+class RpcServer:
+    """Asyncio RPC server on a dedicated thread.
+
+    Handlers: `fn(conn, payload) -> result` (sync, runs on loop — keep fast)
+    or `async fn(conn, payload)`. A handler may return `Deferred` to reply
+    later via `conn.reply(req_id, ...)` (used for blocking ops like object
+    gets and worker leases).
+    """
+
+    class Deferred:
+        """Sentinel: handler will reply asynchronously via conn.reply(req_id)."""
+
+    DEFERRED = Deferred()
+
+    def __init__(self, host: str = "127.0.0.1"):
+        self._host = host
+        self._handlers: Dict[str, Callable] = {}
+        self._loop: asyncio.AbstractEventLoop = None  # type: ignore
+        self._thread: Optional[threading.Thread] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.port: int = 0
+        self.connections: list[ServerConnection] = []
+        self._started = threading.Event()
+        self._stopped = False
+
+    @property
+    def address(self) -> str:
+        return f"{self._host}:{self.port}"
+
+    def register(self, method: str, fn: Callable) -> None:
+        self._handlers[method] = fn
+
+    def register_all(self, obj: Any, prefix: str = "") -> None:
+        """Register every `rpc_*` method of `obj` under its suffix name."""
+        for name in dir(obj):
+            if name.startswith("rpc_"):
+                self.register(prefix + name[4:], getattr(obj, name))
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="rpc-server", daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("RPC server failed to start")
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def _serve():
+            self._server = await asyncio.start_server(self._handle_conn, self._host, 0)
+            self.port = self._server.sockets[0].getsockname()[1]
+            self._started.set()
+
+        self._loop.run_until_complete(_serve())
+        try:
+            self._loop.run_forever()
+        finally:
+            try:
+                self._loop.run_until_complete(self._loop.shutdown_asyncgens())
+            except Exception:
+                pass
+            self._loop.close()
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        peer = str(writer.get_extra_info("peername"))
+        conn = ServerConnection(self, writer, peer)
+        self.connections.append(conn)
+        try:
+            while True:
+                hdr = await reader.readexactly(8)
+                (n,) = struct.unpack("!Q", hdr)
+                frame = await reader.readexactly(n)
+                msg_type, req_id, method, payload = _decode(frame)
+                handler = self._handlers.get(method)
+                if handler is None:
+                    if msg_type == REQ:
+                        conn.reply(req_id, f"no such method: {method}", is_error=True)
+                    continue
+                try:
+                    if asyncio.iscoroutinefunction(handler):
+                        result = await handler(conn, req_id, payload)
+                    else:
+                        result = handler(conn, req_id, payload)
+                    if msg_type == REQ and not isinstance(result, RpcServer.Deferred):
+                        conn.reply(req_id, result)
+                except Exception as e:  # handler error -> error reply
+                    logger.exception("handler %s failed", method)
+                    if msg_type == REQ:
+                        import traceback
+
+                        conn.reply(req_id, f"{e}\n{traceback.format_exc()}", is_error=True)
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
+            pass
+        except Exception:
+            if not self._stopped:
+                logger.exception("connection error from %s", peer)
+        finally:
+            conn.alive = False
+            try:
+                self.connections.remove(conn)
+            except ValueError:
+                pass
+            for cb in conn.on_close:
+                try:
+                    cb(conn)
+                except Exception:
+                    logger.exception("on_close callback failed")
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def call_soon(self, fn: Callable, *args) -> None:
+        """Schedule `fn` on the server loop (thread-safe)."""
+        self._loop.call_soon_threadsafe(fn, *args)
+
+    def call_later(self, delay: float, fn: Callable, *args):
+        return self._loop.call_soon_threadsafe(
+            lambda: self._loop.call_later(delay, fn, *args)
+        )
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._loop and self._loop.is_running():
+            def _shutdown():
+                if self._server:
+                    self._server.close()
+                for conn in list(self.connections):
+                    conn.alive = False
+                    try:
+                        conn._writer.close()
+                    except Exception:
+                        pass
+                self._loop.stop()
+            try:
+                self._loop.call_soon_threadsafe(_shutdown)
+            except RuntimeError:
+                pass
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class RpcClient:
+    """Thread-safe synchronous client with pipelining and push dispatch."""
+
+    def __init__(self, address: str, push_handler: Optional[Callable[[str, Any], None]] = None,
+                 connect_timeout: float = 30.0, on_disconnect: Optional[Callable[[], None]] = None):
+        host, port = address.rsplit(":", 1)
+        self.address = address
+        self._sock = socket.create_connection((host, int(port)), timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._wlock = threading.Lock()
+        self._pending: Dict[int, Future] = {}
+        self._next_id = 1
+        self._id_lock = threading.Lock()
+        self._push_handler = push_handler
+        self._on_disconnect = on_disconnect
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop, name="rpc-client-reader", daemon=True)
+        self._reader.start()
+
+    def _read_loop(self):
+        f = self._sock.makefile("rb")
+        try:
+            while True:
+                hdr = f.read(8)
+                if len(hdr) < 8:
+                    break
+                (n,) = struct.unpack("!Q", hdr)
+                frame = f.read(n)
+                if len(frame) < n:
+                    break
+                msg_type, req_id, method, payload = _decode(frame)
+                if msg_type == PUSH:
+                    if self._push_handler is not None:
+                        try:
+                            self._push_handler(method, payload)
+                        except Exception:
+                            logger.exception("push handler failed for %s", method)
+                else:
+                    fut = self._pending.pop(req_id, None)
+                    if fut is not None:
+                        if msg_type == ERR:
+                            fut.set_exception(RpcCallError(str(payload)))
+                        else:
+                            fut.set_result(payload)
+        except Exception:
+            if not self._closed:
+                logger.debug("rpc client read loop ended", exc_info=True)
+        finally:
+            self._closed = True
+            err = RpcDisconnected(f"connection to {self.address} lost")
+            for fut in list(self._pending.values()):
+                if not fut.done():
+                    fut.set_exception(err)
+            self._pending.clear()
+            if self._on_disconnect is not None:
+                try:
+                    self._on_disconnect()
+                except Exception:
+                    pass
+
+    def _send(self, data: bytes) -> None:
+        if self._closed:
+            raise RpcDisconnected(f"connection to {self.address} closed")
+        with self._wlock:
+            self._sock.sendall(data)
+
+    def call_future(self, method: str, payload: Any = None) -> Future:
+        with self._id_lock:
+            req_id = self._next_id
+            self._next_id += 1
+        fut: Future = Future()
+        self._pending[req_id] = fut
+        try:
+            self._send(_encode(REQ, req_id, method, payload))
+        except Exception:
+            self._pending.pop(req_id, None)
+            raise
+        return fut
+
+    def call(self, method: str, payload: Any = None, timeout: Optional[float] = None) -> Any:
+        return self.call_future(method, payload).result(timeout=timeout)
+
+    def notify(self, method: str, payload: Any = None) -> None:
+        """One-way message (no response expected)."""
+        self._send(_encode(PUSH, 0, method, payload))
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except Exception:
+            pass
+        try:
+            self._sock.close()
+        except Exception:
+            pass
+
+
+class RpcCallError(RuntimeError):
+    """Remote handler raised; message contains remote traceback."""
+
+
+def connect_with_retry(address: str, timeout: float = 30.0, **kw) -> RpcClient:
+    deadline = time.monotonic() + timeout
+    last: Exception | None = None
+    while time.monotonic() < deadline:
+        try:
+            return RpcClient(address, **kw)
+        except (ConnectionRefusedError, OSError) as e:
+            last = e
+            time.sleep(0.05)
+    raise ConnectionError(f"could not connect to {address} within {timeout}s: {last}")

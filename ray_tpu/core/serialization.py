@@ -1,0 +1,114 @@
+"""Serialization: cloudpickle + out-of-band zero-copy buffers.
+
+Equivalent role to the reference's `python/ray/_private/serialization.py:108`
+(SerializationContext): cloudpickle for arbitrary Python, pickle protocol 5
+out-of-band buffers so numpy / JAX host arrays are serialized as raw memory
+views that can be written straight into (and read straight out of) the
+shared-memory object store without copies.
+
+Also tracks ObjectRefs nested inside serialized values so the ownership layer
+can register borrows (cf. reference `AddNestedObjectIds`,
+`src/ray/core_worker/reference_count.h:365`).
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+
+class SerializedObject:
+    """A serialized value: a small pickle payload + big zero-copy buffers."""
+
+    __slots__ = ("payload", "buffers", "contained_refs")
+
+    def __init__(self, payload: bytes, buffers: List[memoryview], contained_refs: list):
+        self.payload = payload
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+
+    @property
+    def total_bytes(self) -> int:
+        return len(self.payload) + sum(b.nbytes for b in self.buffers)
+
+    def to_bytes(self) -> bytes:
+        """Flatten into one buffer: [n_bufs][len payload][payload][len b_i][b_i]..."""
+        parts = [len(self.buffers).to_bytes(4, "big"), len(self.payload).to_bytes(8, "big"), self.payload]
+        for b in self.buffers:
+            parts.append(b.nbytes.to_bytes(8, "big"))
+            parts.append(b)
+        return b"".join(parts)
+
+    def write_into(self, dst: memoryview) -> int:
+        """Write the flattened representation into `dst`; returns bytes written."""
+        off = 0
+
+        def w(b):
+            nonlocal off
+            n = len(b) if isinstance(b, (bytes, bytearray)) else b.nbytes
+            dst[off : off + n] = b
+            off += n
+
+        w(len(self.buffers).to_bytes(4, "big"))
+        w(len(self.payload).to_bytes(8, "big"))
+        w(self.payload)
+        for b in self.buffers:
+            w(b.nbytes.to_bytes(8, "big"))
+            w(b)
+        return off
+
+    @classmethod
+    def from_buffer(cls, src: memoryview) -> "SerializedObject":
+        """Reconstruct (zero-copy: buffers are views into `src`)."""
+        off = 0
+        n_bufs = int.from_bytes(src[off : off + 4], "big")
+        off += 4
+        plen = int.from_bytes(src[off : off + 8], "big")
+        off += 8
+        payload = bytes(src[off : off + plen])
+        off += plen
+        buffers = []
+        for _ in range(n_bufs):
+            blen = int.from_bytes(src[off : off + 8], "big")
+            off += 8
+            buffers.append(src[off : off + blen])
+            off += blen
+        return cls(payload, buffers, [])
+
+
+# Track refs encountered while pickling, via ObjectRef.__reduce__ hook.
+_thread_local = threading.local()
+
+
+def record_contained_ref(ref) -> None:
+    refs = getattr(_thread_local, "contained_refs", None)
+    if refs is not None:
+        refs.append(ref)
+
+
+def serialize(value: Any) -> SerializedObject:
+    _thread_local.contained_refs = []
+    buffers: List[pickle.PickleBuffer] = []
+    try:
+        payload = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+        contained = list(_thread_local.contained_refs)
+    finally:
+        _thread_local.contained_refs = None
+    views = [b.raw() for b in buffers]
+    return SerializedObject(payload, views, contained)
+
+
+def deserialize(obj: SerializedObject) -> Any:
+    return pickle.loads(obj.payload, buffers=obj.buffers)
+
+
+def dumps(value: Any) -> bytes:
+    """Convenience: serialize to a single contiguous bytes blob."""
+    return serialize(value).to_bytes()
+
+
+def loads(data: bytes | memoryview) -> Any:
+    return deserialize(SerializedObject.from_buffer(memoryview(data)))

@@ -1,0 +1,110 @@
+"""Task/actor specifications that travel on the wire.
+
+Equivalent of the reference's `TaskSpecification`
+(`src/ray/common/task/task_spec.h`): everything a raylet/worker needs to
+schedule and execute a task, including ownership info for the result path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID, WorkerID
+
+
+class TaskType(Enum):
+    NORMAL = 0
+    ACTOR_CREATION = 1
+    ACTOR_TASK = 2
+
+
+@dataclass
+class SchedulingStrategy:
+    """Where a task may run (cf. python/ray/util/scheduling_strategies.py:15,41)."""
+
+    # "DEFAULT" (hybrid), "SPREAD", or None when pg/node targeted
+    name: str = "DEFAULT"
+    node_id: Optional[bytes] = None       # NodeAffinity
+    soft: bool = False
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    task_type: TaskType
+    # Function: either a serialized callable (normal tasks / actor creation)
+    # or a method name (actor tasks).
+    function_blob: Optional[bytes]
+    method_name: str
+    language_hint: str = "python"
+
+    # Arguments: positional list of either ("value", bytes) inline serialized
+    # or ("ref", ObjectID, owner_address) for object refs the executor must
+    # resolve before running (cf. reference dependency resolution).
+    args: List[Tuple] = field(default_factory=list)
+    kwargs_blob: Optional[bytes] = None
+
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    scheduling: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+
+    # Ownership: address of the owner's core-worker RPC server, and its id.
+    owner_address: str = ""
+    owner_worker_id: Optional[WorkerID] = None
+
+    # Actor fields
+    actor_id: Optional[ActorID] = None
+    actor_creation_spec: Optional["ActorCreationSpec"] = None
+    sequence_number: int = 0  # per-caller ordering for actor tasks
+    caller_id: Optional[WorkerID] = None
+
+    # runtime env (conda/pip not supported; env vars + working dir are)
+    runtime_env: Optional[dict] = None
+
+    def return_object_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_task_return(self.task_id, i + 1) for i in range(self.num_returns)]
+
+
+@dataclass
+class ActorCreationSpec:
+    actor_id: ActorID
+    name: Optional[str]            # named actor (get_actor lookup)
+    namespace: str
+    max_restarts: int
+    max_task_retries: int
+    max_concurrency: int
+    lifetime: str                  # "non_detached" | "detached"
+    class_blob: bytes              # cloudpickled class
+    init_args: List[Tuple] = field(default_factory=list)
+    init_kwargs_blob: Optional[bytes] = None
+    resources: Dict[str, float] = field(default_factory=dict)
+    scheduling: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    runtime_env: Optional[dict] = None
+
+
+class ActorState(Enum):
+    PENDING = "PENDING_CREATION"
+    ALIVE = "ALIVE"
+    RESTARTING = "RESTARTING"
+    DEAD = "DEAD"
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    name: Optional[str]
+    namespace: str
+    state: ActorState
+    address: str = ""              # actor worker's core-worker RPC address
+    node_id: Optional[bytes] = None
+    num_restarts: int = 0
+    max_restarts: int = 0
+    death_cause: str = ""
+    class_name: str = ""

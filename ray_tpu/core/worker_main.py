@@ -1,0 +1,47 @@
+"""Worker process entry point.
+
+Equivalent of the reference's `python/ray/_private/workers/default_worker.py`
+(entry `:165`): spawned by the raylet's worker pool, connects back, then
+serves tasks until told to exit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--raylet", required=True)
+    parser.add_argument("--gcs", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--log-level", default="WARNING")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=args.log_level,
+        format="%(asctime)s %(levelname)s worker %(name)s: %(message)s",
+    )
+
+    from ray_tpu.core.worker import CoreWorker, set_current_worker
+
+    try:
+        worker = CoreWorker(
+            mode="worker", raylet_address=args.raylet, gcs_address=args.gcs,
+            connect_timeout=10.0)
+    except ConnectionError:
+        return  # raylet is gone (e.g. shut down while we were starting)
+    set_current_worker(worker)
+
+    # Serve until the raylet connection drops (raylet died or killed us).
+    try:
+        while not worker.raylet.closed:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

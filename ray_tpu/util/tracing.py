@@ -1,0 +1,64 @@
+"""Built-in timeline: chrome://tracing events.
+
+Equivalent of the reference's profile-event timeline
+(`src/ray/core_worker/profile_event.h` -> `ray.timeline()`,
+`python/ray/_private/state.py:851 chrome_tracing_dump:435`): lightweight
+in-process event recording, dumped as chrome trace JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import List
+
+_events: List[dict] = []
+_lock = threading.Lock()
+_t0 = time.perf_counter()
+
+
+def _now_us() -> float:
+    return (time.perf_counter() - _t0) * 1e6
+
+
+@contextmanager
+def span(name: str, category: str = "task", **args):
+    start = _now_us()
+    try:
+        yield
+    finally:
+        end = _now_us()
+        with _lock:
+            _events.append({
+                "name": name, "cat": category, "ph": "X",
+                "ts": start, "dur": end - start,
+                "pid": os.getpid(), "tid": threading.get_ident() % 100000,
+                "args": args,
+            })
+
+
+def instant(name: str, category: str = "event", **args) -> None:
+    with _lock:
+        _events.append({
+            "name": name, "cat": category, "ph": "i", "ts": _now_us(),
+            "pid": os.getpid(), "tid": threading.get_ident() % 100000,
+            "s": "p", "args": args,
+        })
+
+
+def get_events() -> List[dict]:
+    with _lock:
+        return list(_events)
+
+
+def dump(path: str) -> None:
+    with open(path, "w") as f:
+        json.dump({"traceEvents": get_events()}, f)
+
+
+def clear() -> None:
+    with _lock:
+        _events.clear()

@@ -1,0 +1,221 @@
+"""End-to-end tests for tasks, objects, and actors on a single node.
+
+Models the reference's `python/ray/tests/test_basic.py` coverage.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_task_roundtrip(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1)) == 2
+
+
+def test_task_parallel_many(ray_start_regular):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(20)]
+    assert ray_tpu.get(refs) == [i * i for i in range(20)]
+
+
+def test_task_args_refs(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    r1 = add.remote(1, 2)
+    r2 = add.remote(r1, 10)  # ref as arg resolves to its value
+    assert ray_tpu.get(r2) == 13
+
+
+def test_task_kwargs_and_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=2)
+    def divmod_(a, b=3):
+        return a // b, a % b
+
+    q, r = divmod_.remote(10)
+    assert ray_tpu.get([q, r]) == [3, 1]
+
+
+def test_put_get_small_and_large(ray_start_regular):
+    small = {"k": 1}
+    assert ray_tpu.get(ray_tpu.put(small)) == small
+
+    big = np.random.rand(1 << 18)  # 2 MiB -> plasma path
+    out = ray_tpu.get(ray_tpu.put(big))
+    np.testing.assert_array_equal(out, big)
+
+
+def test_large_task_arg_and_return(ray_start_regular):
+    big = np.arange(1 << 18, dtype=np.float64)
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    out = ray_tpu.get(double.remote(big))
+    np.testing.assert_array_equal(out, big * 2)
+
+
+def test_task_error_propagates(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kapow")
+
+    with pytest.raises(ValueError, match="kapow"):
+        ray_tpu.get(boom.remote())
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.5)
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def delay(t):
+        time.sleep(t)
+        return t
+
+    ray_tpu.get([delay.remote(0), delay.remote(0)])  # warm up two workers
+    fast = delay.remote(0.05)
+    slow = delay.remote(5)
+    ready, pending = ray_tpu.wait([fast, slow], num_returns=1, timeout=3)
+    assert ready == [fast]
+    assert pending == [slow]
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        import ray_tpu as rt
+
+        return rt.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(10)) == 21
+
+
+def test_actor_basic(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, k=1):
+            self.n += k
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.incr.remote()) == 11
+    assert ray_tpu.get(c.incr.remote(5)) == 16
+    assert ray_tpu.get(c.value.remote()) == 16
+
+
+def test_actor_ordering(ray_start_regular):
+    @ray_tpu.remote
+    class Log:
+        def __init__(self):
+            self.items = []
+
+        def append(self, x):
+            self.items.append(x)
+
+        def get(self):
+            return self.items
+
+    log = Log.remote()
+    for i in range(50):
+        log.append.remote(i)
+    assert ray_tpu.get(log.get.remote()) == list(range(50))
+
+
+def test_actor_error(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def bad(self):
+            raise RuntimeError("actor oops")
+
+        def good(self):
+            return "fine"
+
+    a = A.remote()
+    with pytest.raises(RuntimeError, match="actor oops"):
+        ray_tpu.get(a.bad.remote())
+    # actor survives method errors
+    assert ray_tpu.get(a.good.remote()) == "fine"
+
+
+def test_named_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    Svc.options(name="svc1").remote()
+    h = ray_tpu.get_actor("svc1")
+    assert ray_tpu.get(h.ping.remote()) == "pong"
+
+
+def test_kill_actor(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    ray_tpu.kill(a)
+    time.sleep(0.5)
+    with pytest.raises((ray_tpu.ActorDiedError, ray_tpu.ActorError, ray_tpu.RayTpuError)):
+        ray_tpu.get(a.ping.remote(), timeout=10)
+
+
+def test_actor_handle_passing(ray_start_regular):
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.v = None
+
+        def set(self, v):
+            self.v = v
+
+        def get(self):
+            return self.v
+
+    @ray_tpu.remote
+    def writer(store, v):
+        import ray_tpu as rt
+
+        rt.get(store.set.remote(v))
+        return True
+
+    s = Store.remote()
+    assert ray_tpu.get(writer.remote(s, 42))
+    assert ray_tpu.get(s.get.remote()) == 42
+
+
+def test_cluster_resources(ray_start_regular):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU") == 4.0
+    assert total.get("TPU") == 8.0

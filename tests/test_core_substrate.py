@@ -1,0 +1,146 @@
+"""Tests for ids, config, serialization, and the RPC layer."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.core import rpc, serialization
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID, _TaskIDCounter
+
+
+def test_ids_roundtrip():
+    t = TaskID.from_random()
+    o = ObjectID.for_task_return(t, 1)
+    assert o.task_id() == t
+    assert o.return_index() == 1
+    p = ObjectID.for_put(t, 3)
+    assert p.return_index() == ObjectID.PUT_INDEX_BASE + 3
+    assert o != p
+    assert len({o, p, o}) == 2
+    assert NodeID.nil().is_nil()
+    assert not NodeID.from_random().is_nil()
+
+
+def test_task_id_counter_deterministic():
+    w = WorkerID(b"w" * 16)
+    c1, c2 = _TaskIDCounter(w), _TaskIDCounter(w)
+    assert c1.next_task_id() == c2.next_task_id()
+    assert c1.next_task_id() != c1.next_task_id()
+
+
+def test_config_env_override(monkeypatch):
+    from ray_tpu.core import config as config_mod
+
+    monkeypatch.setenv("RAY_TPU_SCHEDULER_SPREAD_THRESHOLD", "0.75")
+    config_mod.reset_config()
+    assert get_config().scheduler_spread_threshold == 0.75
+    monkeypatch.delenv("RAY_TPU_SCHEDULER_SPREAD_THRESHOLD")
+    config_mod.reset_config()
+    assert get_config().scheduler_spread_threshold == 0.5
+
+
+def test_serialization_roundtrip():
+    value = {"a": [1, 2, 3], "b": "hello", "c": (None, True)}
+    blob = serialization.dumps(value)
+    assert serialization.loads(blob) == value
+
+
+def test_serialization_numpy_zero_copy():
+    arr = np.arange(1 << 16, dtype=np.float32)
+    s = serialization.serialize(arr)
+    # The array body must travel out-of-band, not inside the pickle payload.
+    assert sum(b.nbytes for b in s.buffers) >= arr.nbytes
+    assert len(s.payload) < 10_000
+    out = serialization.loads(s.to_bytes())
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_rpc_request_response_and_push():
+    server = rpc.RpcServer()
+    got_pushes = []
+
+    def echo(conn, req_id, payload):
+        return ("echo", payload)
+
+    def push_me(conn, req_id, payload):
+        conn.push("hello", payload * 2)
+        return "ok"
+
+    server.register("echo", echo)
+    server.register("push_me", push_me)
+    server.start()
+    try:
+        client = rpc.RpcClient(server.address, push_handler=lambda m, p: got_pushes.append((m, p)))
+        assert client.call("echo", {"x": 1}) == ("echo", {"x": 1})
+        assert client.call("push_me", 21) == "ok"
+        deadline = time.time() + 5
+        while not got_pushes and time.time() < deadline:
+            time.sleep(0.01)
+        assert got_pushes == [("hello", 42)]
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_rpc_error_propagates():
+    server = rpc.RpcServer()
+
+    def boom(conn, req_id, payload):
+        raise ValueError("kapow")
+
+    server.register("boom", boom)
+    server.start()
+    try:
+        client = rpc.RpcClient(server.address)
+        with pytest.raises(rpc.RpcCallError, match="kapow"):
+            client.call("boom")
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_rpc_deferred_reply():
+    server = rpc.RpcServer()
+
+    def slow(conn, req_id, payload):
+        def later():
+            conn.reply(req_id, payload + 1)
+
+        threading.Timer(0.05, later).start()
+        return rpc.RpcServer.DEFERRED
+
+    server.register("slow", slow)
+    server.start()
+    try:
+        client = rpc.RpcClient(server.address)
+        assert client.call("slow", 41) == 42
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_rpc_concurrent_pipelined_calls():
+    server = rpc.RpcServer()
+    server.register("double", lambda conn, req_id, p: p * 2)
+    server.start()
+    try:
+        client = rpc.RpcClient(server.address)
+        futs = [client.call_future("double", i) for i in range(100)]
+        assert [f.result(timeout=5) for f in futs] == [i * 2 for i in range(100)]
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_rpc_disconnect_fails_pending():
+    server = rpc.RpcServer()
+    server.register("hang", lambda conn, req_id, p: rpc.RpcServer.DEFERRED)
+    server.start()
+    client = rpc.RpcClient(server.address)
+    fut = client.call_future("hang")
+    server.stop()
+    with pytest.raises(rpc.RpcDisconnected):
+        fut.result(timeout=5)
