@@ -1,0 +1,8 @@
+from ray_tpu.models.transformer import (
+    ModelConfig,
+    init_params,
+    param_logical_axes,
+    forward,
+    loss_fn,
+    count_params,
+)

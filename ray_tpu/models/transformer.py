@@ -1,0 +1,227 @@
+"""Flagship model: decoder-only transformer LM (Llama-3 family shapes).
+
+Functional JAX, TPU-first:
+  - parameters are a plain pytree with *logical axis* annotations
+    (`param_logical_axes`) mapped to mesh axes by `ray_tpu.parallel.AxisRules`
+    — dp/fsdp/tp/sp shardings are data, not code;
+  - layers are stacked on a leading axis and iterated with `lax.scan`
+    (one compiled layer body regardless of depth — fast compiles, and
+    `jax.checkpoint` on the body gives per-layer rematerialization);
+  - bfloat16 activations/weights with fp32 RMSNorm statistics and fp32
+    logits for the softmax-cross-entropy;
+  - attention is the pallas flash kernel on TPU; with sequence parallelism
+    (mesh sp>1) it switches to ring attention over the sp axis.
+
+The reference has no model zoo of its own (it delegates to torch; SURVEY
+§2.4) — this model is the equivalent of the torch models its Train/RLlib
+examples wrap, built natively.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.layers import apply_rotary, rms_norm, rotary_embedding, swiglu
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 32768
+    d_model: int = 2048
+    n_layers: int = 16
+    n_heads: int = 16
+    n_kv_heads: int = 8
+    d_ff: int = 8192
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: str = "full"          # "none" | "full"
+    use_ring_attention: bool = False  # set when mesh sp > 1
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    # ---- presets ----
+    @staticmethod
+    def tiny() -> "ModelConfig":
+        return ModelConfig(vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+                           n_kv_heads=2, d_ff=256, max_seq_len=256,
+                           dtype=jnp.float32, remat="none")
+
+    @staticmethod
+    def b1() -> "ModelConfig":
+        """~1.2B params: bench-scale for a single v5e chip."""
+        return ModelConfig(vocab_size=32768, d_model=2048, n_layers=16,
+                           n_heads=16, n_kv_heads=8, d_ff=8192)
+
+    @staticmethod
+    def llama3_8b() -> "ModelConfig":
+        """Llama-3-8B shapes (vocab rounded to a 128-multiple sharding unit)."""
+        return ModelConfig(vocab_size=128256, d_model=4096, n_layers=32,
+                           n_heads=32, n_kv_heads=8, d_ff=14336,
+                           max_seq_len=8192)
+
+
+# ---------------------------------------------------------------- params
+
+
+def param_logical_axes(cfg: ModelConfig) -> Dict[str, Any]:
+    """Logical axes per parameter leaf (layer-stacked leaves lead with
+    'layers', which is never mesh-sharded)."""
+    axes = {
+        "embed": ("vocab", "embed"),
+        "final_norm": ("embed_nosplit",),
+        "layers": {
+            "attn_norm": ("layers", "embed_nosplit"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "heads"),
+            "wv": ("layers", "embed", "heads"),
+            "wo": ("layers", "heads", "embed"),
+            "mlp_norm": ("layers", "embed_nosplit"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> Dict[str, Any]:
+    """Scaled-normal init; weights stored in cfg.dtype (bf16 master weights
+    are avoided — the optimizer keeps fp32 state; see train.step)."""
+    k_embed, k_layers, k_head = jax.random.split(rng, 3)
+    d, hd = cfg.d_model, cfg.head_dim
+    nq, nkv = cfg.n_heads, cfg.n_kv_heads
+    L = cfg.n_layers
+
+    def norm_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    params: Dict[str, Any] = {
+        "embed": (jax.random.normal(k_embed, (cfg.vocab_size, d), jnp.float32)
+                  * 0.02).astype(cfg.dtype),
+        "final_norm": jnp.ones((d,), cfg.dtype),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), cfg.dtype),
+            "wq": norm_init(ks[0], (L, d, nq * hd), d),
+            "wk": norm_init(ks[1], (L, d, nkv * hd), d),
+            "wv": norm_init(ks[2], (L, d, nkv * hd), d),
+            "wo": norm_init(ks[3], (L, nq * hd, d), nq * hd),
+            "mlp_norm": jnp.ones((L, d), cfg.dtype),
+            "w_gate": norm_init(ks[4], (L, d, cfg.d_ff), d),
+            "w_up": norm_init(ks[5], (L, d, cfg.d_ff), d),
+            "w_down": norm_init(ks[6], (L, cfg.d_ff, d), cfg.d_ff),
+        },
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = (jax.random.normal(k_head, (d, cfg.vocab_size),
+                                               jnp.float32) * 0.02).astype(cfg.dtype)
+    return params
+
+
+def count_params(params: Any) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _layer(cfg: ModelConfig, mesh, x, layer_params, cos, sin):
+    """One transformer block. x: [b, s, d] (s possibly sp-sharded)."""
+    p = layer_params
+    b, s, d = x.shape
+    hd = cfg.head_dim
+
+    h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+    q = (h @ p["wq"]).reshape(b, s, cfg.n_heads, hd)
+    k = (h @ p["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (h @ p["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
+    q = apply_rotary(q, cos, sin)
+    k = apply_rotary(k, cos, sin)
+    # [b, heads, s, hd]
+    q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    if cfg.use_ring_attention:
+        rep = cfg.n_heads // cfg.n_kv_heads
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        from ray_tpu.ops.ring_attention import ring_attention_sharded
+
+        attn = ring_attention_sharded(mesh, q, k, v, causal=True)
+    else:
+        attn = attention(q, k, v, causal=True)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
+    x = x + (attn @ p["wo"]).astype(x.dtype)
+
+    h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    h = swiglu(h @ p["w_gate"], h @ p["w_up"])
+    x = x + (h @ p["w_down"]).astype(x.dtype)
+    return x
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array, cfg: ModelConfig,
+            positions: Optional[jax.Array] = None, mesh=None) -> jax.Array:
+    """tokens [b, s] -> logits [b, s, vocab] (fp32).
+
+    `mesh` is required when `cfg.use_ring_attention` (the sp shard_map needs
+    it); everything else is pure sharding-annotation-driven SPMD.
+    """
+    if positions is None:
+        positions = jnp.arange(tokens.shape[1])
+    x = params["embed"][tokens].astype(cfg.dtype)  # gather: [b, s, d]
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = cos[None], sin[None]  # add batch dim
+
+    layer_fn = functools.partial(_layer, cfg, mesh)
+    if cfg.remat == "full":
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def body(x, lp):
+        return layer_fn(x, lp, cos, sin), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+
+
+def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
+            cfg: ModelConfig, mesh=None):
+    """Next-token cross entropy.
+
+    batch: either {"tokens": [b, s+1]} (shifted here) or pre-shifted
+    {"inputs": [b, s], "targets": [b, s]} — the latter keeps s divisible by
+    the sp axis for sequence parallelism. Optional {"loss_mask": [b, s]}.
+    """
+    if "inputs" in batch:
+        inputs, targets = batch["inputs"], batch["targets"]
+        mask = batch.get("loss_mask")
+    else:
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        mask = batch.get("loss_mask")
+        if mask is not None:
+            mask = mask[:, 1:]
+    logits = forward(params, inputs, cfg, mesh=mesh)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    target_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - target_logit
+    if mask is not None:
+        maskf = mask.astype(jnp.float32)
+        loss = jnp.sum(nll * maskf) / jnp.maximum(jnp.sum(maskf), 1.0)
+    else:
+        loss = jnp.mean(nll)
+    return loss, {"loss": loss, "ntokens": nll.size}

@@ -1,0 +1,9 @@
+from ray_tpu.parallel.mesh import (
+    MeshConfig,
+    make_mesh,
+    make_virtual_mesh,
+    AxisRules,
+    DEFAULT_RULES,
+    logical_sharding,
+    shard_pytree,
+)

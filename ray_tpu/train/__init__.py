@@ -1,0 +1,1 @@
+from ray_tpu.train.step import TrainState, make_train_step, make_init_fn, batch_sharding

@@ -1,0 +1,140 @@
+"""Sharded training step: the pjit'd heart of the Train stack.
+
+Where the reference's TorchTrainer wraps user loops around torch DDP/FSDP
+(`python/ray/train/torch/config.py:69`, `train_loop_utils.py:92-101`), the
+TPU-native step is one jitted function whose parallelism is entirely in the
+in/out shardings: dp×fsdp shard the batch, fsdp shards parameters ZeRO-3
+style (XLA inserts the all-gathers/reduce-scatters), tp shards heads/mlp,
+sp runs ring attention. No collective calls appear below — the compiler
+emits them over ICI/DCN from the sharding annotations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.models.transformer import (
+    ModelConfig,
+    init_params,
+    loss_fn,
+    param_logical_axes,
+)
+from ray_tpu.parallel.mesh import AxisRules, DEFAULT_RULES, logical_sharding
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def default_optimizer(learning_rate: float = 3e-4,
+                      weight_decay: float = 0.1,
+                      warmup_steps: int = 100,
+                      total_steps: int = 10000) -> optax.GradientTransformation:
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay,
+                    mu_dtype=jnp.float32),
+    )
+
+
+def state_shardings(cfg: ModelConfig, mesh: Mesh,
+                    optimizer: optax.GradientTransformation,
+                    rules: AxisRules = DEFAULT_RULES) -> TrainState:
+    """Build a TrainState of NamedShardings (same tree shape as the state)."""
+    p_axes = param_logical_axes(cfg)
+    p_sh = logical_sharding(mesh, p_axes, rules)
+    params_shape = jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), cfg))
+    opt_shape = jax.eval_shape(optimizer.init, params_shape)
+    replicated = NamedSharding(mesh, P())
+    opt_sh = _shard_opt_like_params(opt_shape, params_shape, p_sh, replicated)
+    return TrainState(params=p_sh, opt_state=opt_sh, step=replicated)
+
+
+def _shard_opt_like_params(opt_shape, params_shape, p_sh, replicated):
+    """Optimizer states embed param-shaped subtrees (adam mu/nu); shard those
+    like the params and replicate everything else (counts, schedules)."""
+    param_struct = jax.tree_util.tree_structure(params_shape)
+
+    def recurse(node):
+        try:
+            struct = jax.tree_util.tree_structure(node)
+        except Exception:
+            struct = None
+        if struct == param_struct:
+            return p_sh
+        if isinstance(node, (list, tuple)):
+            mapped = [recurse(x) for x in node]
+            return type(node)(mapped) if not hasattr(node, "_fields") else type(node)(*mapped)
+        if isinstance(node, dict):
+            return {k: recurse(v) for k, v in node.items()}
+        if dataclasses.is_dataclass(node) and not isinstance(node, jax.ShapeDtypeStruct):
+            return type(node)(**{f.name: recurse(getattr(node, f.name))
+                                 for f in dataclasses.fields(node)})
+        return replicated
+
+    return recurse(opt_shape)
+
+
+def batch_sharding(mesh: Mesh) -> Dict[str, NamedSharding]:
+    """inputs/targets [b, s]: batch over (dp, fsdp), seq over sp."""
+    s = NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+    return {"inputs": s, "targets": s}
+
+
+def make_init_fn(cfg: ModelConfig, mesh: Mesh,
+                 optimizer: optax.GradientTransformation,
+                 rules: AxisRules = DEFAULT_RULES) -> Callable[[jax.Array], TrainState]:
+    """Jitted, sharded-out initializer: params materialize directly on the
+    mesh (an 8B model never exists unsharded on any host)."""
+    sh = state_shardings(cfg, mesh, optimizer, rules)
+
+    def init(rng):
+        params = init_params(rng, cfg)
+        return TrainState(params=params, opt_state=optimizer.init(params),
+                          step=jnp.zeros((), jnp.int32))
+
+    return jax.jit(init, out_shardings=sh)
+
+
+def make_train_step(cfg: ModelConfig, mesh: Mesh,
+                    optimizer: Optional[optax.GradientTransformation] = None,
+                    rules: AxisRules = DEFAULT_RULES,
+                    donate: bool = True):
+    """Returns (step_fn, init_fn, shardings). step_fn(state, batch) ->
+    (state, metrics); fully compiled, parameters donated."""
+    optimizer = optimizer or default_optimizer()
+    sh = state_shardings(cfg, mesh, optimizer, rules)
+    b_sh = batch_sharding(mesh)
+
+    def step(state: TrainState, batch: Dict[str, jax.Array]):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch, cfg, mesh)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+            "step": state.step,
+        }
+        return TrainState(new_params, new_opt, state.step + 1), metrics
+
+    step_fn = jax.jit(
+        step,
+        in_shardings=(sh, b_sh),
+        out_shardings=(sh, NamedSharding(mesh, P())),
+        donate_argnums=(0,) if donate else (),
+    )
+    return step_fn, make_init_fn(cfg, mesh, optimizer, rules), sh

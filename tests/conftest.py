@@ -2,12 +2,18 @@ import os
 
 # Force an 8-device virtual CPU mesh for all tests: multi-chip sharding paths
 # (dp/fsdp/tp/sp) run in CI without TPUs, per the driver's dryrun contract.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the box exports JAX_PLATFORMS=axon
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The machine's sitecustomize registers the TPU plugin and sets the
+# jax_platforms *config* (which beats the env var) — override it back.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
