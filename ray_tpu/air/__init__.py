@@ -1,0 +1,9 @@
+from ray_tpu.air.config import (
+    ScalingConfig,
+    RunConfig,
+    CheckpointConfig,
+    FailureConfig,
+)
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.result import Result
+from ray_tpu.air import session

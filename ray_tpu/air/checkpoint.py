@@ -1,0 +1,76 @@
+"""Checkpoint: dict <-> directory <-> bytes interconvertible.
+
+Mirrors the reference's AIR `Checkpoint` (`python/ray/air/checkpoint.py:63`)
+without the cloud-URI legs (storage_path handles persistence). JAX pytrees
+of arrays are stored as native numpy `.npz` plus a pickled structure, so an
+8B model checkpoint round-trips without Python-object overhead.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class Checkpoint:
+    def __init__(self, data: Optional[Dict[str, Any]] = None,
+                 directory: Optional[str] = None):
+        self._data = data
+        self._directory = directory
+
+    # ---- constructors ----
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        return cls(data=dict(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(directory=path)
+
+    # ---- accessors ----
+    def to_dict(self) -> Dict[str, Any]:
+        if self._data is not None:
+            return self._data
+        assert self._directory is not None
+        with open(os.path.join(self._directory, "checkpoint.pkl"), "rb") as f:
+            data = pickle.load(f)
+        npz_path = os.path.join(self._directory, "arrays.npz")
+        if os.path.exists(npz_path):
+            arrays = np.load(npz_path)
+            leaves = [arrays[k] for k in sorted(arrays.files, key=int)]
+            import jax
+
+            data = jax.tree_util.tree_unflatten(data["__treedef__"], leaves) \
+                if "__treedef__" in data else data
+        return data
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        path = path or tempfile.mkdtemp(prefix="rtpu-ckpt-")
+        os.makedirs(path, exist_ok=True)
+        if self._directory is not None and self._directory != path:
+            shutil.copytree(self._directory, path, dirs_exist_ok=True)
+            return path
+        data = self._data or {}
+        # split array leaves out for efficient storage
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(data)
+        if leaves and all(isinstance(x, (np.ndarray, np.generic)) or hasattr(x, "__array__")
+                          for x in leaves):
+            np.savez(os.path.join(path, "arrays.npz"),
+                     **{str(i): np.asarray(x) for i, x in enumerate(leaves)})
+            with open(os.path.join(path, "checkpoint.pkl"), "wb") as f:
+                pickle.dump({"__treedef__": treedef}, f)
+        else:
+            with open(os.path.join(path, "checkpoint.pkl"), "wb") as f:
+                pickle.dump(data, f)
+        return path
+
+    def __repr__(self):
+        src = "dict" if self._data is not None else self._directory
+        return f"Checkpoint({src})"

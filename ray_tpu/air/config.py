@@ -1,0 +1,57 @@
+"""AIR-style run configuration dataclasses.
+
+Mirrors the reference's `python/ray/air/config.py` (ScalingConfig:89,
+RunConfig:705, CheckpointConfig:577, FailureConfig:518) with TPU-first
+fields: `use_tpu` + `chips_per_worker` instead of `use_gpu`, and
+`topology` for slice-aware placement.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: int = 4            # TPU chips per worker (host)
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None       # e.g. "v5e-64": informs slice packing
+
+    def worker_resources(self) -> Dict[str, float]:
+        if self.resources_per_worker is not None:
+            return dict(self.resources_per_worker)
+        if self.use_tpu:
+            return {"TPU": float(self.chips_per_worker)}
+        return {"CPU": 1.0}
+
+    def strategy(self) -> str:
+        # TPU workers must land on one ICI slice: STRICT_PACK over slice
+        # hosts (scheduler groups by the tpu_slice label).
+        if self.use_tpu and self.placement_strategy == "PACK":
+            return "STRICT_PACK"
+        return self.placement_strategy
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 0
