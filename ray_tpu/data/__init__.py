@@ -1,0 +1,16 @@
+from ray_tpu.data.datastream import (
+    Datastream,
+    Dataset,
+    DataIterator,
+    from_items,
+    from_numpy,
+    range as range_,
+    range_tensor,
+    read_csv,
+    read_json,
+    read_parquet,
+    read_text,
+)
+
+# reference-compatible module-level names
+range = range_  # noqa: A001 (shadows builtin deliberately, like ray.data.range)

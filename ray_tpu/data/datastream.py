@@ -1,0 +1,413 @@
+"""Datastream: distributed datasets of object-store blocks.
+
+Equivalent capability surface to the reference's Data library
+(`python/ray/data/datastream.py:171`, blocks `python/ray/data/block.py:259`,
+streaming executor `_internal/execution/streaming_executor.py:45`,
+streaming split `_internal/iterator/stream_split_iterator.py:41`):
+
+  - a dataset is a list of *blocks* living in the object store as ObjectRefs;
+  - transforms are lazy: a logical op list, fused into one task per block at
+    execution (the map-fusion optimization the reference's logical optimizer
+    performs);
+  - execution happens as parallel tasks over blocks; `iter_batches` streams
+    block results without materializing the whole dataset on the driver;
+  - `streaming_split(n)` hands per-worker iterators coordinated by a block-
+    assignment actor (the reference's coordinator-actor design, SURVEY §H).
+
+Blocks are columnar dicts of numpy arrays (the TPU-relevant layout: feeds
+`jax.device_put` directly) or plain row lists for generic Python data.
+"""
+
+from __future__ import annotations
+
+import builtins
+import functools
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.core.object_ref import ObjectRef
+
+Block = Union[List[Any], Dict[str, np.ndarray]]
+
+
+def _block_len(block: Block) -> int:
+    if isinstance(block, dict):
+        return len(next(iter(block.values()))) if block else 0
+    return len(block)
+
+
+def _block_rows(block: Block) -> List[Any]:
+    if isinstance(block, dict):
+        keys = list(block)
+        return [{k: block[k][i] for k in keys}
+                for i in builtins.range(_block_len(block))]
+    return list(block)
+
+
+def _rows_to_block(rows: List[Any]) -> Block:
+    if rows and isinstance(rows[0], dict) and all(
+            isinstance(v, (int, float, np.number, np.ndarray)) for v in rows[0].values()):
+        keys = list(rows[0])
+        try:
+            return {k: np.asarray([r[k] for r in rows]) for k in keys}
+        except Exception:
+            return rows
+    return rows
+
+
+def _concat_blocks(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if _block_len(b) > 0]
+    if not blocks:
+        return []
+    if all(isinstance(b, dict) for b in blocks):
+        keys = list(blocks[0])
+        return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+    rows: List[Any] = []
+    for b in blocks:
+        rows.extend(_block_rows(b))
+    return rows
+
+
+def _slice_block(block: Block, start: int, end: int) -> Block:
+    if isinstance(block, dict):
+        return {k: v[start:end] for k, v in block.items()}
+    return block[start:end]
+
+
+# ------------------------------------------------------------------ ops
+
+
+def _apply_ops(block: Block, ops: List[tuple]) -> Block:
+    """Run the fused op chain on one block (executes inside a task)."""
+    for op in ops:
+        kind = op[0]
+        if kind == "map_batches":
+            fn = op[1]
+            if isinstance(block, list):
+                block = fn(_rows_to_block(block))
+            else:
+                block = fn(block)
+        elif kind == "map":
+            fn = op[1]
+            block = _rows_to_block([fn(r) for r in _block_rows(block)])
+        elif kind == "flat_map":
+            fn = op[1]
+            out: List[Any] = []
+            for r in _block_rows(block):
+                out.extend(fn(r))
+            block = _rows_to_block(out)
+        elif kind == "filter":
+            fn = op[1]
+            block = _rows_to_block([r for r in _block_rows(block) if fn(r)])
+    return block
+
+
+@ray_tpu.remote
+def _exec_block(block_or_ref, ops: List[tuple]) -> Block:
+    return _apply_ops(block_or_ref, ops)
+
+
+class Datastream:
+    """A lazy, distributed dataset. (alias: Dataset)"""
+
+    def __init__(self, block_refs: List[ObjectRef], ops: Optional[List[tuple]] = None):
+        self._block_refs = list(block_refs)
+        self._ops: List[tuple] = list(ops or [])
+
+    # ---------------------------------------------------------- transforms
+    def map(self, fn: Callable[[Any], Any]) -> "Datastream":
+        return Datastream(self._block_refs, self._ops + [("map", fn)])
+
+    def map_batches(self, fn: Callable[[Block], Block], *,
+                    batch_format: str = "numpy") -> "Datastream":
+        return Datastream(self._block_refs, self._ops + [("map_batches", fn)])
+
+    def flat_map(self, fn: Callable[[Any], Iterable[Any]]) -> "Datastream":
+        return Datastream(self._block_refs, self._ops + [("flat_map", fn)])
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Datastream":
+        return Datastream(self._block_refs, self._ops + [("filter", fn)])
+
+    def repartition(self, num_blocks: int) -> "Datastream":
+        ds = self.materialize()
+        blocks = ray_tpu.get(ds._block_refs)
+        whole = _concat_blocks(blocks)
+        n = _block_len(whole)
+        per = max(1, -(-n // num_blocks))
+        new_refs = [ray_tpu.put(_slice_block(whole, i * per, min((i + 1) * per, n)))
+                    for i in builtins.range(num_blocks) if i * per < n or i == 0]
+        return Datastream(new_refs)
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Datastream":
+        ds = self.materialize()
+        blocks = ray_tpu.get(ds._block_refs)
+        rows: List[Any] = []
+        for b in blocks:
+            rows.extend(_block_rows(b))
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(len(rows))
+        rows = [rows[i] for i in idx]
+        nb = max(1, len(ds._block_refs))
+        per = max(1, -(-len(rows) // nb))
+        refs = [ray_tpu.put(_rows_to_block(rows[i:i + per]))
+                for i in builtins.range(0, max(len(rows), 1), per)]
+        return Datastream(refs)
+
+    def union(self, other: "Datastream") -> "Datastream":
+        a, b = self.materialize(), other.materialize()
+        return Datastream(a._block_refs + b._block_refs)
+
+    # ----------------------------------------------------------- execution
+    def materialize(self) -> "Datastream":
+        if not self._ops:
+            return self
+        refs = [_exec_block.remote(r, self._ops) for r in self._block_refs]
+        return Datastream(refs)
+
+    def _executed_refs(self) -> List[ObjectRef]:
+        return self.materialize()._block_refs
+
+    # ----------------------------------------------------------- consumers
+    def count(self) -> int:
+        return sum(_block_len(b) for b in ray_tpu.get(self._executed_refs()))
+
+    def take(self, limit: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for ref in self._executed_refs():
+            out.extend(_block_rows(ray_tpu.get(ref)))
+            if len(out) >= limit:
+                break
+        return out[:limit]
+
+    def take_all(self) -> List[Any]:
+        out: List[Any] = []
+        for ref in self._executed_refs():
+            out.extend(_block_rows(ray_tpu.get(ref)))
+        return out
+
+    def schema(self) -> Optional[Dict[str, Any]]:
+        for ref in self._executed_refs():
+            b = ray_tpu.get(ref)
+            if _block_len(b):
+                if isinstance(b, dict):
+                    return {k: v.dtype for k, v in b.items()}
+                r = _block_rows(b)[0]
+                return {k: type(v) for k, v in r.items()} if isinstance(r, dict) else {
+                    "value": type(r)}
+        return None
+
+    def num_blocks(self) -> int:
+        return len(self._block_refs)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for ref in self._executed_refs():
+            yield from _block_rows(ray_tpu.get(ref))
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Block]:
+        """Stream batches; blocks execute as tasks ahead of consumption."""
+        refs = self._executed_refs()
+        carry: Optional[Block] = None
+        for ref in refs:
+            block = ray_tpu.get(ref)
+            if carry is not None:
+                block = _concat_blocks([carry, block])
+                carry = None
+            n = _block_len(block)
+            i = 0
+            while n - i >= batch_size:
+                yield _slice_block(block, i, i + batch_size)
+                i += batch_size
+            if i < n:
+                carry = _slice_block(block, i, n)
+        if carry is not None and not drop_last:
+            yield carry
+
+    def split(self, n: int, *, equal: bool = False) -> List["Datastream"]:
+        refs = self._executed_refs()
+        if equal:
+            blocks = ray_tpu.get(refs)
+            whole = _concat_blocks(blocks)
+            total = _block_len(whole)
+            per = total // n
+            return [Datastream([ray_tpu.put(_slice_block(whole, i * per, (i + 1) * per))])
+                    for i in builtins.range(n)]
+        out: List[List[ObjectRef]] = [[] for _ in builtins.range(n)]
+        for i, r in enumerate(refs):
+            out[i % n].append(r)
+        return [Datastream(r) for r in out]
+
+    def streaming_split(self, n: int, *, equal: bool = True) -> List["DataIterator"]:
+        """Per-consumer iterators fed by a coordinator actor (SURVEY §H)."""
+        refs = self._executed_refs()
+        coord = _SplitCoordinator.options(num_cpus=0).remote(
+            [r for r in refs], n)
+        return [DataIterator(coord, i) for i in builtins.range(n)]
+
+    def __repr__(self):
+        return (f"Datastream(num_blocks={len(self._block_refs)}, "
+                f"pending_ops={len(self._ops)})")
+
+
+Dataset = Datastream  # the reference renamed Dataset->Datastream in this era
+
+
+@ray_tpu.remote
+class _SplitCoordinator:
+    """Serves block refs round-robin to n consumers, epoch-synchronized."""
+
+    def __init__(self, refs: List[ObjectRef], n: int):
+        self.refs = refs
+        self.n = n
+        self.epoch_positions: Dict[int, int] = {}
+
+    def next_block(self, consumer: int):
+        pos = self.epoch_positions.get(consumer, consumer)
+        if pos >= len(self.refs):
+            return None
+        self.epoch_positions[consumer] = pos + self.n
+        return self.refs[pos]
+
+    def reset(self, consumer: int):
+        self.epoch_positions[consumer] = consumer
+        return True
+
+
+class DataIterator:
+    """Per-worker view of a streaming split (cf. reference DataIterator)."""
+
+    def __init__(self, coordinator, index: int):
+        self._coord = coordinator
+        self._index = index
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     drop_last: bool = False) -> Iterator[Block]:
+        ray_tpu.get(self._coord.reset.remote(self._index))
+        carry: Optional[Block] = None
+        while True:
+            ref = ray_tpu.get(self._coord.next_block.remote(self._index))
+            if ref is None:
+                break
+            block = ray_tpu.get(ref)
+            if carry is not None:
+                block = _concat_blocks([carry, block])
+                carry = None
+            n = _block_len(block)
+            i = 0
+            while n - i >= batch_size:
+                yield _slice_block(block, i, i + batch_size)
+                i += batch_size
+            if i < n:
+                carry = _slice_block(block, i, n)
+        if carry is not None and not drop_last:
+            yield carry
+
+    def iter_rows(self) -> Iterator[Any]:
+        for batch in self.iter_batches(batch_size=256):
+            yield from _block_rows(batch)
+
+    def __reduce__(self):
+        return (DataIterator, (self._coord, self._index))
+
+
+# ------------------------------------------------------------ constructors
+
+
+def from_items(items: List[Any], *, parallelism: int = 8) -> Datastream:
+    n = max(1, min(parallelism, len(items) or 1))
+    per = -(-len(items) // n) if items else 1
+    refs = [ray_tpu.put(_rows_to_block(items[i:i + per]))
+            for i in builtins.range(0, max(len(items), 1), per)]
+    return Datastream(refs)
+
+
+def range(n: int, *, parallelism: int = 8) -> Datastream:  # noqa: A001
+    per = -(-n // parallelism) if n else 1
+    refs = []
+    for start in builtins.range(0, max(n, 1), per):
+        end = min(start + per, n)
+        refs.append(ray_tpu.put({"id": np.arange(start, end)}))
+    return Datastream(refs)
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = 8) -> Datastream:
+    per = -(-n // parallelism) if n else 1
+    refs = []
+    for start in builtins.range(0, max(n, 1), per):
+        end = min(start + per, n)
+        ids = np.arange(start, end)
+        data = np.broadcast_to(ids.reshape(-1, *([1] * len(shape))),
+                               (end - start, *shape)).copy()
+        refs.append(ray_tpu.put({"data": data}))
+    return Datastream(refs)
+
+
+def from_numpy(arrays: Union[np.ndarray, Dict[str, np.ndarray]],
+               *, parallelism: int = 8) -> Datastream:
+    if isinstance(arrays, np.ndarray):
+        arrays = {"data": arrays}
+    n = len(next(iter(arrays.values())))
+    per = -(-n // parallelism) if n else 1
+    refs = []
+    for start in builtins.range(0, max(n, 1), per):
+        end = min(start + per, n)
+        refs.append(ray_tpu.put({k: v[start:end] for k, v in arrays.items()}))
+    return Datastream(refs)
+
+
+def read_text(paths: Union[str, List[str]]) -> Datastream:
+    paths = [paths] if isinstance(paths, str) else list(paths)
+
+    @ray_tpu.remote
+    def load(path: str) -> Block:
+        with open(path) as f:
+            return [{"text": line.rstrip("\n")} for line in f]
+
+    return Datastream([load.remote(p) for p in paths])
+
+
+def read_json(paths: Union[str, List[str]]) -> Datastream:
+    paths = [paths] if isinstance(paths, str) else list(paths)
+
+    @ray_tpu.remote
+    def load(path: str) -> Block:
+        import json
+
+        rows = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+        return _rows_to_block(rows)
+
+    return Datastream([load.remote(p) for p in paths])
+
+
+def read_csv(paths: Union[str, List[str]]) -> Datastream:
+    paths = [paths] if isinstance(paths, str) else list(paths)
+
+    @ray_tpu.remote
+    def load(path: str) -> Block:
+        import csv
+
+        with open(path) as f:
+            return _rows_to_block([dict(r) for r in csv.DictReader(f)])
+
+    return Datastream([load.remote(p) for p in paths])
+
+
+def read_parquet(paths: Union[str, List[str]]) -> Datastream:
+    paths = [paths] if isinstance(paths, str) else list(paths)
+
+    @ray_tpu.remote
+    def load(path: str) -> Block:
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(path)
+        return {c: table[c].to_numpy() for c in table.column_names}
+
+    return Datastream([load.remote(p) for p in paths])

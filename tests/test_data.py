@@ -1,0 +1,84 @@
+"""Data library tests (cf. reference python/ray/data/tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+def test_range_count_take(ray_start_regular):
+    ds = rd.range(100)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+
+
+def test_map_batches_and_filter(ray_start_regular):
+    ds = rd.range(100).map_batches(lambda b: {"id": b["id"] * 2})
+    ds = ds.filter(lambda r: r["id"] % 4 == 0)
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == [i * 2 for i in range(100) if (i * 2) % 4 == 0]
+
+
+def test_map_and_flat_map(ray_start_regular):
+    ds = rd.from_items([1, 2, 3]).map(lambda x: x + 1)
+    assert sorted(ds.take_all()) == [2, 3, 4]
+    ds2 = rd.from_items([1, 2]).flat_map(lambda x: [x, x * 10])
+    assert sorted(ds2.take_all()) == [1, 2, 10, 20]
+
+
+def test_iter_batches_sizes(ray_start_regular):
+    ds = rd.range(50)
+    batches = list(ds.iter_batches(batch_size=16))
+    sizes = [len(b["id"]) for b in batches]
+    assert sum(sizes) == 50
+    assert all(s == 16 for s in sizes[:-1])
+
+
+def test_repartition_and_shuffle(ray_start_regular):
+    ds = rd.range(40, parallelism=4).repartition(8)
+    assert ds.num_blocks() == 8
+    assert ds.count() == 40
+    shuffled = rd.range(40).random_shuffle(seed=0)
+    vals = [r["id"] for r in shuffled.take_all()]
+    assert sorted(vals) == list(range(40))
+    assert vals != list(range(40))
+
+
+def test_split_equal(ray_start_regular):
+    parts = rd.range(30).split(3, equal=True)
+    counts = [p.count() for p in parts]
+    assert counts == [10, 10, 10]
+
+
+def test_streaming_split_disjoint_and_complete(ray_start_regular):
+    ds = rd.range(40, parallelism=8)
+    its = ds.streaming_split(2)
+    seen = [[], []]
+    for i, it in enumerate(its):
+        for batch in it.iter_batches(batch_size=100):
+            seen[i].extend(batch["id"].tolist())
+    assert sorted(seen[0] + seen[1]) == list(range(40))
+    assert not (set(seen[0]) & set(seen[1]))
+
+
+def test_read_text_json_csv(ray_start_regular, tmp_path):
+    p = tmp_path / "f.txt"
+    p.write_text("a\nb\nc\n")
+    assert rd.read_text(str(p)).count() == 3
+
+    j = tmp_path / "f.jsonl"
+    j.write_text('{"x": 1}\n{"x": 2}\n')
+    assert sorted(r["x"] for r in rd.read_json(str(j)).take_all()) == [1, 2]
+
+    c = tmp_path / "f.csv"
+    c.write_text("a,b\n1,2\n3,4\n")
+    rows = rd.read_csv(str(c)).take_all()
+    assert rows[0]["a"] == "1"
+
+
+def test_from_numpy_schema(ray_start_regular):
+    ds = rd.from_numpy({"x": np.arange(10, dtype=np.float32)})
+    schema = ds.schema()
+    assert schema["x"] == np.float32
