@@ -1,0 +1,121 @@
+"""Trial schedulers: FIFO, ASHA, PBT.
+
+Reference mechanics replicated (SURVEY appendix K):
+  - ASHA (`python/ray/tune/schedulers/async_hyperband.py:17`): rungs at
+    r, r*eta, r*eta^2, ...; at each rung keep the top 1/eta of recorded
+    results and stop trials below the cutoff (`on_trial_result:138`).
+  - PBT (`python/ray/tune/schedulers/pbt.py`): every perturbation_interval,
+    bottom-quantile trials exploit (clone a top-quantile trial's checkpoint)
+    then explore (mutate hyperparams; `_explore:48`): both rest on the
+    Trainable save/restore contract, which the trial actor provides.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_trial_result(self, runner, trial, result) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4, time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.rf = reduction_factor
+        # rung milestones: grace, grace*rf, grace*rf^2 ... < max_t
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        self._rung_results: Dict[int, List[float]] = {r: [] for r in self.rungs}
+
+    def _val(self, result) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        t = int(result.get(self.time_attr, 0))
+        if t >= self.max_t:
+            return STOP
+        for rung in reversed(self.rungs):
+            if t >= rung and rung not in trial.rungs_done:
+                trial.rungs_done.add(rung)
+                v = self._val(result)
+                recorded = self._rung_results[rung]
+                recorded.append(v)
+                if len(recorded) >= self.rf:
+                    cutoff = sorted(recorded, reverse=True)[
+                        max(0, len(recorded) // self.rf - 1)]
+                    if v < cutoff:
+                        return STOP
+                break
+        return CONTINUE
+
+
+class PopulationBasedTraining:
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25, seed: int = 0,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.time_attr = time_attr
+        self._rng = random.Random(seed)
+
+    def _score(self, trial) -> float:
+        v = trial.last_result.get(self.metric)
+        if v is None:
+            return float("-inf")
+        return float(v) if self.mode == "max" else -float(v)
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        t = int(result.get(self.time_attr, 0))
+        if t - trial.last_perturb < self.interval:
+            return CONTINUE
+        trial.last_perturb = t
+        trials = [tr for tr in runner.trials if tr.last_result]
+        if len(trials) < 2:
+            return CONTINUE
+        ranked = sorted(trials, key=self._score, reverse=True)
+        k = max(1, int(len(ranked) * self.quantile))
+        top, bottom = ranked[:k], ranked[-k:]
+        if trial in bottom and trial not in top:
+            donor = self._rng.choice(top)
+            new_config = self._explore(dict(donor.config))
+            runner.exploit(trial, donor, new_config)
+        return CONTINUE
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        """Mutate each listed hyperparam (reference pbt.py `_explore:48`):
+        resample from a domain/list, or scale numeric values by 0.8/1.2."""
+        from ray_tpu.tune.search import Domain
+
+        for key, spec in self.mutations.items():
+            if key not in config:
+                continue
+            if isinstance(spec, Domain):
+                config[key] = spec.sample(self._rng)
+            elif isinstance(spec, list):
+                config[key] = self._rng.choice(spec)
+            elif callable(spec):
+                config[key] = spec()
+            else:
+                factor = self._rng.choice([0.8, 1.2])
+                config[key] = type(config[key])(config[key] * factor)
+        return config

@@ -1,0 +1,261 @@
+"""Tuner + TrialRunner: experiment execution over trial actors.
+
+Mirrors the reference's anatomy (`python/ray/tune/tuner.py:53,340` ->
+`TrialRunner.step` loop `execution/trial_runner.py:1178,1355` ->
+`RayTrialExecutor` launching each trial as an actor). Each trial is a
+`_TrialActor` running the user function with a tune session; the runner
+polls `next_result` futures, feeds results to the scheduler, and stops /
+exploits trials per its decisions. PBT exploit = save donor checkpoint,
+kill the trial actor, restart it with the mutated config and the donor's
+checkpoint — exactly the Trainable save/restore contract the reference's
+schedulers rely on (SURVEY §K).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as _queue
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.result import Result
+from ray_tpu.tune import session as tune_session
+from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
+from ray_tpu.tune.search import generate_configs
+
+logger = logging.getLogger(__name__)
+
+
+@ray_tpu.remote
+class _TrialActor:
+    """Hosts one trial; the user function runs on a private thread and its
+    reports stream out through `next_result` (max_concurrency=2 so control
+    calls interleave with the blocking poll)."""
+
+    def __init__(self, fn: Callable, config: Dict[str, Any],
+                 checkpoint: Optional[Checkpoint]):
+        self._fn = fn
+        self._config = config
+        self._reports: "_queue.Queue" = _queue.Queue()
+        self._last_checkpoint = checkpoint
+        self._iteration = 0
+        self._done = False
+        self._error: Optional[str] = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        def report_fn(metrics, ckpt):
+            if ckpt is not None:
+                self._last_checkpoint = ckpt
+            self._iteration += 1
+            m = dict(metrics)
+            m["training_iteration"] = self._iteration
+            self._reports.put(m)
+
+        tune_session._set(report_fn, self._last_checkpoint)
+        try:
+            self._fn(self._config)
+        except Exception:
+            self._error = traceback.format_exc()
+        finally:
+            tune_session._clear()
+            self._done = True
+            self._reports.put(None)  # sentinel
+
+    def next_result(self):
+        item = self._reports.get()
+        if item is None:
+            return {"__done__": True, "__error__": self._error}
+        return item
+
+    def save(self):
+        return self._last_checkpoint
+
+    def config(self):
+        return self._config
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    state: str = "PENDING"            # PENDING/RUNNING/TERMINATED/ERROR
+    actor: Any = None
+    pending: Any = None               # in-flight next_result ref
+    last_result: Dict[str, Any] = field(default_factory=dict)
+    last_checkpoint: Optional[Checkpoint] = None
+    error: Optional[str] = None
+    rungs_done: Set[int] = field(default_factory=set)   # ASHA bookkeeping
+    last_perturb: int = 0                               # PBT bookkeeping
+    history: List[Dict[str, Any]] = field(default_factory=list)
+
+
+@dataclass
+class TuneConfig:
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    metric: str = "score"
+    mode: str = "max"
+    scheduler: Any = None
+    resources_per_trial: Optional[Dict[str, float]] = None
+    seed: int = 0
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result]):
+        self._results = results
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: str = "max") -> Result:
+        metric = metric or "score"
+        scored = [r for r in self._results if metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric '{metric}'")
+        return (max if mode == "max" else min)(
+            scored, key=lambda r: r.metrics[metric])
+
+    @property
+    def errors(self):
+        return [r.error for r in self._results if r.error]
+
+
+class TrialRunner:
+    def __init__(self, fn: Callable, configs: List[Dict[str, Any]],
+                 tune_config: TuneConfig):
+        self.fn = fn
+        self.trials = [Trial(trial_id=f"trial_{i:05d}", config=c)
+                       for i, c in enumerate(configs)]
+        self.cfg = tune_config
+        self.scheduler = tune_config.scheduler or FIFOScheduler()
+
+    # ----------------------------------------------------------- lifecycle
+    def _start_trial(self, trial: Trial,
+                     checkpoint: Optional[Checkpoint] = None) -> None:
+        opts = {"max_concurrency": 2}
+        if self.cfg.resources_per_trial:
+            opts["resources"] = dict(self.cfg.resources_per_trial)
+        else:
+            opts["num_cpus"] = 1
+        trial.actor = _TrialActor.options(**opts).remote(
+            self.fn, trial.config, checkpoint or trial.last_checkpoint)
+        trial.state = "RUNNING"
+        trial.pending = trial.actor.next_result.remote()
+
+    def _stop_trial(self, trial: Trial, state: str = "TERMINATED") -> None:
+        trial.state = state
+        trial.pending = None
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+
+    def exploit(self, trial: Trial, donor: Trial, new_config: Dict[str, Any]) -> None:
+        """PBT: clone donor's checkpoint into `trial` and restart it with the
+        mutated config."""
+        try:
+            ckpt = ray_tpu.get(donor.actor.save.remote(), timeout=30) \
+                if donor.actor is not None else donor.last_checkpoint
+        except Exception:
+            ckpt = donor.last_checkpoint
+        logger.info("PBT exploit: %s <- %s", trial.trial_id, donor.trial_id)
+        self._stop_trial(trial, state="PENDING")
+        trial.config = new_config
+        trial.last_checkpoint = ckpt
+        trial.rungs_done = set()
+
+    # ----------------------------------------------------------- main loop
+    def run(self) -> None:
+        while True:
+            running = [t for t in self.trials if t.state == "RUNNING"]
+            pending = [t for t in self.trials if t.state == "PENDING"]
+            if not running and not pending:
+                return
+            while pending and len(running) < self.cfg.max_concurrent_trials:
+                t = pending.pop(0)
+                self._start_trial(t)
+                running.append(t)
+            refs = [t.pending for t in running if t.pending is not None]
+            if not refs:
+                time.sleep(0.02)
+                continue
+            done, _ = ray_tpu.wait(refs, num_returns=1, timeout=1.0)
+            for ref in done:
+                trial = next(t for t in running if t.pending == ref)
+                self._process(trial, ref)
+
+    def _process(self, trial: Trial, ref) -> None:
+        try:
+            result = ray_tpu.get(ref)
+        except Exception as e:
+            trial.error = str(e)
+            self._stop_trial(trial, "ERROR")
+            return
+        if result.get("__done__"):
+            if result.get("__error__"):
+                trial.error = result["__error__"]
+                self._stop_trial(trial, "ERROR")
+            else:
+                self._finalize_checkpoint(trial)
+                self._stop_trial(trial, "TERMINATED")
+            return
+        trial.last_result = result
+        trial.history.append(result)
+        decision = self.scheduler.on_trial_result(self, trial, result)
+        if trial.state != "RUNNING":
+            return  # scheduler exploited/restarted this trial
+        if decision == STOP:
+            self._finalize_checkpoint(trial)
+            self._stop_trial(trial, "TERMINATED")
+        else:
+            trial.pending = trial.actor.next_result.remote()
+
+    def _finalize_checkpoint(self, trial: Trial) -> None:
+        if trial.actor is not None:
+            try:
+                ckpt = ray_tpu.get(trial.actor.save.remote(), timeout=30)
+                if ckpt is not None:
+                    trial.last_checkpoint = ckpt
+            except Exception:
+                pass
+
+
+class Tuner:
+    """`Tuner(trainable, param_space=..., tune_config=...).fit()`
+    (reference `python/ray/tune/tuner.py:53`)."""
+
+    def __init__(self, trainable: Callable, *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config=None):
+        self._fn = trainable
+        self._space = dict(param_space or {})
+        self._cfg = tune_config or TuneConfig()
+        self._run_config = run_config
+
+    def fit(self) -> ResultGrid:
+        configs = generate_configs(self._space, self._cfg.num_samples,
+                                   self._cfg.seed)
+        runner = TrialRunner(self._fn, configs, self._cfg)
+        runner.run()
+        results = []
+        for t in runner.trials:
+            err = RuntimeError(t.error) if t.error else None
+            metrics = dict(t.last_result)
+            metrics["config"] = t.config
+            results.append(Result(metrics=metrics, checkpoint=t.last_checkpoint,
+                                  error=err, metrics_history=t.history))
+        return results and ResultGrid(results) or ResultGrid([])

@@ -1,0 +1,106 @@
+"""Tune tests: grid/random search, ASHA early stopping, PBT exploit."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+def test_grid_search_runs_all(ray_start_regular):
+    def trainable(config):
+        tune.report({"score": config["x"] * 10})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=tune.TuneConfig(num_samples=1, max_concurrent_trials=3),
+    )
+    results = tuner.fit()
+    assert len(results) == 3
+    best = results.get_best_result("score", "max")
+    assert best.metrics["score"] == 30
+    assert best.metrics["config"]["x"] == 3
+
+
+def test_random_search_distributions(ray_start_regular):
+    def trainable(config):
+        tune.report({"score": config["lr"]})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.loguniform(1e-4, 1e-1)},
+        tune_config=tune.TuneConfig(num_samples=4, max_concurrent_trials=4),
+    )
+    results = tuner.fit()
+    assert len(results) == 4
+    for r in results:
+        assert 1e-4 <= r.metrics["score"] <= 1e-1
+
+
+def test_trial_error_isolated(ray_start_regular):
+    def trainable(config):
+        if config["x"] == 1:
+            raise ValueError("bad trial")
+        tune.report({"score": config["x"]})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([0, 1, 2])},
+        tune_config=tune.TuneConfig(max_concurrent_trials=3),
+    )
+    results = tuner.fit()
+    assert len(results.errors) == 1
+    best = results.get_best_result("score", "max")
+    assert best.metrics["score"] == 2
+
+
+def test_asha_stops_bad_trials(ray_start_regular):
+    def trainable(config):
+        for step in range(20):
+            tune.report({"score": config["q"] * (step + 1)})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(
+            max_concurrent_trials=4,
+            scheduler=tune.ASHAScheduler(
+                metric="score", mode="max", max_t=20,
+                grace_period=2, reduction_factor=2),
+        ),
+    )
+    results = tuner.fit()
+    iters = [r.metrics.get("training_iteration", 0) for r in results]
+    # the best trial runs longest; at least one trial was cut early
+    assert max(iters) >= 10
+    assert min(iters) < 20
+
+
+def test_pbt_exploits_checkpoints(ray_start_regular):
+    def trainable(config):
+        ckpt = tune.get_checkpoint()
+        score = ckpt.to_dict()["score"] if ckpt else 0.0
+        for _ in range(12):
+            score += config["lr"]
+            tune.report({"score": score},
+                        checkpoint=Checkpoint.from_dict({"score": score}))
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.01, 1.0])},
+        tune_config=tune.TuneConfig(
+            max_concurrent_trials=2,
+            scheduler=tune.PopulationBasedTraining(
+                metric="score", mode="max", perturbation_interval=3,
+                quantile_fraction=0.5,
+                hyperparam_mutations={"lr": [0.5, 1.0, 2.0]}),
+        ),
+    )
+    results = tuner.fit()
+    assert not results.errors
+    # the weak trial (lr=0.01) must have been lifted by exploiting the
+    # strong trial's checkpoint
+    scores = sorted(r.metrics["score"] for r in results)
+    assert scores[0] > 0.12 * 2  # far above what lr=0.01 alone achieves
