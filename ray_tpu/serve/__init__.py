@@ -1,0 +1,9 @@
+from ray_tpu.serve.api import (
+    deployment,
+    run,
+    shutdown,
+    get_deployment_handle,
+    start_http_proxy,
+    Deployment,
+    DeploymentHandle,
+)

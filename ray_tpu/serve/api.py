@@ -1,0 +1,417 @@
+"""Serve: model serving on actors.
+
+Mirrors the reference's anatomy (SURVEY §3.5): a detached ServeController
+actor (`python/ray/serve/controller.py:73`) reconciles per-deployment target
+replica counts into replica actors (`_private/deployment_state.py:1009`);
+handles route requests with power-of-two-choices over client-tracked
+in-flight counts (`_private/router.py:263,224`); replicas report queue
+lengths and a queue-based policy autoscales within [min,max]
+(`_private/autoscaling_policy.py:127`); config updates reach handles via
+versioned long-polls (`_private/long_poll.py`). The HTTP ingress is a
+proxy actor running a stdlib threading HTTP server (the reference uses
+uvicorn/Starlette — an external dep this build avoids).
+
+TPU twist: a deployment may set `resources={"TPU": n}` so replicas pin to
+chips/slices; model weights travel to replicas through the object store.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "_serve_controller"
+
+
+@dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_num_ongoing_requests_per_replica: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 5.0
+
+
+@ray_tpu.remote
+class _ReplicaActor:
+    def __init__(self, def_blob: bytes, init_args, init_kwargs):
+        target = cloudpickle.loads(def_blob)
+        if isinstance(target, type):
+            self._callable = target(*init_args, **(init_kwargs or {}))
+        else:
+            self._callable = target
+        self._inflight = 0
+
+    def handle_request(self, method_name: str, args, kwargs):
+        self._inflight += 1
+        try:
+            # function deployments and class __call__ both route through the
+            # callable itself; named methods are looked up on the instance
+            fn = (self._callable if method_name == "__call__"
+                  else getattr(self._callable, method_name))
+            return fn(*args, **(kwargs or {}))
+        finally:
+            self._inflight -= 1
+
+    def queue_len(self) -> int:
+        return self._inflight
+
+    def health(self) -> bool:
+        return True
+
+
+@ray_tpu.remote
+class ServeController:
+    """Reconciles deployment target state into replica actors."""
+
+    def __init__(self):
+        self._deployments: Dict[str, dict] = {}
+        self._replicas: Dict[str, List[Any]] = {}
+        self._versions: Dict[str, int] = {}
+        self._shutdown = False
+        self._thread = threading.Thread(target=self._reconcile_loop, daemon=True)
+        self._thread.start()
+
+    # -------------------------------------------------------------- deploy
+    def deploy(self, name: str, def_blob: bytes, init_args, init_kwargs,
+               num_replicas: int, actor_options: Optional[dict],
+               autoscaling: Optional[AutoscalingConfig], max_concurrency: int):
+        if name in self._deployments:
+            # redeploy: tear down old-version replicas; reconcile recreates
+            # them from the new definition (rolling updates are round-2)
+            for r in self._replicas.pop(name, []):
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+            self._versions[name] = self._versions.get(name, 0) + 1
+        self._deployments[name] = {
+            "def_blob": def_blob,
+            "init_args": init_args,
+            "init_kwargs": init_kwargs,
+            "target": num_replicas if autoscaling is None else autoscaling.min_replicas,
+            "actor_options": dict(actor_options or {}),
+            "autoscaling": autoscaling,
+            "max_concurrency": max_concurrency,
+            "last_scale_up": 0.0,
+            "last_scale_down": 0.0,
+        }
+        self._reconcile_one(name)
+        return True
+
+    def delete_deployment(self, name: str):
+        d = self._deployments.pop(name, None)
+        for r in self._replicas.pop(name, []):
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self._versions[name] = self._versions.get(name, 0) + 1
+        return d is not None
+
+    def shutdown(self):
+        self._shutdown = True
+        for name in list(self._deployments):
+            self.delete_deployment(name)
+        return True
+
+    # ----------------------------------------------------------- discovery
+    def get_replicas(self, name: str, known_version: int = -1,
+                     timeout_s: float = 2.0):
+        """Versioned long-poll (reference LongPollHost, long_poll.py:186)."""
+        deadline = time.monotonic() + timeout_s
+        while (self._versions.get(name, 0) == known_version
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        return {
+            "version": self._versions.get(name, 0),
+            "replicas": list(self._replicas.get(name, [])),
+        }
+
+    def list_deployments(self):
+        return {
+            name: {"target": d["target"],
+                   "replicas": len(self._replicas.get(name, []))}
+            for name, d in self._deployments.items()
+        }
+
+    # ----------------------------------------------------------- reconcile
+    def _reconcile_loop(self):
+        while not self._shutdown:
+            time.sleep(0.25)
+            try:
+                for name in list(self._deployments):
+                    self._autoscale(name)
+                    self._reconcile_one(name)
+            except Exception:
+                logger.exception("reconcile failed")
+
+    def _reconcile_one(self, name: str):
+        d = self._deployments.get(name)
+        if d is None:
+            return
+        replicas = self._replicas.setdefault(name, [])
+        changed = False
+        while len(replicas) < d["target"]:
+            opts = dict(d["actor_options"])
+            opts["max_concurrency"] = max(d["max_concurrency"], 4)
+            replica = _ReplicaActor.options(**opts).remote(
+                d["def_blob"], d["init_args"], d["init_kwargs"])
+            replicas.append(replica)
+            changed = True
+        while len(replicas) > d["target"]:
+            r = replicas.pop()
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+            changed = True
+        if changed:
+            self._versions[name] = self._versions.get(name, 0) + 1
+
+    def _autoscale(self, name: str):
+        """Queue-length-driven scaling (reference autoscaling_policy.py:127)."""
+        d = self._deployments.get(name)
+        if d is None or d["autoscaling"] is None:
+            return
+        cfg: AutoscalingConfig = d["autoscaling"]
+        replicas = self._replicas.get(name, [])
+        if not replicas:
+            return
+        try:
+            qlens = ray_tpu.get(
+                [r.queue_len.remote() for r in replicas], timeout=5)
+        except Exception:
+            return
+        total = sum(qlens)
+        desired = max(
+            cfg.min_replicas,
+            min(cfg.max_replicas,
+                int(-(-total // max(cfg.target_num_ongoing_requests_per_replica, 1e-9)))
+                or cfg.min_replicas))
+        now = time.monotonic()
+        if desired > d["target"] and now - d["last_scale_up"] > cfg.upscale_delay_s:
+            d["target"] = desired
+            d["last_scale_up"] = now
+        elif desired < d["target"] and now - d["last_scale_down"] > cfg.downscale_delay_s:
+            d["target"] = d["target"] - 1
+            d["last_scale_down"] = now
+
+
+# ------------------------------------------------------------------ handle
+
+
+class DeploymentHandle:
+    """Routes calls to replicas: power-of-two-choices over client-side
+    in-flight counts (reference router.py:263)."""
+
+    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+        self._name = deployment_name
+        self._method = method_name
+        self._version = -1
+        self._replicas: List[Any] = []
+        self._inflight: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def _controller(self):
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+    def _refresh(self, block: bool = True):
+        deadline = time.monotonic() + 30
+        while True:
+            info = ray_tpu.get(self._controller().get_replicas.remote(
+                self._name, self._version))
+            with self._lock:
+                self._version = info["version"]
+                self._replicas = info["replicas"]
+                if self._replicas or not block or time.monotonic() > deadline:
+                    return
+            time.sleep(0.1)
+
+    def options(self, method_name: str = "__call__") -> "DeploymentHandle":
+        h = DeploymentHandle(self._name, method_name)
+        return h
+
+    def remote(self, *args, **kwargs):
+        with self._lock:
+            replicas = list(self._replicas)
+        if not replicas:
+            self._refresh()
+            replicas = list(self._replicas)
+            if not replicas:
+                raise RuntimeError(f"deployment {self._name} has no replicas")
+        # power of two choices on locally-tracked in-flight counts
+        if len(replicas) == 1:
+            idx = 0
+        else:
+            a, b = random.sample(range(len(replicas)), 2)
+            idx = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+        replica = replicas[idx]
+        with self._lock:
+            self._inflight[idx] = self._inflight.get(idx, 0) + 1
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+        # decrement when result lands (best-effort, background thread)
+        def _done():
+            try:
+                ray_tpu.wait([ref], num_returns=1, timeout=300)
+            finally:
+                with self._lock:
+                    self._inflight[idx] = max(0, self._inflight.get(idx, 1) - 1)
+        threading.Thread(target=_done, daemon=True).start()
+        return ref
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._name, self._method))
+
+
+# ------------------------------------------------------------------ public
+
+
+@dataclass
+class Deployment:
+    func_or_class: Any
+    name: str
+    num_replicas: int = 1
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    max_concurrent_queries: int = 8
+    init_args: tuple = ()
+    init_kwargs: Optional[dict] = None
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        import dataclasses as dc
+
+        return dc.replace(self, init_args=args, init_kwargs=kwargs)
+
+    def options(self, **opts) -> "Deployment":
+        import dataclasses as dc
+
+        return dc.replace(self, **opts)
+
+
+def deployment(_func_or_class=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, ray_actor_options: Optional[dict] = None,
+               autoscaling_config: Optional[dict] = None,
+               max_concurrent_queries: int = 8):
+    """`@serve.deployment` (reference python/ray/serve/api.py:261)."""
+
+    def wrap(target):
+        auto = None
+        if autoscaling_config:
+            auto = AutoscalingConfig(**autoscaling_config) \
+                if isinstance(autoscaling_config, dict) else autoscaling_config
+        return Deployment(
+            func_or_class=target,
+            name=name or getattr(target, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            ray_actor_options=dict(ray_actor_options or {}),
+            autoscaling_config=auto,
+            max_concurrent_queries=max_concurrent_queries,
+        )
+
+    return wrap(_func_or_class) if _func_or_class is not None else wrap
+
+
+def _get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return ServeController.options(
+            name=CONTROLLER_NAME, num_cpus=0, max_concurrency=8).remote()
+
+
+def run(target: Deployment, *, name: str = "default") -> DeploymentHandle:
+    """Deploy and return a handle (reference serve.run, api.py:460)."""
+    controller = _get_or_create_controller()
+    ray_tpu.get(controller.deploy.remote(
+        target.name,
+        cloudpickle.dumps(target.func_or_class),
+        target.init_args,
+        target.init_kwargs,
+        target.num_replicas,
+        target.ray_actor_options,
+        target.autoscaling_config,
+        target.max_concurrent_queries,
+    ))
+    handle = DeploymentHandle(target.name)
+    handle._refresh()
+    return handle
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def shutdown() -> None:
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=30)
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+
+
+# ------------------------------------------------------------------ http
+
+
+@ray_tpu.remote
+class _HTTPProxyActor:
+    """HTTP ingress: POST /<deployment> with a JSON body -> handle call
+    (reference HTTPProxyActor, _private/http_proxy.py:250,434)."""
+
+    def __init__(self, port: int):
+        import http.server
+
+        proxy = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                name = self.path.strip("/")
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b"{}"
+                try:
+                    payload = json.loads(body) if body else {}
+                    handle = proxy._handles.setdefault(
+                        name, DeploymentHandle(name))
+                    out = ray_tpu.get(handle.remote(payload), timeout=60)
+                    data = json.dumps({"result": out}).encode()
+                    self.send_response(200)
+                except Exception as e:
+                    data = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *a):
+                pass
+
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+
+    def get_port(self) -> int:
+        return self.port
+
+
+def start_http_proxy(port: int = 0):
+    """Start the HTTP ingress actor; returns (actor_handle, port)."""
+    actor = _HTTPProxyActor.options(num_cpus=0, max_concurrency=8).remote(port)
+    return actor, ray_tpu.get(actor.get_port.remote())

@@ -1,0 +1,119 @@
+"""Serve tests: deployments, routing, scaling, HTTP ingress."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster(ray_start_regular):
+    yield ray_start_regular
+    serve.shutdown()
+
+
+def test_function_deployment(serve_cluster):
+    @serve.deployment
+    def echo(payload):
+        return {"echo": payload}
+
+    handle = serve.run(echo.bind())
+    out = ray_tpu.get(handle.remote({"x": 1}))
+    assert out == {"echo": {"x": 1}}
+
+
+def test_class_deployment_with_state(serve_cluster):
+    @serve.deployment(num_replicas=1)
+    class Model:
+        def __init__(self, scale):
+            self.scale = scale
+
+        def __call__(self, x):
+            return x * self.scale
+
+        def info(self):
+            return {"scale": self.scale}
+
+    handle = serve.run(Model.bind(3))
+    assert ray_tpu.get(handle.remote(7)) == 21
+    info_handle = handle.options(method_name="info")
+    assert ray_tpu.get(info_handle.remote()) == {"scale": 3}
+
+
+def test_multiple_replicas_balance(serve_cluster):
+    @serve.deployment(num_replicas=2)
+    class Worker:
+        def __call__(self, _):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(Worker.bind())
+    pids = set(ray_tpu.get([handle.remote(None) for _ in range(20)]))
+    assert len(pids) == 2  # both replicas served traffic
+
+
+def test_redeploy_updates(serve_cluster):
+    @serve.deployment(name="svc")
+    def v1(_):
+        return "v1"
+
+    handle = serve.run(v1.bind())
+    assert ray_tpu.get(handle.remote(None)) == "v1"
+
+    @serve.deployment(name="svc")
+    def v2(_):
+        return "v2"
+
+    handle2 = serve.run(v2.bind())
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if ray_tpu.get(handle2.remote(None)) == "v2":
+            break
+        time.sleep(0.2)
+    assert ray_tpu.get(handle2.remote(None)) == "v2"
+
+
+def test_http_proxy(serve_cluster):
+    @serve.deployment
+    def add_one(payload):
+        return payload["x"] + 1
+
+    serve.run(add_one.bind())
+    _, port = serve.start_http_proxy()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/add_one",
+        data=json.dumps({"x": 41}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        body = json.loads(resp.read())
+    assert body["result"] == 42
+
+
+def test_autoscaling_up(serve_cluster):
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_num_ongoing_requests_per_replica": 1.0,
+        "upscale_delay_s": 0.1})
+    class Slow:
+        def __call__(self, _):
+            time.sleep(1.0)
+            return "ok"
+
+    handle = serve.run(Slow.bind())
+    refs = [handle.remote(None) for _ in range(8)]  # flood the single replica
+    controller = ray_tpu.get_actor(serve.api.CONTROLLER_NAME)
+    deadline = time.time() + 20
+    scaled = False
+    while time.time() < deadline:
+        info = ray_tpu.get(controller.list_deployments.remote())
+        if info["Slow"]["target"] > 1:
+            scaled = True
+            break
+        time.sleep(0.2)
+    assert scaled, "controller never scaled up under queue pressure"
+    assert ray_tpu.get(refs, timeout=60) == ["ok"] * 8
