@@ -1,0 +1,3 @@
+from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.ppo import PPO, PPOConfig

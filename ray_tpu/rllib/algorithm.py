@@ -1,0 +1,52 @@
+"""Algorithm base: the Tune Trainable contract for RL.
+
+Mirrors the reference's `Algorithm` (rllib/algorithms/algorithm.py:149):
+`train()` runs one `training_step` iteration and returns metrics;
+save/restore expose checkpoints so Tune schedulers (ASHA/PBT) drive RL
+experiments unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+class Algorithm:
+    def __init__(self, config: Dict[str, Any]):
+        self.config = dict(config)
+        self.iteration = 0
+        self.setup(self.config)
+
+    # -- subclass hooks --
+    def setup(self, config: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def get_weights(self) -> Any:
+        raise NotImplementedError
+
+    def set_weights(self, weights: Any) -> None:
+        raise NotImplementedError
+
+    # -- Trainable contract --
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        metrics = self.training_step()
+        metrics["training_iteration"] = self.iteration
+        return metrics
+
+    def save(self) -> Checkpoint:
+        return Checkpoint.from_dict({
+            "weights": self.get_weights(), "iteration": self.iteration})
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        data = checkpoint.to_dict()
+        self.set_weights(data["weights"])
+        self.iteration = data.get("iteration", 0)
+
+    def stop(self) -> None:
+        pass

@@ -1,0 +1,85 @@
+"""Environments: a dependency-free CartPole + vectorization.
+
+The reference wraps gym (`rllib/env/vector_env.py`); this build ships a
+numpy CartPole (classic Barto-Sutton dynamics, the same the reference's CI
+learning tests train on) so the RL stack is testable with zero external
+env deps. Any object with reset()->obs / step(a)->(obs, r, done, info)
+works as an env.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+import numpy as np
+
+
+class CartPoleEnv:
+    """CartPole-v1 dynamics (max 500 steps, solved ~475)."""
+
+    GRAVITY = 9.8
+    CART_MASS = 1.0
+    POLE_MASS = 0.1
+    POLE_HALF_LEN = 0.5
+    FORCE = 10.0
+    DT = 0.02
+    THETA_LIMIT = 12 * 2 * np.pi / 360
+    X_LIMIT = 2.4
+    MAX_STEPS = 500
+
+    observation_dim = 4
+    num_actions = 2
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+        self._state = None
+        self._steps = 0
+
+    def reset(self) -> np.ndarray:
+        self._state = self._rng.uniform(-0.05, 0.05, size=4)
+        self._steps = 0
+        return self._state.astype(np.float32)
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool, dict]:
+        x, x_dot, theta, theta_dot = self._state
+        force = self.FORCE if action == 1 else -self.FORCE
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        total_mass = self.CART_MASS + self.POLE_MASS
+        polemass_length = self.POLE_MASS * self.POLE_HALF_LEN
+        temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
+        theta_acc = (self.GRAVITY * sintheta - costheta * temp) / (
+            self.POLE_HALF_LEN * (4.0 / 3.0 - self.POLE_MASS * costheta**2 / total_mass))
+        x_acc = temp - polemass_length * theta_acc * costheta / total_mass
+        x += self.DT * x_dot
+        x_dot += self.DT * x_acc
+        theta += self.DT * theta_dot
+        theta_dot += self.DT * theta_acc
+        self._state = np.array([x, x_dot, theta, theta_dot])
+        self._steps += 1
+        done = bool(
+            abs(x) > self.X_LIMIT or abs(theta) > self.THETA_LIMIT
+            or self._steps >= self.MAX_STEPS)
+        return self._state.astype(np.float32), 1.0, done, {}
+
+
+class VectorEnv:
+    """N independent env copies stepped together (reference vector_env.py)."""
+
+    def __init__(self, env_fn: Callable[[int], Any], num_envs: int, seed: int = 0):
+        self.envs = [env_fn(seed + i) for i in range(num_envs)]
+        self.num_envs = num_envs
+
+    def reset(self) -> np.ndarray:
+        return np.stack([e.reset() for e in self.envs])
+
+    def step(self, actions) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List[dict]]:
+        obs, rews, dones, infos = [], [], [], []
+        for e, a in zip(self.envs, actions):
+            o, r, d, i = e.step(int(a))
+            if d:
+                o = e.reset()
+            obs.append(o)
+            rews.append(r)
+            dones.append(d)
+            infos.append(i)
+        return np.stack(obs), np.array(rews, np.float32), np.array(dones), infos

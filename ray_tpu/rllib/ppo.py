@@ -1,0 +1,332 @@
+"""PPO: rollout-worker actor fleet + JAX learner.
+
+Mirrors the reference's PPO anatomy (SURVEY §3.6): `training_step` =
+parallel `RolloutWorker.sample` actor calls -> concat to a train batch ->
+learner update -> weight broadcast (`rllib/algorithms/algorithm.py:1336`,
+`rollout_worker.py:879`, `core/learner/learner.py:409,773`). The learner is
+TPU-native: a jitted clipped-surrogate update with minibatched SGD epochs
+(pmap/mesh-ready — the policy step is pure JAX); rollout workers run
+CPU envs as actors, exactly the reference's split of env hosts vs learner
+chips.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
+
+
+# ------------------------------------------------------------- policy model
+
+
+def init_policy_params(rng_seed: int, obs_dim: int, num_actions: int,
+                       hidden: Tuple[int, ...] = (64, 64)) -> Dict[str, Any]:
+    rng = np.random.default_rng(rng_seed)
+    sizes = (obs_dim, *hidden)
+    params: Dict[str, Any] = {}
+    for i in range(len(sizes) - 1):
+        params[f"w{i}"] = (rng.standard_normal((sizes[i], sizes[i + 1]))
+                           * np.sqrt(2.0 / sizes[i])).astype(np.float32)
+        params[f"b{i}"] = np.zeros(sizes[i + 1], np.float32)
+    params["w_pi"] = (rng.standard_normal((sizes[-1], num_actions)) * 0.01).astype(np.float32)
+    params["b_pi"] = np.zeros(num_actions, np.float32)
+    params["w_v"] = (rng.standard_normal((sizes[-1], 1)) * 1.0).astype(np.float32)
+    params["b_v"] = np.zeros(1, np.float32)
+    return params
+
+
+def policy_apply(params, obs, n_hidden: int = 2):
+    """Returns (logits, value). Works under numpy AND jax.numpy."""
+    import jax.numpy as jnp
+
+    x = obs
+    for i in range(n_hidden):
+        x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
+    logits = x @ params["w_pi"] + params["b_pi"]
+    value = (x @ params["w_v"] + params["b_v"])[..., 0]
+    return logits, value
+
+
+# ---------------------------------------------------------------- rollouts
+
+
+@ray_tpu.remote
+class RolloutWorker:
+    """Env-stepping actor (reference rollout_worker.py:166; `sample:879`)."""
+
+    def __init__(self, env_maker, num_envs: int, seed: int,
+                 obs_dim: int, num_actions: int):
+        self.vec = VectorEnv(env_maker, num_envs, seed)
+        self.obs = self.vec.reset()
+        self.rng = np.random.default_rng(seed)
+        self.params: Optional[dict] = None
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        # per-env running episode returns for metrics
+        self._ep_returns = np.zeros(num_envs, np.float32)
+        self._completed: List[float] = []
+
+    def set_weights(self, params: dict) -> bool:
+        self.params = {k: np.asarray(v) for k, v in params.items()}
+        return True
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect num_steps transitions per env; returns flat arrays plus
+        bootstrap values for GAE."""
+        assert self.params is not None, "set_weights before sample"
+        T, N = num_steps, self.vec.num_envs
+        obs_buf = np.zeros((T, N, self.obs_dim), np.float32)
+        act_buf = np.zeros((T, N), np.int32)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+        for t in range(T):
+            logits, value = policy_apply(self.params, self.obs)
+            logits = np.asarray(logits)
+            value = np.asarray(value)
+            z = logits - logits.max(-1, keepdims=True)
+            probs = np.exp(z) / np.exp(z).sum(-1, keepdims=True)
+            actions = np.array([self.rng.choice(self.num_actions, p=p) for p in probs])
+            logp = np.log(probs[np.arange(N), actions] + 1e-10)
+            obs_buf[t] = self.obs
+            act_buf[t] = actions
+            logp_buf[t] = logp
+            val_buf[t] = value
+            self.obs, rewards, dones, _ = self.vec.step(actions)
+            rew_buf[t] = rewards
+            done_buf[t] = dones
+            self._ep_returns += rewards
+            for i, d in enumerate(dones):
+                if d:
+                    self._completed.append(float(self._ep_returns[i]))
+                    self._ep_returns[i] = 0.0
+        _, last_value = policy_apply(self.params, self.obs)
+        episode_returns, self._completed = self._completed, []
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+            "values": val_buf, "rewards": rew_buf, "dones": done_buf,
+            "last_value": np.asarray(last_value),
+            "episode_returns": np.array(episode_returns, np.float32),
+        }
+
+
+def compute_gae(batch: Dict[str, np.ndarray], gamma: float, lam: float):
+    """Generalized advantage estimation over [T, N] arrays."""
+    rewards, values, dones = batch["rewards"], batch["values"], batch["dones"]
+    T, N = rewards.shape
+    adv = np.zeros((T, N), np.float32)
+    last_gae = np.zeros(N, np.float32)
+    next_value = batch["last_value"]
+    for t in reversed(range(T)):
+        nonterminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last_gae = delta + gamma * lam * nonterminal * last_gae
+        adv[t] = last_gae
+        next_value = values[t]
+    returns = adv + values
+    return adv, returns
+
+
+# ----------------------------------------------------------------- learner
+
+
+class PPOLearner:
+    """Jitted clipped-surrogate update (reference core/learner/learner.py)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, lr: float,
+                 clip: float = 0.2, vf_coeff: float = 0.5,
+                 entropy_coeff: float = 0.01, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.params = init_policy_params(seed, obs_dim, num_actions)
+        self.optimizer = optax.adam(lr)
+        self.opt_state = self.optimizer.init(self.params)
+
+        def loss_fn(params, batch):
+            logits, value = policy_apply(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=-1)[:, 0]
+            ratio = jnp.exp(logp - batch["logp"])
+            adv = batch["advantages"]
+            pg = -jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - clip, 1 + clip) * adv).mean()
+            vf = 0.5 * ((value - batch["returns"]) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pg + vf_coeff * vf - entropy_coeff * entropy
+            return total, {"policy_loss": pg, "vf_loss": vf, "entropy": entropy}
+
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        self._update = jax.jit(update)
+
+    def update_minibatches(self, flat: Dict[str, np.ndarray],
+                           num_epochs: int, minibatch_size: int,
+                           rng: np.random.Generator) -> Dict[str, float]:
+        n = len(flat["obs"])
+        stats = {}
+        for _ in range(num_epochs):
+            idx = rng.permutation(n)
+            for start in range(0, n, minibatch_size):
+                mb = {k: v[idx[start:start + minibatch_size]] for k, v in flat.items()}
+                self.params, self.opt_state, stats = self._update(
+                    self.params, self.opt_state, mb)
+        import jax
+
+        return {k: float(v) for k, v in jax.device_get(stats).items()}
+
+    def get_weights(self):
+        import jax
+
+        return {k: np.asarray(v) for k, v in jax.device_get(self.params).items()}
+
+    def set_weights(self, weights):
+        import jax.numpy as jnp
+
+        self.params = {k: jnp.asarray(v) for k, v in weights.items()}
+        self.opt_state = self.optimizer.init(self.params)
+
+
+# --------------------------------------------------------------- algorithm
+
+
+class PPOConfig:
+    """Builder-pattern config (reference rllib/algorithms/ppo/ppo.py)."""
+
+    def __init__(self):
+        self.env_maker: Callable[[int], Any] = lambda seed: CartPoleEnv(seed)
+        self.obs_dim = CartPoleEnv.observation_dim
+        self.num_actions = CartPoleEnv.num_actions
+        self.num_rollout_workers = 2
+        self.num_envs_per_worker = 4
+        self.rollout_fragment_length = 128
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.lambda_ = 0.95
+        self.clip_param = 0.2
+        self.entropy_coeff = 0.01
+        self.vf_coeff = 0.5
+        self.num_sgd_iter = 4
+        self.sgd_minibatch_size = 256
+        self.seed = 0
+
+    def environment(self, env_maker=None, *, obs_dim=None, num_actions=None) -> "PPOConfig":
+        if env_maker is not None:
+            self.env_maker = env_maker
+        if obs_dim is not None:
+            self.obs_dim = obs_dim
+        if num_actions is not None:
+            self.num_actions = num_actions
+        return self
+
+    def rollouts(self, *, num_rollout_workers=None, num_envs_per_worker=None,
+                 rollout_fragment_length=None) -> "PPOConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, *, lr=None, gamma=None, lambda_=None, clip_param=None,
+                 entropy_coeff=None, num_sgd_iter=None,
+                 sgd_minibatch_size=None) -> "PPOConfig":
+        for k, v in [("lr", lr), ("gamma", gamma), ("lambda_", lambda_),
+                     ("clip_param", clip_param), ("entropy_coeff", entropy_coeff),
+                     ("num_sgd_iter", num_sgd_iter),
+                     ("sgd_minibatch_size", sgd_minibatch_size)]:
+            if v is not None:
+                setattr(self, k, v)
+        return self
+
+    def build(self) -> "PPO":
+        return PPO({"ppo_config": self})
+
+
+class PPO(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        cfg: PPOConfig = config.get("ppo_config") or PPOConfig()
+        self.cfg = cfg
+        self.learner = PPOLearner(
+            cfg.obs_dim, cfg.num_actions, cfg.lr, cfg.clip_param,
+            cfg.vf_coeff, cfg.entropy_coeff, cfg.seed)
+        self.workers = [
+            RolloutWorker.options(num_cpus=1).remote(
+                cfg.env_maker, cfg.num_envs_per_worker, cfg.seed + 1000 * (i + 1),
+                cfg.obs_dim, cfg.num_actions)
+            for i in range(cfg.num_rollout_workers)
+        ]
+        self._rng = np.random.default_rng(cfg.seed)
+        self._broadcast_weights()
+        self._reward_history: List[float] = []
+
+    def _broadcast_weights(self) -> None:
+        w = self.learner.get_weights()
+        ray_tpu.get([wk.set_weights.remote(w) for wk in self.workers])
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        # 1. parallel sampling
+        samples = ray_tpu.get([
+            wk.sample.remote(cfg.rollout_fragment_length) for wk in self.workers])
+        # 2. GAE per worker batch, then concat + flatten [T,N]->[T*N]
+        flats: List[Dict[str, np.ndarray]] = []
+        episode_returns: List[float] = []
+        for batch in samples:
+            adv, ret = compute_gae(batch, cfg.gamma, cfg.lambda_)
+            T, N = batch["actions"].shape
+            flats.append({
+                "obs": batch["obs"].reshape(T * N, -1),
+                "actions": batch["actions"].reshape(-1),
+                "logp": batch["logp"].reshape(-1),
+                "advantages": adv.reshape(-1),
+                "returns": ret.reshape(-1),
+            })
+            episode_returns.extend(batch["episode_returns"].tolist())
+        flat = {k: np.concatenate([f[k] for f in flats]) for k in flats[0]}
+        adv = flat["advantages"]
+        flat["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        # 3. learner update
+        stats = self.learner.update_minibatches(
+            flat, cfg.num_sgd_iter, cfg.sgd_minibatch_size, self._rng)
+        # 4. broadcast new weights
+        self._broadcast_weights()
+        if episode_returns:
+            self._reward_history.extend(episode_returns)
+            self._reward_history = self._reward_history[-100:]
+        mean_reward = float(np.mean(self._reward_history)) if self._reward_history else 0.0
+        return {
+            "episode_reward_mean": mean_reward,
+            "num_env_steps_sampled": int(flat["actions"].size),
+            **stats,
+        }
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.learner.set_weights(weights)
+        self._broadcast_weights()
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

@@ -1,0 +1,90 @@
+"""RL tests: env dynamics, GAE, and PPO learning on CartPole."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import CartPoleEnv, PPOConfig, VectorEnv
+from ray_tpu.rllib.ppo import compute_gae, init_policy_params, policy_apply
+
+
+def test_cartpole_dynamics():
+    env = CartPoleEnv(seed=0)
+    obs = env.reset()
+    assert obs.shape == (4,)
+    total = 0.0
+    done = False
+    steps = 0
+    while not done and steps < 600:
+        obs, r, done, _ = env.step(steps % 2)
+        total += r
+        steps += 1
+    assert 5 <= steps <= 500  # alternating policy falls over well before cap
+
+
+def test_vector_env_auto_reset():
+    vec = VectorEnv(lambda s: CartPoleEnv(s), num_envs=3, seed=0)
+    obs = vec.reset()
+    assert obs.shape == (3, 4)
+    for _ in range(100):
+        obs, r, dones, _ = vec.step(np.zeros(3, dtype=int))
+    assert obs.shape == (3, 4)  # auto-reset kept shapes intact
+
+
+def test_gae_simple_case():
+    batch = {
+        "rewards": np.array([[1.0], [1.0], [1.0]], np.float32),
+        "values": np.zeros((3, 1), np.float32),
+        "dones": np.array([[0.0], [0.0], [1.0]], np.float32),
+        "last_value": np.array([10.0], np.float32),
+    }
+    adv, ret = compute_gae(batch, gamma=1.0, lam=1.0)
+    # terminal at t=2 cuts the bootstrap: returns are 3, 2, 1
+    np.testing.assert_allclose(ret[:, 0], [3.0, 2.0, 1.0])
+
+
+def test_policy_apply_shapes():
+    params = init_policy_params(0, 4, 2)
+    logits, value = policy_apply(params, np.zeros((7, 4), np.float32))
+    assert np.asarray(logits).shape == (7, 2)
+    assert np.asarray(value).shape == (7,)
+
+
+@pytest.mark.slow
+def test_ppo_learns_cartpole(ray_start_regular):
+    algo = (PPOConfig()
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=4,
+                      rollout_fragment_length=128)
+            .training(lr=1e-3, num_sgd_iter=6, sgd_minibatch_size=256)
+            .build())
+    first = None
+    last = None
+    for i in range(12):
+        metrics = algo.train()
+        if metrics["episode_reward_mean"] > 0 and first is None:
+            first = metrics["episode_reward_mean"]
+        last = metrics["episode_reward_mean"]
+    algo.stop()
+    assert first is not None, "no episodes completed"
+    assert last > max(first * 1.5, 40.0), (first, last)
+
+
+def test_ppo_save_restore(ray_start_regular):
+    algo = (PPOConfig()
+            .rollouts(num_rollout_workers=1, num_envs_per_worker=2,
+                      rollout_fragment_length=32)
+            .build())
+    algo.train()
+    ckpt = algo.save()
+    w1 = algo.get_weights()
+    algo.stop()
+
+    algo2 = (PPOConfig()
+             .rollouts(num_rollout_workers=1, num_envs_per_worker=2,
+                       rollout_fragment_length=32)
+             .build())
+    algo2.restore(ckpt)
+    w2 = algo2.get_weights()
+    for k in w1:
+        np.testing.assert_array_equal(w1[k], w2[k])
+    algo2.stop()
