@@ -126,7 +126,9 @@ class SharedObjectStore:
         self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()  # LRU order
         self._lock = threading.RLock()
         self._used = 0
-        self._prefix = f"rtpu-{os.getpid()}-"
+        # unique per store instance: several raylets (and their stores) can
+        # share one process in in-process test clusters
+        self._prefix = f"rtpu-{os.getpid()}-{os.urandom(3).hex()}-"
         self._seq = 0
 
     # ---- producer API ----------------------------------------------------
@@ -136,9 +138,17 @@ class SharedObjectStore:
             if object_id in self._entries:
                 raise FileExistsError(f"object {object_id} already exists")
             self._maybe_evict(size)
-            self._seq += 1
-            name = f"{self._prefix}{self._seq}"
-            shm = ShmSegment(name, size, create=True)
+            shm = None
+            for _ in range(1000):
+                self._seq += 1
+                name = f"{self._prefix}{self._seq}"
+                try:
+                    shm = ShmSegment(name, size, create=True)
+                    break
+                except FileExistsError:
+                    continue  # stale segment from a crashed prior run
+            if shm is None:
+                raise RuntimeError("could not allocate shm segment")
             self._entries[object_id] = _Entry(name=name, size=size)
             self._used += size
             return shm
