@@ -1,0 +1,116 @@
+"""CLI: `python -m ray_tpu <command>`.
+
+Command surface mirrors the reference CLI (SURVEY appendix A): start,
+status, list (actors/nodes/tasks/pgs/jobs), summary, timeline, job submit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _connect(address: str):
+    import ray_tpu
+
+    ray_tpu.init(address=address)
+    return ray_tpu
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "start":
+        # argparse REMAINDER can't forward leading options; dispatch directly
+        from ray_tpu.core.node_main import main as node_main
+
+        node_main(argv[1:])
+        return 0
+
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("start", help="start a node daemon (head or worker)")
+
+    p_status = sub.add_parser("status", help="cluster resource summary")
+    p_status.add_argument("--address", required=True)
+
+    p_list = sub.add_parser("list", help="list cluster entities")
+    p_list.add_argument("what", choices=["actors", "nodes", "tasks",
+                                         "placement-groups", "jobs"])
+    p_list.add_argument("--address", required=True)
+
+    p_sum = sub.add_parser("summary", help="task state summary")
+    p_sum.add_argument("--address", required=True)
+
+    p_tl = sub.add_parser("timeline", help="dump chrome trace json")
+    p_tl.add_argument("--output", default="timeline.json")
+
+    p_job = sub.add_parser("job", help="job submission")
+    job_sub = p_job.add_subparsers(dest="job_cmd", required=True)
+    p_job_submit = job_sub.add_parser("submit")
+    p_job_submit.add_argument("--address", required=True)
+    p_job_submit.add_argument("--working-dir", default=None)
+    p_job_submit.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    p_job_list = job_sub.add_parser("list")
+    p_job_list.add_argument("--address", required=True)
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "status":
+        rt = _connect(args.address)
+        print(json.dumps({
+            "total": rt.cluster_resources(),
+            "available": rt.available_resources(),
+            "nodes": len(rt.nodes()),
+        }, indent=2))
+        return 0
+
+    if args.cmd == "list":
+        _connect(args.address)
+        from ray_tpu import state
+
+        fn = {
+            "actors": state.list_actors,
+            "nodes": state.list_nodes,
+            "tasks": state.list_tasks,
+            "placement-groups": state.list_placement_groups,
+            "jobs": state.list_jobs,
+        }[args.what]
+        print(json.dumps(fn(), indent=2, default=str))
+        return 0
+
+    if args.cmd == "summary":
+        _connect(args.address)
+        from ray_tpu import state
+
+        print(json.dumps(state.summarize_tasks(), indent=2))
+        return 0
+
+    if args.cmd == "timeline":
+        from ray_tpu.util import tracing
+
+        tracing.dump(args.output)
+        print(f"wrote {args.output}")
+        return 0
+
+    if args.cmd == "job":
+        from ray_tpu.job_submission import JobSubmissionClient
+
+        client = JobSubmissionClient(args.address)
+        if args.job_cmd == "submit":
+            entry = args.entrypoint
+            if entry and entry[0] == "--":
+                entry = entry[1:]
+            job_id = client.submit_job(
+                entrypoint=" ".join(entry), working_dir=args.working_dir)
+            print(job_id)
+        else:
+            print(json.dumps(client.list_jobs(), indent=2, default=str))
+        return 0
+
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,6 +59,13 @@ class GcsServer:
         # jobs
         self._jobs: Dict[bytes, dict] = {}
 
+        # task events: ring buffer of recent task lifecycle records
+        # (reference GcsTaskManager + per-worker TaskEventBuffer,
+        # src/ray/core_worker/task_event_buffer.h)
+        self._task_events: Dict[bytes, dict] = {}
+        self._task_events_order: List[bytes] = []
+        self._max_task_events = 10000
+
         # pubsub: channel -> list[ServerConnection]
         self._subs: Dict[str, List[rpc.ServerConnection]] = {}
 
@@ -271,6 +278,30 @@ class GcsServer:
     def rpc_get_jobs(self, conn, req_id, payload):
         with self._lock:
             return list(self._jobs.values())
+
+    # ------------------------------------------------------------ task events
+    def rpc_task_event(self, conn, req_id, payload):
+        """Best-effort task lifecycle records (notify; no reply needed)."""
+        key = payload["task_id"]
+        with self._lock:
+            e = self._task_events.get(key)
+            if e is None:
+                if len(self._task_events_order) >= self._max_task_events:
+                    old = self._task_events_order.pop(0)
+                    self._task_events.pop(old, None)
+                e = {"task_id": key}
+                self._task_events[key] = e
+                self._task_events_order.append(key)
+            e.update({k: v for k, v in payload.items() if k != "task_id"})
+            e.setdefault("events", []).append(
+                (payload.get("state", "?"), time.time()))
+        return True
+
+    def rpc_list_task_events(self, conn, req_id, payload):
+        limit = (payload or {}).get("limit", 1000)
+        with self._lock:
+            keys = self._task_events_order[-limit:]
+            return [dict(self._task_events[k]) for k in keys]
 
     # ---------------------------------------------------------------- actors
     def rpc_register_actor(self, conn, req_id, payload):

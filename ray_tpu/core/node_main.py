@@ -1,0 +1,80 @@
+"""Standalone node daemon: `ray_tpu start` entry.
+
+Equivalent of the reference's `ray start` process assembly (SURVEY
+appendix A, `python/ray/scripts/scripts.py:529`): `--head` runs GCS +
+raylet in this process; otherwise a raylet joins an existing GCS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import time
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="ray_tpu start")
+    parser.add_argument("--head", action="store_true")
+    parser.add_argument("--address", help="GCS address to join (worker node)")
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--num-tpus", type=float, default=None)
+    parser.add_argument("--resources", default="{}",
+                        help='extra resources JSON, e.g. \'{"TPU": 4}\'')
+    parser.add_argument("--labels", default="{}",
+                        help='node labels JSON, e.g. \'{"tpu_slice": "s0"}\'')
+    parser.add_argument("--object-store-memory", type=int, default=None)
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=args.log_level)
+    resources = json.loads(args.resources)
+    if args.num_cpus is not None:
+        resources["CPU"] = args.num_cpus
+    if args.num_tpus is not None:
+        resources["TPU"] = args.num_tpus
+    labels = json.loads(args.labels)
+
+    from ray_tpu.core.gcs import GcsServer
+    from ray_tpu.core.node import default_node_resources, detect_tpu_labels
+    from ray_tpu.core.raylet import Raylet
+
+    labels = {**detect_tpu_labels(), **labels}
+    gcs_address = args.address
+    gcs = None
+    if args.head:
+        gcs = GcsServer()
+        gcs_address = gcs.start()
+        print(f"ray_tpu head started. GCS address: {gcs_address}")
+        print(f"Connect with: ray_tpu.init(address=\"{gcs_address}\")")
+    elif not gcs_address:
+        parser.error("either --head or --address is required")
+
+    raylet = Raylet(
+        gcs_address=gcs_address,
+        resources=default_node_resources(None, resources),
+        labels=labels,
+        object_store_memory=args.object_store_memory,
+    )
+    raylet.start()
+    print(f"raylet started on node {raylet.node_id.hex()[:12]} "
+          f"({raylet.address})")
+
+    stop = {"flag": False}
+
+    def handle(sig, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, handle)
+    signal.signal(signal.SIGTERM, handle)
+    while not stop["flag"]:
+        time.sleep(0.5)
+    raylet.stop()
+    if gcs is not None:
+        gcs.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -292,8 +292,25 @@ class CoreWorker:
         )
         refs = self._register_returns(spec)
         self._pending_tasks[task_id] = [spec, max_retries]
+        self._emit_task_event(spec, "SUBMITTED")
         self.raylet.notify("submit_task", {"spec": spec})
         return refs
+
+    def _emit_task_event(self, spec: TaskSpec, state: str) -> None:
+        """Best-effort task lifecycle record to the control plane
+        (reference TaskEventBuffer -> GcsTaskManager)."""
+        try:
+            self.gcs.notify("task_event", {
+                "task_id": spec.task_id.binary(),
+                "name": spec.method_name,
+                "type": spec.task_type.name,
+                "state": state,
+                "job_id": spec.job_id.binary(),
+                "node_id": self.node_id,
+                "worker_id": self.worker_id.binary(),
+            })
+        except Exception:
+            pass
 
     def _register_returns(self, spec: TaskSpec) -> List[ObjectRef]:
         refs = []
@@ -699,6 +716,7 @@ class CoreWorker:
         )
         refs = self._register_returns(spec)
         self._pending_tasks[task_id] = [spec, 0]
+        self._emit_task_event(spec, "SUBMITTED")
         self._send_actor_task(actor_id, spec, attempts=0)
         return refs
 
@@ -875,6 +893,8 @@ class CoreWorker:
         (cf. reference `_raylet.pyx:718 execute_task`)."""
         prev_task_id = getattr(self._tls, "task_id", None)
         self._tls.task_id = spec.task_id
+        self._emit_task_event(spec, "RUNNING")
+        failed = False
         results = []
         try:
             if spec.task_type == TaskType.ACTOR_TASK:
@@ -909,11 +929,13 @@ class CoreWorker:
             te = cls.from_exception(spec.method_name, e)
             blob = serialization.dumps(te)
             results = [("error", oid, blob) for oid in spec.return_object_ids()]
+            failed = True
         finally:
             if prev_task_id is None:
                 del self._tls.task_id
             else:
                 self._tls.task_id = prev_task_id
+        self._emit_task_event(spec, "FAILED" if failed else "FINISHED")
         try:
             if spec.owner_address == self.address:
                 self.rpc_report_task_result(None, 0, {"task_id": spec.task_id, "results": results})

@@ -1,0 +1,74 @@
+"""Dashboard-lite: HTTP JSON endpoints for cluster state + metrics.
+
+Equivalent role to the reference's aiohttp dashboard head
+(`dashboard/head.py` + modules): machine-readable endpoints instead of the
+React client —
+
+    GET /api/nodes       GET /api/actors     GET /api/tasks
+    GET /api/jobs        GET /api/placement_groups
+    GET /api/cluster_resources
+    GET /metrics         (Prometheus text format)
+    GET /timeline        (chrome://tracing JSON)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+
+def start_dashboard(port: int = 0) -> Tuple[ThreadingHTTPServer, int]:
+    """Serve dashboard endpoints from this (driver) process; returns port."""
+    from ray_tpu import state as state_api
+    from ray_tpu.core import api as core_api
+    from ray_tpu.util import metrics as metrics_mod
+    from ray_tpu.util import tracing
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            try:
+                if self.path == "/api/nodes":
+                    body, ctype = json.dumps(state_api.list_nodes()), "application/json"
+                elif self.path == "/api/actors":
+                    body, ctype = json.dumps(state_api.list_actors()), "application/json"
+                elif self.path == "/api/tasks":
+                    body, ctype = json.dumps(state_api.list_tasks()), "application/json"
+                elif self.path == "/api/jobs":
+                    body, ctype = json.dumps(state_api.list_jobs()), "application/json"
+                elif self.path == "/api/placement_groups":
+                    body, ctype = json.dumps(state_api.list_placement_groups()), "application/json"
+                elif self.path == "/api/cluster_resources":
+                    body, ctype = json.dumps({
+                        "total": core_api.cluster_resources(),
+                        "available": core_api.available_resources(),
+                    }), "application/json"
+                elif self.path == "/metrics":
+                    body, ctype = metrics_mod.export_prometheus(), "text/plain"
+                elif self.path == "/timeline":
+                    body, ctype = json.dumps(
+                        {"traceEvents": tracing.get_events()}), "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            except Exception as e:
+                data = json.dumps({"error": str(e)}).encode()
+                self.send_response(500)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        def log_message(self, *a):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, server.server_address[1]

@@ -1,0 +1,123 @@
+"""Job submission: run an entrypoint command on the cluster, supervised.
+
+Mirrors the reference's job flow (`dashboard/modules/job/job_manager.py:507`:
+submit -> detached JobSupervisor actor runs the shell entrypoint, streams
+logs, records JobInfo): here the supervisor is a plain named actor and job
+records live in the GCS KV.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+_KV_NS = "job_submission"
+
+
+@ray_tpu.remote
+class JobSupervisor:
+    """Runs one entrypoint subprocess and captures its output."""
+
+    def __init__(self, job_id: str, entrypoint: str,
+                 working_dir: Optional[str], env_vars: Optional[dict]):
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self.working_dir = working_dir
+        self.env_vars = env_vars or {}
+        self._proc: Optional[subprocess.Popen] = None
+        self._log = b""
+        self._status = "PENDING"
+
+    def start(self, gcs_address: str) -> str:
+        env = dict(os.environ)
+        env.update({k: str(v) for k, v in self.env_vars.items()})
+        env["RAY_TPU_ADDRESS"] = gcs_address
+        self._proc = subprocess.Popen(
+            self.entrypoint, shell=True, cwd=self.working_dir,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+        self._status = "RUNNING"
+        return self._status
+
+    def poll(self) -> str:
+        if self._proc is None:
+            return self._status
+        rc = self._proc.poll()
+        if rc is None:
+            return "RUNNING"
+        if self._status in ("RUNNING",):
+            out, _ = self._proc.communicate()
+            self._log += out or b""
+            self._status = "SUCCEEDED" if rc == 0 else "FAILED"
+        return self._status
+
+    def logs(self) -> str:
+        self.poll()
+        return self._log.decode(errors="replace")
+
+    def stop(self) -> bool:
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            self._status = "STOPPED"
+            return True
+        return False
+
+
+class JobSubmissionClient:
+    """Client API (reference `python/ray/job_submission/`)."""
+
+    def __init__(self, address: Optional[str] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address)
+        from ray_tpu.core.api import _global_worker
+
+        self._worker = _global_worker()
+
+    def _kv(self, method: str, **payload):
+        payload["namespace"] = _KV_NS
+        return self._worker.gcs.call(f"kv_{method}", payload)
+
+    def submit_job(self, *, entrypoint: str, working_dir: Optional[str] = None,
+                   env_vars: Optional[dict] = None,
+                   submission_id: Optional[str] = None) -> str:
+        job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
+        supervisor = JobSupervisor.options(
+            name=f"_job_supervisor:{job_id}", num_cpus=0).remote(
+            job_id, entrypoint, working_dir, env_vars)
+        ray_tpu.get(supervisor.start.remote(self._worker.gcs_address))
+        self._kv("put", key=job_id.encode(), value={
+            "job_id": job_id, "entrypoint": entrypoint,
+            "submit_time": time.time()})
+        return job_id
+
+    def _supervisor(self, job_id: str):
+        return ray_tpu.get_actor(f"_job_supervisor:{job_id}")
+
+    def get_job_status(self, job_id: str) -> str:
+        try:
+            return ray_tpu.get(self._supervisor(job_id).poll.remote())
+        except ValueError:
+            return "UNKNOWN"
+
+    def get_job_logs(self, job_id: str) -> str:
+        return ray_tpu.get(self._supervisor(job_id).logs.remote())
+
+    def stop_job(self, job_id: str) -> bool:
+        return ray_tpu.get(self._supervisor(job_id).stop.remote())
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        keys = self._kv("keys", prefix=b"")
+        return [self._kv("get", key=k) for k in keys]
+
+    def wait_until_finish(self, job_id: str, timeout: float = 300) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(job_id)
+            if status in ("SUCCEEDED", "FAILED", "STOPPED"):
+                return status
+            time.sleep(0.5)
+        return self.get_job_status(job_id)

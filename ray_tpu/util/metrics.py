@@ -1,0 +1,117 @@
+"""User-facing metrics: Counter / Gauge / Histogram + Prometheus text export.
+
+Mirrors `ray.util.metrics` (reference `python/ray/util/metrics.py`) and the
+Prometheus export path (reference metrics_agent -> scrape endpoint); here a
+process-local registry renders the standard text exposition format, served
+by the dashboard (`ray_tpu.dashboard`).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: List["Metric"] = []
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry.append(self)
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        return tuple(sorted(merged.items()))
+
+    def _fmt_labels(self, key: Tuple) -> str:
+        if not key:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in key)
+        return "{" + inner + "}"
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            k = self._key(tags)
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[self._key(tags)] = value
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = (0.01, 0.1, 1, 10, 100),
+                 tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries)
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._totals: Dict[Tuple, int] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            k = self._key(tags)
+            counts = self._counts.setdefault(k, [0] * (len(self.boundaries) + 1))
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._totals[k] = self._totals.get(k, 0) + 1
+
+
+def export_prometheus() -> str:
+    """Render all registered metrics in Prometheus text format."""
+    lines: List[str] = []
+    with _registry_lock:
+        metrics = list(_registry)
+    for m in metrics:
+        lines.append(f"# HELP {m.name} {m.description}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        if isinstance(m, Histogram):
+            for k, counts in m._counts.items():
+                cum = 0
+                for i, b in enumerate(m.boundaries):
+                    cum += counts[i]
+                    labels = dict(k)
+                    labels["le"] = str(b)
+                    inner = ",".join(f'{kk}="{vv}"' for kk, vv in sorted(labels.items()))
+                    lines.append(f"{m.name}_bucket{{{inner}}} {cum}")
+                cum += counts[-1]
+                labels = dict(k)
+                labels["le"] = "+Inf"
+                inner = ",".join(f'{kk}="{vv}"' for kk, vv in sorted(labels.items()))
+                lines.append(f"{m.name}_bucket{{{inner}}} {cum}")
+                lines.append(f"{m.name}_sum{m._fmt_labels(k)} {m._sums.get(k, 0.0)}")
+                lines.append(f"{m.name}_count{m._fmt_labels(k)} {m._totals.get(k, 0)}")
+        else:
+            for k, v in m._values.items():
+                lines.append(f"{m.name}{m._fmt_labels(k)} {v}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,133 @@
+"""State API, metrics, dashboard, ActorPool, job submission, CLI daemon."""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.metrics import Counter, Gauge, Histogram, export_prometheus
+
+
+def test_state_api(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    ray_tpu.get([f.remote(), a.ping.remote()])
+    time.sleep(0.3)  # task events are fire-and-forget
+
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+
+    actors = state.list_actors()
+    assert any(x["class_name"] == "A" for x in actors)
+
+    tasks = state.list_tasks()
+    names = {t["name"] for t in tasks}
+    assert "f" in names and "ping" in names
+    finished = [t for t in tasks if t["name"] == "f"]
+    assert finished and finished[0]["state"] == "FINISHED"
+
+
+def test_metrics_prometheus_export():
+    c = Counter("test_requests_total", "requests", tag_keys=("route",))
+    c.inc(3, tags={"route": "/a"})
+    g = Gauge("test_inflight", "inflight")
+    g.set(7)
+    h = Histogram("test_latency", "latency", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(5.0)
+    text = export_prometheus()
+    assert 'test_requests_total{route="/a"} 3.0' in text
+    assert "test_inflight 7" in text
+    assert "test_latency_count" in text
+    assert 'le="+Inf"' in text
+
+
+def test_dashboard_endpoints(ray_start_regular):
+    from ray_tpu.dashboard import start_dashboard
+
+    server, port = start_dashboard()
+    try:
+        for path in ("/api/nodes", "/api/cluster_resources", "/metrics", "/timeline"):
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                assert r.status == 200
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/api/nodes", timeout=10) as r:
+            nodes = json.loads(r.read())
+        assert len(nodes) == 1
+    finally:
+        server.shutdown()
+
+
+def test_actor_pool(ray_start_regular):
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, x):
+            return x * 2
+
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = sorted(pool.map(lambda a, v: a.double.remote(v), [1, 2, 3, 4]))
+    assert out == [2, 4, 6, 8]
+
+
+def test_job_submission(ray_start_regular):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint="echo hello-from-job && exit 0")
+    status = client.wait_until_finish(job_id, timeout=60)
+    assert status == "SUCCEEDED"
+    assert "hello-from-job" in client.get_job_logs(job_id)
+    jobs = client.list_jobs()
+    assert any(j["job_id"] == job_id for j in jobs)
+
+
+def test_job_failure_status(ray_start_regular):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint="exit 3")
+    assert client.wait_until_finish(job_id, timeout=60) == "FAILED"
+
+
+def test_cli_start_daemon_and_connect(tmp_path):
+    """Boot a real head daemon via the CLI and connect a separate driver."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--num-cpus", "2", "--resources", '{"TPU": 1}'],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd="/root/repo")
+    try:
+        address = None
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if "GCS address:" in line:
+                address = line.split("GCS address:")[1].strip()
+                break
+        assert address, "daemon did not print its GCS address"
+        driver = subprocess.run(
+            [sys.executable, "-c",
+             "import ray_tpu\n"
+             f"ray_tpu.init(address='{address}')\n"
+             "@ray_tpu.remote\n"
+             "def f(x):\n"
+             "    return x + 1\n"
+             "print('RESULT', ray_tpu.get(f.remote(41)))\n"],
+            capture_output=True, text=True, timeout=120, cwd="/root/repo")
+        assert "RESULT 42" in driver.stdout, driver.stdout + driver.stderr
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
