@@ -101,13 +101,33 @@ class SharedBuffer:
         self._shm.close()
 
 
+class ArenaBuffer:
+    """A zero-copy view of an object living inside the C++ shared arena."""
+
+    def __init__(self, view: memoryview, name: str, size: int):
+        self.view = view
+        self.name = name
+        self.size = size
+
+    @property
+    def buf(self) -> memoryview:  # writer-side API parity with ShmSegment
+        return self.view
+
+    def close(self):
+        try:
+            self.view.release()
+        except Exception:
+            pass
+
+
 @dataclass
 class _Entry:
-    name: str           # shm segment name
+    name: str           # shm segment name, or "@<arena_path>:<offset>"
     size: int
     sealed: bool = False
     spilled_path: Optional[str] = None
     pinned: int = 0     # pin count (in-use by local get buffers)
+    arena_offset: Optional[int] = None
     created_at: float = field(default_factory=time.monotonic)
 
 
@@ -130,6 +150,18 @@ class SharedObjectStore:
         # share one process in in-process test clusters
         self._prefix = f"rtpu-{os.getpid()}-{os.urandom(3).hex()}-"
         self._seq = 0
+        # C++ arena for small objects: one mmap, sub-allocated (plasma's
+        # dlmalloc-arena design); file-per-object remains the big-object path
+        self.arena_threshold = 1 << 20  # 1 MiB
+        self._arena = None
+        try:
+            from ray_tpu.core.arena import Arena
+
+            arena_cap = max(64 << 20, min(self.capacity // 4, 512 << 20))
+            self._arena = Arena.create(
+                os.path.join(_SHM_DIR, f"{self._prefix}arena"), arena_cap)
+        except Exception:
+            logger.debug("arena unavailable", exc_info=True)
 
     # ---- producer API ----------------------------------------------------
     def create(self, object_id: ObjectID, size: int) -> ShmSegment:
@@ -138,6 +170,14 @@ class SharedObjectStore:
             if object_id in self._entries:
                 raise FileExistsError(f"object {object_id} already exists")
             self._maybe_evict(size)
+            if self._arena is not None and size <= self.arena_threshold:
+                off = self._arena.alloc(size)
+                if off is not None:
+                    name = f"@{self._arena.path}:{off}"
+                    self._entries[object_id] = _Entry(
+                        name=name, size=size, arena_offset=off)
+                    self._used += size
+                    return ArenaBuffer(self._arena.view(off, size), name, size)
             shm = None
             for _ in range(1000):
                 self._seq += 1
@@ -187,13 +227,13 @@ class SharedObjectStore:
             self._entries.move_to_end(object_id)
             return (e.name, e.size)
 
-    def get_buffer(self, object_id: ObjectID) -> Optional[SharedBuffer]:
+    def get_buffer(self, object_id: ObjectID):
         """In-process zero-copy read (same process as the store)."""
         loc = self.lookup(object_id)
         if loc is None:
             return None
         name, size = loc
-        return SharedBuffer(ShmSegment(name, size), size)
+        return attach_object(name, size)
 
     def read_bytes(self, object_id: ObjectID) -> Optional[bytes]:
         buf = self.get_buffer(object_id)
@@ -210,7 +250,11 @@ class SharedObjectStore:
             e = self._entries.pop(object_id, None)
             if e is None:
                 return
-            if e.spilled_path is None:
+            if e.arena_offset is not None:
+                if self._arena is not None:
+                    self._arena.free(e.arena_offset)
+                self._used -= e.size
+            elif e.spilled_path is None:
                 self._unlink(e)
                 self._used -= e.size
             elif os.path.exists(e.spilled_path):
@@ -233,6 +277,10 @@ class SharedObjectStore:
         with self._lock:
             for oid in list(self._entries):
                 self.delete(oid)
+            if self._arena is not None:
+                self._arena.close()
+                self._arena.unlink()
+                self._arena = None
 
     # ---- internals -------------------------------------------------------
     def _unlink(self, e: _Entry) -> None:
@@ -251,8 +299,9 @@ class SharedObjectStore:
             if self._used + incoming <= self.capacity * threshold:
                 break
             e = self._entries[oid]
-            if not e.sealed or e.spilled_path is not None or e.pinned > 0:
-                continue
+            if (not e.sealed or e.spilled_path is not None or e.pinned > 0
+                    or e.arena_offset is not None):
+                continue  # arena objects are small; only file segments spill
             self._spill(oid, e)
 
     def _spill(self, object_id: ObjectID, e: _Entry) -> None:
@@ -288,6 +337,18 @@ class SharedObjectStore:
         logger.debug("restored %s from spill", object_id)
 
 
-def attach_object(name: str, size: int) -> SharedBuffer:
-    """Attach to a sealed object's segment from any process on the node."""
+def attach_object(name: str, size: int):
+    """Attach to a sealed object from any process on the node.
+
+    `name` is either a /dev/shm segment name or "@<arena_path>:<offset>"
+    for objects living in the C++ shared arena.
+    """
+    if name.startswith("@"):
+        from ray_tpu.core.arena import attached_arena
+
+        path, off = name[1:].rsplit(":", 1)
+        arena = attached_arena(path)
+        if arena is None:
+            raise FileNotFoundError(f"cannot attach arena {path}")
+        return ArenaBuffer(arena.view(int(off), size), name, size)
     return SharedBuffer(ShmSegment(name, size), size)
