@@ -1,0 +1,2 @@
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler, NodeType
+from ray_tpu.autoscaler.node_provider import NodeProvider, FakeNodeProvider

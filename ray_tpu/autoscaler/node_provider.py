@@ -1,0 +1,86 @@
+"""Node providers: the pluggable cloud interface.
+
+Mirrors the reference's `NodeProvider` plugin surface
+(`python/ray/autoscaler/node_provider.py:13`; aws/gcp/... subclasses) with
+two implementations:
+
+  - `FakeNodeProvider`: launches real in-process raylets (the reference's
+    `FakeMultiNodeProvider`, `fake_multi_node/node_provider.py:237`) so
+    autoscaler end-to-end behavior is testable on one machine;
+  - `GceTpuNodeProvider`: skeleton for TPU-VM provisioning through the GCE
+    API (create/delete tpu-vm node pools per slice topology) — the API
+    calls are stubbed out since this environment has no cloud egress, but
+    the request shapes document the intended integration.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class NodeProvider:
+    def create_node(self, node_type: str, resources: Dict[str, float],
+                    labels: Dict[str, str]) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+
+class FakeNodeProvider(NodeProvider):
+    """Backs node launches with in-process raylets joined to a real GCS."""
+
+    def __init__(self, gcs_address: str):
+        self.gcs_address = gcs_address
+        self._nodes: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def create_node(self, node_type: str, resources: Dict[str, float],
+                    labels: Dict[str, str]) -> str:
+        from ray_tpu.core.raylet import Raylet
+
+        raylet = Raylet(gcs_address=self.gcs_address,
+                        resources=dict(resources), labels=dict(labels))
+        raylet.start()
+        pid = f"fake-{node_type}-{uuid.uuid4().hex[:8]}"
+        with self._lock:
+            self._nodes[pid] = raylet
+        return pid
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        with self._lock:
+            raylet = self._nodes.pop(provider_node_id, None)
+        if raylet is not None:
+            raylet.stop()
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    def raylet_for(self, provider_node_id: str):
+        return self._nodes.get(provider_node_id)
+
+
+class GceTpuNodeProvider(NodeProvider):
+    """TPU-VM provisioning skeleton (no cloud egress in this environment).
+
+    create_node would POST to
+    `tpu.googleapis.com/v2/projects/{p}/locations/{z}/nodes` with
+    `acceleratorType` (e.g. "v5litepod-16") derived from the node type's
+    slice topology, then run the bootstrap command
+    (`python -m ray_tpu start --address=<gcs>`) on each TPU-VM worker via
+    SSH — the reference's command_runner pattern.
+    """
+
+    def __init__(self, project: str, zone: str, gcs_address: str):
+        self.project = project
+        self.zone = zone
+        self.gcs_address = gcs_address
+        raise NotImplementedError(
+            "GCE TPU provisioning requires cloud credentials/egress; use "
+            "FakeNodeProvider for local testing")

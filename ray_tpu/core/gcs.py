@@ -150,7 +150,19 @@ class GcsServer:
             n = self._nodes.get(node_id)
             if n is not None and "resources_available" in payload:
                 n["resources_available"] = payload["resources_available"]
+            if n is not None:
+                n["pending_demands"] = payload.get("pending_demands", [])
         return True
+
+    def rpc_get_pending_demands(self, conn, req_id, payload):
+        """Aggregate unscheduled resource demand (autoscaler input; reference
+        load_metrics.py)."""
+        with self._lock:
+            out = []
+            for n in self._nodes.values():
+                if n["alive"]:
+                    out.extend(n.get("pending_demands", []))
+            return out
 
     def rpc_report_resources(self, conn, req_id, payload):
         """Raylet resource view update (reference RaySyncer role)."""

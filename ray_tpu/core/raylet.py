@@ -206,9 +206,13 @@ class Raylet:
         period = get_config().health_check_period_ms / 1000.0
         while not self._shutdown.wait(period):
             try:
+                with self._lock:
+                    demands = [self._effective_demand(qt.spec)
+                               for qt in list(self._queue)[:100]]
                 self._gcs.call("heartbeat", {
                     "node_id": self.node_id.binary(),
                     "resources_available": dict(self.resources_available),
+                    "pending_demands": demands,
                 }, timeout=5)
             except Exception:
                 if not self._shutdown.is_set():

@@ -1,0 +1,29 @@
+"""RuntimeEnv: per-task/actor environment configuration.
+
+Mirrors the reference's public dataclass
+(`python/ray/runtime_env/runtime_env.py`) for the fields this build
+supports natively: `env_vars` and `working_dir` are applied in the worker
+before execution (ray_tpu/core/worker.py `_apply_runtime_env`). Conda/pip
+isolation would require per-env worker pools (reference
+`_private/runtime_env/{conda,pip}.py` + agent); that is a round-2+ item and
+raises NotImplementedError rather than silently ignoring.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class RuntimeEnv(dict):
+    def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
+                 working_dir: Optional[str] = None,
+                 pip: Optional[list] = None, conda: Optional[str] = None):
+        if pip or conda:
+            raise NotImplementedError(
+                "pip/conda runtime envs need per-env worker pools (planned); "
+                "supported fields: env_vars, working_dir")
+        super().__init__()
+        if env_vars:
+            self["env_vars"] = dict(env_vars)
+        if working_dir:
+            self["working_dir"] = working_dir

@@ -1,0 +1,1 @@
+from ray_tpu.workflow.api import step, run, run_async, resume, list_all, get_status

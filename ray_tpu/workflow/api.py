@@ -1,0 +1,223 @@
+"""Workflows: durable DAG execution with storage-backed step checkpoints.
+
+Mirrors the reference workflow library's capability
+(`python/ray/workflow/workflow_executor.py`, `workflow_storage.py`): every
+step's result is persisted under the workflow's storage directory before
+dependents run, so a crashed/cancelled workflow `resume()`s from the last
+completed step instead of recomputing.
+
+    @workflow.step
+    def add(a, b): return a + b
+
+    out = workflow.run(add.step(add.step(1, 2), 3), workflow_id="w1",
+                       storage="/tmp/wf")
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+
+_DEFAULT_STORAGE = os.path.join(
+    os.environ.get("RAY_TPU_WORKFLOW_STORAGE",
+                   os.path.expanduser("~/.ray_tpu/workflows")))
+
+
+class WorkflowStep:
+    """A lazy step invocation (node in the workflow DAG)."""
+
+    def __init__(self, fn, args, kwargs, name: Optional[str] = None,
+                 max_retries: int = 0):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.name = name or fn.__name__
+        self.max_retries = max_retries
+
+    def step_id(self) -> str:
+        """Deterministic id from the step's position in the DAG."""
+        h = hashlib.blake2b(digest_size=8)
+        h.update(self.name.encode())
+        for a in self.args:
+            h.update(a.step_id().encode() if isinstance(a, WorkflowStep)
+                     else repr(a).encode())
+        for k, v in sorted(self.kwargs.items()):
+            h.update(k.encode())
+            h.update(v.step_id().encode() if isinstance(v, WorkflowStep)
+                     else repr(v).encode())
+        return f"{self.name}-{h.hexdigest()}"
+
+
+class _StepBuilder:
+    def __init__(self, fn, **opts):
+        self.fn = fn
+        self.opts = opts
+
+    def step(self, *args, **kwargs) -> WorkflowStep:
+        return WorkflowStep(self.fn, args, kwargs, **self.opts)
+
+    def options(self, **opts) -> "_StepBuilder":
+        merged = dict(self.opts)
+        merged.update(opts)
+        return _StepBuilder(self.fn, **merged)
+
+
+def step(fn=None, *, name: Optional[str] = None, max_retries: int = 0):
+    """Decorator: `@workflow.step` (reference workflow step API)."""
+    if fn is not None:
+        return _StepBuilder(fn)
+
+    def deco(f):
+        return _StepBuilder(f, name=name, max_retries=max_retries)
+
+    return deco
+
+
+# ------------------------------------------------------------------ storage
+
+
+class _Storage:
+    def __init__(self, root: str, workflow_id: str):
+        self.dir = os.path.join(root, workflow_id)
+        os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
+
+    def _path(self, step_id: str) -> str:
+        return os.path.join(self.dir, "steps", step_id + ".pkl")
+
+    def has(self, step_id: str) -> bool:
+        return os.path.exists(self._path(step_id))
+
+    def load(self, step_id: str):
+        with open(self._path(step_id), "rb") as f:
+            return pickle.load(f)
+
+    def save(self, step_id: str, value) -> None:
+        tmp = self._path(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(value, f)
+        os.replace(tmp, self._path(step_id))
+
+    def set_meta(self, **kv) -> None:
+        path = os.path.join(self.dir, "meta.json")
+        meta = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                meta = json.load(f)
+        meta.update(kv)
+        with open(path, "w") as f:
+            json.dump(meta, f)
+
+    def get_meta(self) -> dict:
+        path = os.path.join(self.dir, "meta.json")
+        if not os.path.exists(path):
+            return {}
+        with open(path) as f:
+            return json.load(f)
+
+    def save_dag(self, root_step: WorkflowStep) -> None:
+        with open(os.path.join(self.dir, "dag.pkl"), "wb") as f:
+            cloudpickle.dump(root_step, f)
+
+    def load_dag(self) -> WorkflowStep:
+        with open(os.path.join(self.dir, "dag.pkl"), "rb") as f:
+            return pickle.load(f)
+
+
+# ---------------------------------------------------------------- executor
+
+
+@ray_tpu.remote
+def _run_step(fn_blob: bytes, args, kwargs):
+    fn = cloudpickle.loads(fn_blob)
+    return fn(*args, **kwargs)
+
+
+def _execute(node: WorkflowStep, storage: _Storage):
+    step_id = node.step_id()
+    if storage.has(step_id):
+        return storage.load(step_id)
+    args = [_execute(a, storage) if isinstance(a, WorkflowStep) else a
+            for a in node.args]
+    kwargs = {k: (_execute(v, storage) if isinstance(v, WorkflowStep) else v)
+              for k, v in node.kwargs.items()}
+    attempts = node.max_retries + 1
+    last_exc: Optional[Exception] = None
+    for _ in range(attempts):
+        try:
+            value = ray_tpu.get(_run_step.remote(
+                cloudpickle.dumps(node.fn), args, kwargs))
+            storage.save(step_id, value)
+            return value
+        except Exception as e:
+            last_exc = e
+    raise last_exc  # type: ignore[misc]
+
+
+def run(root: WorkflowStep, *, workflow_id: Optional[str] = None,
+        storage: Optional[str] = None):
+    workflow_id = workflow_id or f"wf-{int(time.time() * 1000)}"
+    st = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    st.save_dag(root)
+    st.set_meta(status="RUNNING", start_time=time.time())
+    try:
+        out = _execute(root, st)
+        st.set_meta(status="SUCCEEDED", end_time=time.time())
+        return out
+    except Exception as e:
+        st.set_meta(status="FAILED", error=str(e), end_time=time.time())
+        raise
+
+
+def run_async(root: WorkflowStep, *, workflow_id: Optional[str] = None,
+              storage: Optional[str] = None):
+    """Run in a background task; returns an ObjectRef of the result."""
+
+    @ray_tpu.remote
+    def driver(blob, wf_id, storage_root):
+        from ray_tpu.workflow import api as wf_api
+
+        node = cloudpickle.loads(blob)
+        return wf_api.run(node, workflow_id=wf_id, storage=storage_root)
+
+    return driver.remote(cloudpickle.dumps(root),
+                         workflow_id or f"wf-{int(time.time() * 1000)}",
+                         storage or _DEFAULT_STORAGE)
+
+
+def resume(workflow_id: str, *, storage: Optional[str] = None):
+    """Resume from persisted step results (completed steps are not re-run)."""
+    st = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    root = st.load_dag()
+    st.set_meta(status="RUNNING")
+    try:
+        out = _execute(root, st)
+        st.set_meta(status="SUCCEEDED", end_time=time.time())
+        return out
+    except Exception as e:
+        st.set_meta(status="FAILED", error=str(e), end_time=time.time())
+        raise
+
+
+def get_status(workflow_id: str, *, storage: Optional[str] = None) -> Optional[str]:
+    st = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    return st.get_meta().get("status")
+
+
+def list_all(storage: Optional[str] = None) -> List[Dict[str, Any]]:
+    root = storage or _DEFAULT_STORAGE
+    out = []
+    if not os.path.isdir(root):
+        return out
+    for wf_id in sorted(os.listdir(root)):
+        st = _Storage(root, wf_id)
+        meta = st.get_meta()
+        out.append({"workflow_id": wf_id, **meta})
+    return out

@@ -1,0 +1,126 @@
+"""DAG graphs, durable workflows, autoscaler, runtime_env."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+import ray_tpu.dag  # installs .bind()
+
+
+def test_dag_function_graph(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    from ray_tpu.dag import InputNode
+
+    with InputNode() as inp:
+        graph = double.bind(add.bind(inp, 10))
+    out = ray_tpu.get(graph.execute(5))
+    assert out == 30
+
+
+def test_dag_actor_graph(ray_start_regular):
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, x):
+            self.v += x
+            return self.v
+
+    node = Acc.bind(100)
+    g1 = node.add.bind(1)
+    out = ray_tpu.get(g1.execute())
+    assert out == 101
+
+
+def test_workflow_runs_and_persists(ray_start_regular, tmp_path):
+    calls = []
+
+    @workflow.step
+    def add(a, b):
+        return a + b
+
+    dag = add.step(add.step(1, 2), 3)
+    out = workflow.run(dag, workflow_id="wf1", storage=str(tmp_path))
+    assert out == 6
+    assert workflow.get_status("wf1", storage=str(tmp_path)) == "SUCCEEDED"
+    wfs = workflow.list_all(storage=str(tmp_path))
+    assert wfs[0]["workflow_id"] == "wf1"
+
+
+def test_workflow_resume_skips_completed_steps(ray_start_regular, tmp_path):
+    marker = tmp_path / "fail"
+    marker.write_text("1")
+
+    @workflow.step
+    def expensive():
+        return 10
+
+    @workflow.step
+    def maybe_fail(x, marker_path):
+        import os
+
+        if os.path.exists(marker_path):
+            raise RuntimeError("transient failure")
+        return x + 1
+
+    dag = maybe_fail.step(expensive.step(), str(marker))
+    with pytest.raises(RuntimeError, match="transient"):
+        workflow.run(dag, workflow_id="wf2", storage=str(tmp_path / "wf"))
+    assert workflow.get_status("wf2", storage=str(tmp_path / "wf")) == "FAILED"
+    marker.unlink()  # clear the failure condition
+    out = workflow.resume("wf2", storage=str(tmp_path / "wf"))
+    assert out == 11
+    assert workflow.get_status("wf2", storage=str(tmp_path / "wf")) == "SUCCEEDED"
+
+
+def test_autoscaler_scales_up_for_demand(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    cluster.connect()
+
+    from ray_tpu.autoscaler import FakeNodeProvider, NodeType, StandardAutoscaler
+
+    provider = FakeNodeProvider(cluster.gcs_address)
+    autoscaler = StandardAutoscaler(
+        cluster.gcs_address, provider,
+        [NodeType("cpu4", {"CPU": 4.0}, min_workers=0, max_workers=3)],
+        update_interval_s=0.3)
+    autoscaler.start()
+    try:
+        @ray_tpu.remote(num_cpus=4)
+        def big_task():
+            return "ran"
+
+        # infeasible on the 1-CPU node; autoscaler must add a cpu4 node
+        ref = big_task.remote()
+        assert ray_tpu.get(ref, timeout=90) == "ran"
+        assert len(provider.non_terminated_nodes()) >= 1
+    finally:
+        autoscaler.stop()
+        for pid in provider.non_terminated_nodes():
+            provider.terminate_node(pid)
+
+
+def test_runtime_env_env_vars(ray_start_regular):
+    from ray_tpu.runtime_env import RuntimeEnv
+
+    @ray_tpu.remote(runtime_env=RuntimeEnv(env_vars={"MY_FLAG": "hello"}))
+    def read_env():
+        import os
+
+        return os.environ.get("MY_FLAG")
+
+    assert ray_tpu.get(read_env.remote()) == "hello"
+
+    with pytest.raises(NotImplementedError):
+        RuntimeEnv(pip=["requests"])
