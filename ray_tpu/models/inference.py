@@ -1,0 +1,163 @@
+"""Inference: KV-cache prefill/decode and a jitted generate loop.
+
+The serving-side compute path (used by Serve model replicas — the
+reference delegates this to torch; here it is native): prefill builds the
+stacked per-layer KV cache in one pass, decode steps are single-token
+forward passes attending over the cache (static max_len shapes, masked by
+position, so the whole generate loop is one compiled `lax.scan`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import ModelConfig
+from ray_tpu.ops.layers import apply_rotary, rms_norm, rotary_embedding, swiglu
+
+
+def _project_qkv(cfg: ModelConfig, p, x, cos, sin):
+    b, s, _ = x.shape
+    hd = cfg.head_dim
+    q = (x @ p["wq"]).reshape(b, s, cfg.n_heads, hd)
+    k = (x @ p["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (x @ p["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
+    q = apply_rotary(q, cos, sin)
+    k = apply_rotary(k, cos, sin)
+    return q, k, v
+
+
+def _mlp(cfg: ModelConfig, p, h):
+    if cfg.n_experts > 0:
+        from ray_tpu.ops.moe import moe_ffn
+
+        out, _ = moe_ffn(h, p["router"], p["w_gate"], p["w_up"], p["w_down"],
+                         cfg.capacity_factor)
+        return out
+    return swiglu(h @ p["w_gate"], h @ p["w_up"]) @ p["w_down"]
+
+
+def _masked_attention(q, k, v, mask):
+    """q [b,h,sq,hd] over cached k/v [b,kvh,L,hd] with bool mask [sq,L]."""
+    n_rep = q.shape[1] // k.shape[1]
+    if n_rep > 1:
+        k = jnp.repeat(k, n_rep, axis=1)
+        v = jnp.repeat(v, n_rep, axis=1)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def prefill(params: Dict, tokens: jax.Array, cfg: ModelConfig,
+            max_len: int) -> Tuple[jax.Array, Dict]:
+    """Process the prompt; returns (last-position logits [b, vocab], cache).
+
+    cache = {"k": [L,b,kvh,max_len,hd], "v": ..., "length": scalar}.
+    """
+    b, s = tokens.shape
+    hd = cfg.head_dim
+    positions = jnp.arange(s)
+    cos, sin = rotary_embedding(positions, hd, cfg.rope_theta)
+    cos, sin = cos[None], sin[None]
+    x = params["embed"][tokens].astype(cfg.dtype)
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    pad = jnp.zeros((s, max_len - s), bool)
+    mask = jnp.concatenate([causal, pad], axis=1)
+
+    def body(x, lp):
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(cfg, lp, h, cos, sin)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        k_cache = jnp.zeros((b, cfg.n_kv_heads, max_len, hd), cfg.dtype)
+        v_cache = jnp.zeros((b, cfg.n_kv_heads, max_len, hd), cfg.dtype)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(cfg.dtype), (0, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(cfg.dtype), (0, 0, 0, 0))
+        attn = _masked_attention(q, k_cache, v_cache, mask)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
+        x = x + (attn @ lp["wo"]).astype(x.dtype)
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(cfg, lp, h2).astype(x.dtype)
+        return x, (k_cache, v_cache)
+
+    x, (k_all, v_all) = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x[:, -1] @ head.astype(cfg.dtype)).astype(jnp.float32)
+    cache = {"k": k_all, "v": v_all, "length": jnp.asarray(s, jnp.int32)}
+    return logits, cache
+
+
+def decode_step(params: Dict, cache: Dict, token: jax.Array,
+                cfg: ModelConfig) -> Tuple[jax.Array, Dict]:
+    """One token for each batch row; returns (logits [b, vocab], cache)."""
+    b = token.shape[0]
+    hd = cfg.head_dim
+    pos = cache["length"]
+    max_len = cache["k"].shape[-2]
+    cos, sin = rotary_embedding(pos[None], hd, cfg.rope_theta)
+    cos, sin = cos[None], sin[None]
+    x = params["embed"][token[:, None]].astype(cfg.dtype)  # [b,1,d]
+    mask = (jnp.arange(max_len) <= pos)[None, :]  # [1, max_len]
+
+    def body(x, inputs):
+        lp, k_cache, v_cache = inputs
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(cfg, lp, h, cos, sin)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(cfg.dtype), (0, 0, pos, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(cfg.dtype), (0, 0, pos, 0))
+        attn = _masked_attention(q, k_cache, v_cache, mask)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, cfg.n_heads * hd)
+        x = x + (attn @ lp["wo"]).astype(x.dtype)
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(cfg, lp, h2).astype(x.dtype)
+        return x, (k_cache, v_cache)
+
+    x, (k_all, v_all) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x[:, 0] @ head.astype(cfg.dtype)).astype(jnp.float32)
+    new_cache = {"k": k_all, "v": v_all, "length": pos + 1}
+    return logits, new_cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "max_len",
+                                             "temperature"))
+def generate(params: Dict, prompt: jax.Array, cfg: ModelConfig, *,
+             max_new_tokens: int = 32, max_len: int = 512,
+             temperature: float = 0.0,
+             rng: Optional[jax.Array] = None) -> jax.Array:
+    """Autoregressive generation; returns [b, prompt_len + max_new_tokens].
+
+    temperature 0 = greedy; otherwise categorical sampling with `rng`.
+    """
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    logits, cache = prefill(params, prompt, cfg, max_len)
+
+    def sample(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+
+    first = sample(logits, rng)
+
+    def step(carry, key):
+        cache, token = carry
+        logits, cache = decode_step(params, cache, token, cfg)
+        nxt = sample(logits, key)
+        return (cache, nxt), token
+
+    keys = jax.random.split(rng, max_new_tokens)
+    # each scan step emits its *input* token, so ys = exactly the
+    # max_new_tokens sampled tokens (the final step's sample is unused)
+    (_, _last), tokens = jax.lax.scan(step, (cache, first), keys)
+    return jnp.concatenate([prompt, tokens.T], axis=1)

@@ -45,6 +45,11 @@ class ModelConfig:
     remat: str = "full"          # "none" | "full"
     use_ring_attention: bool = False  # set when mesh sp > 1
     tie_embeddings: bool = False
+    # Mixture of Experts: n_experts > 0 replaces the dense FFN with a
+    # top-2-gated MoE (ops/moe.py); experts shard over the "expert" axis.
+    n_experts: int = 0
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -64,6 +69,12 @@ class ModelConfig:
                            n_heads=16, n_kv_heads=8, d_ff=8192)
 
     @staticmethod
+    def tiny_moe() -> "ModelConfig":
+        return ModelConfig(vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+                           n_kv_heads=2, d_ff=256, max_seq_len=256,
+                           dtype=jnp.float32, remat="none", n_experts=4)
+
+    @staticmethod
     def llama3_8b() -> "ModelConfig":
         """Llama-3-8B shapes (vocab rounded to a 128-multiple sharding unit)."""
         return ModelConfig(vocab_size=128256, d_model=4096, n_layers=32,
@@ -77,20 +88,31 @@ class ModelConfig:
 def param_logical_axes(cfg: ModelConfig) -> Dict[str, Any]:
     """Logical axes per parameter leaf (layer-stacked leaves lead with
     'layers', which is never mesh-sharded)."""
-    axes = {
-        "embed": ("vocab", "embed"),
-        "final_norm": ("embed_nosplit",),
-        "layers": {
-            "attn_norm": ("layers", "embed_nosplit"),
-            "wq": ("layers", "embed", "heads"),
-            "wk": ("layers", "embed", "heads"),
-            "wv": ("layers", "embed", "heads"),
-            "wo": ("layers", "heads", "embed"),
-            "mlp_norm": ("layers", "embed_nosplit"),
+    layers: Dict[str, Any] = {
+        "attn_norm": ("layers", "embed_nosplit"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "heads"),
+        "wv": ("layers", "embed", "heads"),
+        "wo": ("layers", "heads", "embed"),
+        "mlp_norm": ("layers", "embed_nosplit"),
+    }
+    if cfg.n_experts > 0:
+        layers.update({
+            "router": ("layers", "embed", None),
+            "w_gate": ("layers", "expert", "embed", "mlp"),
+            "w_up": ("layers", "expert", "embed", "mlp"),
+            "w_down": ("layers", "expert", "mlp", "embed"),
+        })
+    else:
+        layers.update({
             "w_gate": ("layers", "embed", "mlp"),
             "w_up": ("layers", "embed", "mlp"),
             "w_down": ("layers", "mlp", "embed"),
-        },
+        })
+    axes = {
+        "embed": ("vocab", "embed"),
+        "final_norm": ("embed_nosplit",),
+        "layers": layers,
     }
     if not cfg.tie_embeddings:
         axes["lm_head"] = ("embed", "vocab")
@@ -109,22 +131,35 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Dict[str, Any]:
         return (jax.random.normal(key, shape, jnp.float32)
                 * (fan_in ** -0.5)).astype(cfg.dtype)
 
-    ks = jax.random.split(k_layers, 7)
+    ks = jax.random.split(k_layers, 8)
+    layers: Dict[str, Any] = {
+        "attn_norm": jnp.ones((L, d), cfg.dtype),
+        "wq": norm_init(ks[0], (L, d, nq * hd), d),
+        "wk": norm_init(ks[1], (L, d, nkv * hd), d),
+        "wv": norm_init(ks[2], (L, d, nkv * hd), d),
+        "wo": norm_init(ks[3], (L, nq * hd, d), nq * hd),
+        "mlp_norm": jnp.ones((L, d), cfg.dtype),
+    }
+    if cfg.n_experts > 0:
+        E = cfg.n_experts
+        layers.update({
+            "router": (jax.random.normal(ks[7], (L, d, E), jnp.float32)
+                       * 0.02).astype(cfg.dtype),
+            "w_gate": norm_init(ks[4], (L, E, d, cfg.d_ff), d),
+            "w_up": norm_init(ks[5], (L, E, d, cfg.d_ff), d),
+            "w_down": norm_init(ks[6], (L, E, cfg.d_ff, d), cfg.d_ff),
+        })
+    else:
+        layers.update({
+            "w_gate": norm_init(ks[4], (L, d, cfg.d_ff), d),
+            "w_up": norm_init(ks[5], (L, d, cfg.d_ff), d),
+            "w_down": norm_init(ks[6], (L, cfg.d_ff, d), cfg.d_ff),
+        })
     params: Dict[str, Any] = {
         "embed": (jax.random.normal(k_embed, (cfg.vocab_size, d), jnp.float32)
                   * 0.02).astype(cfg.dtype),
         "final_norm": jnp.ones((d,), cfg.dtype),
-        "layers": {
-            "attn_norm": jnp.ones((L, d), cfg.dtype),
-            "wq": norm_init(ks[0], (L, d, nq * hd), d),
-            "wk": norm_init(ks[1], (L, d, nkv * hd), d),
-            "wv": norm_init(ks[2], (L, d, nkv * hd), d),
-            "wo": norm_init(ks[3], (L, nq * hd, d), nq * hd),
-            "mlp_norm": jnp.ones((L, d), cfg.dtype),
-            "w_gate": norm_init(ks[4], (L, d, cfg.d_ff), d),
-            "w_up": norm_init(ks[5], (L, d, cfg.d_ff), d),
-            "w_down": norm_init(ks[6], (L, cfg.d_ff, d), cfg.d_ff),
-        },
+        "layers": layers,
     }
     if not cfg.tie_embeddings:
         params["lm_head"] = (jax.random.normal(k_head, (d, cfg.vocab_size),
@@ -167,14 +202,21 @@ def _layer(cfg: ModelConfig, mesh, x, layer_params, cos, sin):
     x = x + (attn @ p["wo"]).astype(x.dtype)
 
     h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    if cfg.n_experts > 0:
+        from ray_tpu.ops.moe import moe_ffn
+
+        out, aux = moe_ffn(h, p["router"], p["w_gate"], p["w_up"],
+                           p["w_down"], cfg.capacity_factor)
+        x = x + out.astype(x.dtype)
+        return x, aux
     h = swiglu(h @ p["w_gate"], h @ p["w_up"])
     x = x + (h @ p["w_down"]).astype(x.dtype)
-    return x
+    return x, jnp.zeros((), jnp.float32)
 
 
-def forward(params: Dict[str, Any], tokens: jax.Array, cfg: ModelConfig,
-            positions: Optional[jax.Array] = None, mesh=None) -> jax.Array:
-    """tokens [b, s] -> logits [b, s, vocab] (fp32).
+def forward_with_aux(params: Dict[str, Any], tokens: jax.Array, cfg: ModelConfig,
+                     positions: Optional[jax.Array] = None, mesh=None):
+    """tokens [b, s] -> (logits [b, s, vocab] fp32, moe_aux_loss scalar).
 
     `mesh` is required when `cfg.use_ring_attention` (the sp shard_map needs
     it); everything else is pure sharding-annotation-driven SPMD.
@@ -189,13 +231,22 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: ModelConfig,
     if cfg.remat == "full":
         layer_fn = jax.checkpoint(layer_fn)
 
-    def body(x, lp):
-        return layer_fn(x, lp, cos, sin), None
+    def body(carry, lp):
+        x, aux = carry
+        x, layer_aux = layer_fn(x, lp, cos, sin)
+        return (x, aux + layer_aux), None
 
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    (x, aux_total), _ = jax.lax.scan(
+        body, (x, jnp.zeros((), jnp.float32)), params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+    return logits, aux_total
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array, cfg: ModelConfig,
+            positions: Optional[jax.Array] = None, mesh=None) -> jax.Array:
+    return forward_with_aux(params, tokens, cfg, positions, mesh)[0]
 
 
 def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
@@ -215,7 +266,7 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
         mask = batch.get("loss_mask")
         if mask is not None:
             mask = mask[:, 1:]
-    logits = forward(params, inputs, cfg, mesh=mesh)
+    logits, moe_aux = forward_with_aux(params, inputs, cfg, mesh=mesh)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     target_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     nll = logz - target_logit
@@ -224,4 +275,6 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
         loss = jnp.sum(nll * maskf) / jnp.maximum(jnp.sum(maskf), 1.0)
     else:
         loss = jnp.mean(nll)
-    return loss, {"loss": loss, "ntokens": nll.size}
+    if cfg.n_experts > 0:
+        loss = loss + cfg.moe_aux_weight * moe_aux
+    return loss, {"loss": loss, "ntokens": nll.size, "moe_aux": moe_aux}

@@ -1,0 +1,92 @@
+"""Mixture-of-Experts block with expert parallelism.
+
+Absent from the reference entirely (SURVEY §2.4: EP/MoE = none in-tree) —
+green-field, TPU-first design: GShard-style top-2 gating with static expert
+capacity, dispatch/combine einsums over stacked expert weights [E, ...].
+When the "expert" logical axis is sharded over a mesh axis, XLA compiles
+the dispatch/combine einsums into all-to-alls over ICI — no manual
+collectives. Static capacity keeps every shape compile-time constant
+(XLA-friendly; overflowing tokens are dropped, the standard trade).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def top2_gating(router_logits: jax.Array, capacity: int):
+    """Build dispatch/combine tensors.
+
+    router_logits: [T, E]. Returns (dispatch [T,E,C] bool-ish float,
+    combine [T,E,C] float, aux_loss scalar).
+    """
+    T, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+
+    # top-1 and top-2 expert per token
+    idx1 = jnp.argmax(probs, axis=-1)                       # [T]
+    p1 = jnp.take_along_axis(probs, idx1[:, None], axis=-1)[:, 0]
+    masked = probs * (1.0 - jax.nn.one_hot(idx1, E))
+    idx2 = jnp.argmax(masked, axis=-1)
+    p2 = jnp.take_along_axis(masked, idx2[:, None], axis=-1)[:, 0]
+
+    # renormalize the pair
+    denom = jnp.maximum(p1 + p2, 1e-9)
+    w1, w2 = p1 / denom, p2 / denom
+
+    # position of each token within its expert's capacity (running count)
+    mask1 = jax.nn.one_hot(idx1, E)                         # [T, E]
+    pos1 = (jnp.cumsum(mask1, axis=0) - 1.0) * mask1        # [T, E]
+    mask2 = jax.nn.one_hot(idx2, E)
+    pos2 = (jnp.cumsum(mask2, axis=0) + jnp.sum(mask1, axis=0, keepdims=True)
+            - 1.0) * mask2
+
+    keep1 = (pos1 < capacity) * mask1
+    keep2 = (pos2 < capacity) * mask2
+
+    def scatter(keep, pos, w):
+        # [T,E] keep/pos + [T] weight -> [T,E,C]
+        pos_idx = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+        onehot_c = jax.nn.one_hot(pos_idx, capacity) * keep[..., None]
+        return onehot_c * w[:, None, None]
+
+    combine = scatter(keep1, pos1, w1) + scatter(keep2, pos2, w2)
+    dispatch = (combine > 0).astype(router_logits.dtype)
+
+    # load-balancing auxiliary loss (Switch/GShard)
+    density = jnp.mean(mask1, axis=0)                       # fraction routed
+    density_proxy = jnp.mean(probs, axis=0)
+    aux_loss = jnp.sum(density * density_proxy) * (E * E) / E
+    return dispatch.astype(jnp.float32), combine.astype(jnp.float32), aux_loss
+
+
+def moe_ffn(x: jax.Array, router_w: jax.Array, w_gate: jax.Array,
+            w_up: jax.Array, w_down: jax.Array,
+            capacity_factor: float = 1.25) -> Tuple[jax.Array, jax.Array]:
+    """MoE SwiGLU FFN. x: [B, S, d]; router_w: [d, E];
+    expert weights stacked [E, d, ff] / [E, ff, d].
+
+    Returns (out [B,S,d], aux_loss).
+    """
+    B, S, d = x.shape
+    E = router_w.shape[-1]
+    T = B * S
+    capacity = max(1, int(capacity_factor * T / E))
+    xt = x.reshape(T, d)
+
+    router_logits = (xt.astype(jnp.float32) @ router_w.astype(jnp.float32))
+    dispatch, combine, aux = top2_gating(router_logits, capacity)
+
+    # dispatch tokens to experts: [E, C, d]
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), xt)
+    # per-expert SwiGLU over stacked weights (sharded over the expert axis)
+    gate = jnp.einsum("ecd,edf->ecf", expert_in, w_gate)
+    up = jnp.einsum("ecd,edf->ecf", expert_in, w_up)
+    act = jax.nn.silu(gate) * up
+    expert_out = jnp.einsum("ecf,efd->ecd", act, w_down)
+    # combine back: [T, d]
+    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+    return out.reshape(B, S, d), aux
