@@ -80,3 +80,15 @@ __all__ = [
     "ObjectLostError",
     "GetTimeoutError",
 ]
+
+__all__.append("util")
+
+
+def __getattr__(name):
+    # `ray_tpu.util` attribute access like the reference's `ray.util`,
+    # loaded lazily (PEP 562) so bare `import ray_tpu` stays light.
+    if name == "util":
+        import importlib
+
+        return importlib.import_module("ray_tpu.util")
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

@@ -302,12 +302,17 @@ class RuntimeContext:
     def gcs_address(self):
         return self._w.gcs_address
 
+    @property
+    def placement_group_id(self):
+        return getattr(self._w, "placement_group_id", None)
+
     def get(self):
         return {
             "job_id": self.job_id,
             "node_id": self.node_id,
             "worker_id": self.worker_id,
             "actor_id": self.actor_id,
+            "placement_group_id": self.placement_group_id,
         }
 
 

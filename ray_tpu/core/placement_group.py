@@ -68,6 +68,41 @@ def remove_placement_group(pg: PlacementGroup) -> None:
     _global_worker().gcs.call("remove_placement_group", {"pg_id": pg.id})
 
 
+def placement_group_table(pg: Optional[PlacementGroup] = None) -> Dict:
+    """Debug table of one/all placement groups (reference
+    `python/ray/util/placement_group.py:248`)."""
+    from ray_tpu.core.api import _global_worker
+
+    w = _global_worker()
+    if pg is not None:
+        info = w.gcs.call("get_placement_group", {"pg_id": pg.id})
+        return {pg.id.hex(): info} if info else {}
+    infos = w.gcs.call("list_placement_groups", {}) or []
+    return {i["pg_id"].hex() if hasattr(i["pg_id"], "hex") else str(i["pg_id"]): i
+            for i in infos}
+
+
+def get_current_placement_group() -> Optional[PlacementGroup]:
+    """The PG the current task/actor is scheduled into, if any (reference
+    `python/ray/util/placement_group.py:296`)."""
+    from ray_tpu.core.api import get_runtime_context
+
+    try:
+        ctx = get_runtime_context()
+    except Exception:
+        return None
+    pg_id = getattr(ctx, "placement_group_id", None)
+    if pg_id is None:
+        return None
+    from ray_tpu.core.api import _global_worker
+
+    info = _global_worker().gcs.call("get_placement_group", {"pg_id": pg_id})
+    if not info:
+        return None
+    return PlacementGroup(pg_id, info.get("bundles", []),
+                          info.get("strategy", "PACK"), info.get("name"))
+
+
 def tpu_slice_placement_group(
     num_hosts: int,
     chips_per_host: Optional[int] = None,

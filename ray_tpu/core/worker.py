@@ -843,6 +843,15 @@ class CoreWorker:
             else:
                 self._actor_ooo_buffer.setdefault(caller, {})[spec.sequence_number] = spec
 
+    @property
+    def placement_group_id(self):
+        """PG of the currently-executing task, else the hosting actor's PG."""
+        pg = getattr(self._tls, "placement_group_id", None)
+        if pg is not None:
+            return pg
+        spec = self._actor_creation_spec
+        return spec.scheduling.placement_group_id if spec is not None else None
+
     def _become_actor(self, spec: ActorCreationSpec) -> None:
         self.actor_id = spec.actor_id
         self._actor_creation_spec = spec
@@ -893,6 +902,8 @@ class CoreWorker:
         (cf. reference `_raylet.pyx:718 execute_task`)."""
         prev_task_id = getattr(self._tls, "task_id", None)
         self._tls.task_id = spec.task_id
+        prev_pg = getattr(self._tls, "placement_group_id", None)
+        self._tls.placement_group_id = spec.scheduling.placement_group_id
         self._emit_task_event(spec, "RUNNING")
         failed = False
         results = []
@@ -935,6 +946,7 @@ class CoreWorker:
                 del self._tls.task_id
             else:
                 self._tls.task_id = prev_task_id
+            self._tls.placement_group_id = prev_pg
         self._emit_task_event(spec, "FAILED" if failed else "FINISHED")
         try:
             if spec.owner_address == self.address:

@@ -1,0 +1,25 @@
+"""Shared helpers for the Pallas kernels."""
+
+from __future__ import annotations
+
+import jax
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def interpret_mode() -> bool:
+    """Kernels compile with Mosaic on TPU, interpret elsewhere (CI CPU mesh)."""
+    return not on_tpu()
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(a: int, b: int) -> int:
+    return cdiv(a, b) * b

@@ -1,0 +1,135 @@
+"""Flash attention (online-softmax) Pallas kernel.
+
+Forward kernel with O(seq) memory: the [sq, sk] score matrix never hits
+HBM. Grid = (batch*heads, q_blocks, k_blocks) with the k axis innermost —
+sequential on TPU — so a VMEM accumulator carries the running max / sum /
+weighted values across k blocks (the standard online-softmax recurrence).
+
+Backward is recompute-based reference math under `jax.custom_vjp`; the
+training path in `ray_tpu.ops.attention` uses the fused-backward kernel
+for full train steps, this kernel owns the inference/prefill path.
+
+No reference-counterpart: hellofinch/ray delegates all device math to
+torch (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ray_tpu.ops.pallas._util import cdiv, interpret_mode
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                sm_scale: float, causal: bool, block_q: int, block_k: int):
+    i_q = pl.program_id(1)
+    i_k = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(i_k == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Causal: skip k blocks strictly above the diagonal band.
+    should_compute = True
+    if causal:
+        should_compute = i_k * block_k <= i_q * block_q + block_q - 1
+
+    @pl.when(should_compute)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [bq, d]
+        k = k_ref[0].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0].astype(jnp.float32)  # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + i_q * block_q
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + i_k * block_k
+            s = jnp.where(cols <= rows, s, _NEG_INF)
+
+        m_prev = m_ref[:]                       # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                  # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)         # rescale old state
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(i_k == n_k - 1)
+    def _finalize():
+        # Fully-masked rows (can't happen for causal self-attn) guard: l>=1e-30.
+        l = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    grid = (bh, cdiv(sq, bq), cdiv(sk, bk))
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=interpret_mode(),
+    )(q, k, v)
+
+
+def _reference(q, k, v, sm_scale, causal):
+    logits = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask, logits, _NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bqk,bkd->bqd", p, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
+                           sm_scale: float | None = None, causal: bool = True,
+                           block_q: int = 256, block_k: int = 256) -> jax.Array:
+    """Flash attention over [batch*heads, seq, head_dim] tensors."""
+    scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+
+
+def _vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    out = flash_attention_pallas(q, k, v, sm_scale, causal, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _vjp_bwd(sm_scale, causal, block_q, block_k, res, g):
+    q, k, v = res
+    scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    _, vjp = jax.vjp(lambda a, b, c: _reference(a, b, c, scale, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention_pallas.defvjp(_vjp_fwd, _vjp_bwd)

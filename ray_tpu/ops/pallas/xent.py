@@ -1,0 +1,134 @@
+"""Blockwise softmax cross-entropy Pallas kernel.
+
+For a 32k–128k vocab the naive path materializes an fp32 softmax the size
+of the logits — pure HBM traffic. This kernel streams vocab blocks through
+VMEM keeping only running (max, sumexp, correct-logit) per row, and the
+backward emits `softmax - onehot` blockwise from the saved logsumexp, so
+no softmax tensor is ever stored.
+
+No reference-counterpart (hellofinch/ray ships no kernels, SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ray_tpu.ops.pallas._util import cdiv, interpret_mode
+
+_NEG_INF = -1e30
+_BLOCK_ROWS = 256
+_BLOCK_V = 2048
+
+
+def _fwd_kernel(x_ref, label_ref, loss_ref, lse_ref, m_ref, l_ref, c_ref, *,
+                block_v: int):
+    j = pl.program_id(1)
+    n_v = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        c_ref[:] = jnp.zeros_like(c_ref)
+
+    x = x_ref[:].astype(jnp.float32)  # [br, bv]
+    labels = label_ref[:]             # [br, 1] int32
+    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1) + j * block_v
+
+    m_prev = m_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(x, axis=-1, keepdims=True))
+    l_ref[:] = l_ref[:] * jnp.exp(m_prev - m_new) + jnp.sum(
+        jnp.exp(x - m_new), axis=-1, keepdims=True)
+    m_ref[:] = m_new
+    c_ref[:] += jnp.sum(jnp.where(cols == labels, x, 0.0), axis=-1, keepdims=True)
+
+    @pl.when(j == n_v - 1)
+    def _finalize():
+        lse = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
+        lse_ref[:] = lse
+        loss_ref[:] = lse - c_ref[:]
+
+
+def _bwd_kernel(x_ref, label_ref, lse_ref, g_ref, dx_ref, *, block_v: int):
+    j = pl.program_id(1)
+    x = x_ref[:].astype(jnp.float32)
+    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1) + j * block_v
+    p = jnp.exp(x - lse_ref[:])
+    onehot = (cols == label_ref[:]).astype(jnp.float32)
+    dx_ref[:] = ((p - onehot) * g_ref[:]).astype(dx_ref.dtype)
+
+
+def _run_fwd(logits, labels2d):
+    rows, v = logits.shape
+    br = min(_BLOCK_ROWS, rows)
+    bv = min(_BLOCK_V, v)
+    grid = (cdiv(rows, br), cdiv(v, bv))
+    loss, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_v=bv),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((br, bv), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((br, 1), jnp.float32),
+            pltpu.VMEM((br, 1), jnp.float32),
+            pltpu.VMEM((br, 1), jnp.float32),
+        ],
+        interpret=interpret_mode(),
+    )(logits, labels2d)
+    return loss, lse
+
+
+@jax.custom_vjp
+def softmax_cross_entropy_pallas(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-example cross-entropy. logits [N, V] (any dtype), labels [N] int.
+
+    Returns fp32 loss [N]. Gradient flows to logits only.
+    """
+    loss, _ = _run_fwd(logits, labels.astype(jnp.int32).reshape(-1, 1))
+    return loss[:, 0]
+
+
+def _vjp_fwd(logits, labels):
+    labels2d = labels.astype(jnp.int32).reshape(-1, 1)
+    loss, lse = _run_fwd(logits, labels2d)
+    return loss[:, 0], (logits, labels2d, lse)
+
+
+def _vjp_bwd(res, g):
+    logits, labels2d, lse = res
+    rows, v = logits.shape
+    br = min(_BLOCK_ROWS, rows)
+    bv = min(_BLOCK_V, v)
+    grid = (cdiv(rows, br), cdiv(v, bv))
+    dx = pl.pallas_call(
+        functools.partial(_bwd_kernel, block_v=bv),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((br, bv), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((br, bv), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, v), logits.dtype),
+        interpret=interpret_mode(),
+    )(logits, labels2d, lse, g.astype(jnp.float32).reshape(-1, 1))
+    return dx, None
+
+
+softmax_cross_entropy_pallas.defvjp(_vjp_fwd, _vjp_bwd)

@@ -49,18 +49,27 @@ class ASHAScheduler:
         t = int(result.get(self.time_attr, 0))
         if t >= self.max_t:
             return STOP
+        if self.metric not in result:
+            return CONTINUE  # checkpoint-only / heterogeneous report
+        # Record the trial's value at the highest rung it has crossed.
         for rung in reversed(self.rungs):
-            if t >= rung and rung not in trial.rungs_done:
-                trial.rungs_done.add(rung)
-                v = self._val(result)
-                recorded = self._rung_results[rung]
-                recorded.append(v)
-                if len(recorded) >= self.rf:
-                    cutoff = sorted(recorded, reverse=True)[
-                        max(0, len(recorded) // self.rf - 1)]
-                    if v < cutoff:
-                        return STOP
+            if t >= rung and rung not in trial.rung_values:
+                trial.rung_values[rung] = self._val(result)
+                self._rung_results[rung].append(trial.rung_values[rung])
                 break
+        # Re-evaluate the trial's highest recorded rung on EVERY report, not
+        # just at the crossing (`async_hyperband.py:138`): under lockstep
+        # execution the first reporter lands in an empty rung and would never
+        # see a cutoff. Comparing recorded same-rung values is the
+        # synchronous-ASHA criterion — fair across trials at equal budget.
+        if trial.rung_values:
+            rung = max(trial.rung_values)
+            recorded = self._rung_results[rung]
+            if len(recorded) >= self.rf:
+                keep = max(1, len(recorded) // self.rf)
+                cutoff = sorted(recorded, reverse=True)[keep - 1]
+                if trial.rung_values[rung] < cutoff:
+                    return STOP
         return CONTINUE
 
 

@@ -91,7 +91,7 @@ class Trial:
     last_result: Dict[str, Any] = field(default_factory=dict)
     last_checkpoint: Optional[Checkpoint] = None
     error: Optional[str] = None
-    rungs_done: Set[int] = field(default_factory=set)   # ASHA bookkeeping
+    rung_values: Dict[int, float] = field(default_factory=dict)  # ASHA bookkeeping
     last_perturb: int = 0                               # PBT bookkeeping
     history: List[Dict[str, Any]] = field(default_factory=list)
 
@@ -175,7 +175,7 @@ class TrialRunner:
         self._stop_trial(trial, state="PENDING")
         trial.config = new_config
         trial.last_checkpoint = ckpt
-        trial.rungs_done = set()
+        trial.rung_values = {}
 
     # ----------------------------------------------------------- main loop
     def run(self) -> None:

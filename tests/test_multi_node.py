@@ -168,3 +168,22 @@ def test_named_actor_across_nodes(two_node_cluster):
     assert ray_tpu.get(writer.remote(), timeout=60)
     h = ray_tpu.get_actor("reg")
     assert ray_tpu.get(h.get.remote("k")) == 42
+
+
+def test_get_current_placement_group(two_node_cluster):
+    cluster, n1, n2 = two_node_cluster
+    pg = ray_tpu.util.placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where_am_i():
+        import ray_tpu as rt
+
+        cur = rt.util.get_current_placement_group()
+        return None if cur is None else cur.id
+
+    got = ray_tpu.get(
+        where_am_i.options(placement_group=pg).remote(), timeout=60)
+    assert got == pg.id
+    # outside a PG: None
+    assert ray_tpu.get(where_am_i.remote(), timeout=60) is None
