@@ -2,6 +2,7 @@ from ray_tpu.data.datastream import (
     Datastream,
     Dataset,
     DataIterator,
+    GroupedData,
     from_items,
     from_numpy,
     range as range_,
@@ -14,3 +15,5 @@ from ray_tpu.data.datastream import (
 
 # reference-compatible module-level names
 range = range_  # noqa: A001 (shadows builtin deliberately, like ray.data.range)
+
+from ray_tpu.data import preprocessors

@@ -48,7 +48,8 @@ def _block_rows(block: Block) -> List[Any]:
 
 def _rows_to_block(rows: List[Any]) -> Block:
     if rows and isinstance(rows[0], dict) and all(
-            isinstance(v, (int, float, np.number, np.ndarray)) for v in rows[0].values()):
+            isinstance(v, (int, float, str, np.number, np.str_, np.ndarray))
+            for v in rows[0].values()):
         keys = list(rows[0])
         try:
             return {k: np.asarray([r[k] for r in rows]) for k in keys}
@@ -131,33 +132,112 @@ class Datastream:
         return Datastream(self._block_refs, self._ops + [("filter", fn)])
 
     def repartition(self, num_blocks: int) -> "Datastream":
-        ds = self.materialize()
-        blocks = ray_tpu.get(ds._block_refs)
-        whole = _concat_blocks(blocks)
-        n = _block_len(whole)
-        per = max(1, -(-n // num_blocks))
-        new_refs = [ray_tpu.put(_slice_block(whole, i * per, min((i + 1) * per, n)))
-                    for i in builtins.range(num_blocks) if i * per < n or i == 0]
-        return Datastream(new_refs)
+        """Task-based all-to-all repartition (round-robin rows)."""
+        from ray_tpu.data.shuffle import shuffle_refs
+
+        return Datastream(shuffle_refs(
+            self._block_refs, self._ops, mode="random",
+            num_partitions=num_blocks, seed=0))
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Datastream":
-        ds = self.materialize()
-        blocks = ray_tpu.get(ds._block_refs)
-        rows: List[Any] = []
-        for b in blocks:
-            rows.extend(_block_rows(b))
-        rng = np.random.default_rng(seed)
-        idx = rng.permutation(len(rows))
-        rows = [rows[i] for i in idx]
-        nb = max(1, len(ds._block_refs))
-        per = max(1, -(-len(rows) // nb))
-        refs = [ray_tpu.put(_rows_to_block(rows[i:i + per]))
-                for i in builtins.range(0, max(len(rows), 1), per)]
-        return Datastream(refs)
+        """Distributed two-stage shuffle; the driver never sees the rows
+        (cf. reference `_internal/push_based_shuffle.py`)."""
+        from ray_tpu.data.shuffle import shuffle_refs
+
+        return Datastream(shuffle_refs(
+            self._block_refs, self._ops, mode="random", seed=seed))
+
+    def sort(self, key: Union[str, Callable[[Any], Any]],
+             descending: bool = False) -> "Datastream":
+        """Distributed range-partition sort (sample boundaries → partition
+        map tasks → per-range merge tasks; cf. reference sort exchange)."""
+        from ray_tpu.data.shuffle import shuffle_refs
+
+        out = Datastream(shuffle_refs(
+            self._block_refs, self._ops, mode="sort", key=key))
+        if descending:
+            refs = out._block_refs[::-1]
+            rev = ray_tpu.remote(_reverse_block)
+            return Datastream([rev.remote(r) for r in refs])
+        return out
+
+    def groupby(self, key: Union[str, Callable[[Any], Any]]) -> "GroupedData":
+        """Hash-partition rows so each key's rows co-locate, then aggregate
+        per partition (cf. reference `grouped_data.py`)."""
+        from ray_tpu.data.shuffle import shuffle_refs
+
+        refs = shuffle_refs(self._block_refs, self._ops, mode="hash", key=key)
+        return GroupedData(refs, key)
 
     def union(self, other: "Datastream") -> "Datastream":
         a, b = self.materialize(), other.materialize()
         return Datastream(a._block_refs + b._block_refs)
+
+    def zip(self, other: "Datastream") -> "Datastream":
+        """Column-wise zip. Runs as one task per left block that pulls only
+        the overlapping right-side blocks — rows never land on the driver."""
+        a_refs = self._executed_refs()
+        b_refs = other._executed_refs()
+        a_sizes = ray_tpu.get([_count_block.remote(r) for r in a_refs])
+        b_sizes = ray_tpu.get([_count_block.remote(r) for r in b_refs])
+        if sum(a_sizes) != sum(b_sizes):
+            raise ValueError(
+                f"zip requires equal lengths: {sum(a_sizes)} vs {sum(b_sizes)}")
+        b_starts = np.cumsum([0] + b_sizes[:-1]).tolist()
+        merge = ray_tpu.remote(_zip_merge)
+        out_refs, start = [], 0
+        for aref, asz in zip(a_refs, a_sizes):
+            end = start + asz
+            picks, ranges = [], []
+            for bref, bsz, bstart in zip(b_refs, b_sizes, b_starts):
+                bend = bstart + bsz
+                if bend <= start or bstart >= end:
+                    continue
+                picks.append(bref)
+                ranges.append((max(start, bstart) - bstart,
+                               min(end, bend) - bstart))
+            out_refs.append(merge.remote(aref, ranges, *picks))
+            start = end
+        return Datastream(out_refs)
+
+    def limit(self, n: int) -> "Datastream":
+        """First n rows. Executes blocks incrementally and stops as soon as
+        n rows are covered — pending ops never run on the untouched tail."""
+        take = ray_tpu.remote(_limit_exec_block)
+        out_refs, seen = [], 0
+        for ref in self._block_refs:
+            if seen >= n:
+                break
+            out = take.remote(ref, self._ops, n - seen)
+            out_refs.append(out)
+            seen += _block_len(ray_tpu.get(out))
+        return Datastream(out_refs)
+
+    def add_column(self, name: str, fn: Callable[[Block], np.ndarray]) -> "Datastream":
+        def add(block: Block) -> Block:
+            if not isinstance(block, dict):
+                rows = _block_rows(block)
+                block = _rows_to_block(rows)
+                if not isinstance(block, dict):
+                    raise TypeError("add_column requires columnar blocks")
+            out = dict(block)
+            out[name] = np.asarray(fn(block))
+            return out
+
+        return self.map_batches(add)
+
+    def drop_columns(self, cols: List[str]) -> "Datastream":
+        drop = set(cols)
+        return self.map_batches(
+            lambda b: {k: v for k, v in b.items() if k not in drop})
+
+    def select_columns(self, cols: List[str]) -> "Datastream":
+        keep = list(cols)
+        return self.map_batches(lambda b: {k: b[k] for k in keep})
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Datastream":
+        return self.map_batches(
+            lambda b: {mapping.get(k, k): v for k, v in b.items()})
 
     # ----------------------------------------------------------- execution
     def materialize(self) -> "Datastream":
@@ -172,6 +252,67 @@ class Datastream:
     # ----------------------------------------------------------- consumers
     def count(self) -> int:
         return sum(_block_len(b) for b in ray_tpu.get(self._executed_refs()))
+
+    def _column_reduce(self, col: str, block_fn, combine):
+        task = ray_tpu.remote(
+            lambda b, ops: block_fn(_apply_ops(b, ops), col))
+        parts = [p for p in ray_tpu.get(
+            [task.remote(r, self._ops) for r in self._block_refs])
+            if p is not None]
+        if not parts:
+            raise ValueError(f"no rows with column {col!r}")
+        return combine(parts)
+
+    def sum(self, col: str):
+        return self._column_reduce(col, _block_col_sum, lambda ps: sum(ps))
+
+    def min(self, col: str):
+        return self._column_reduce(col, _block_col_min, lambda ps: builtins.min(ps))
+
+    def max(self, col: str):
+        return self._column_reduce(col, _block_col_max, lambda ps: builtins.max(ps))
+
+    def mean(self, col: str):
+        pairs = self._column_reduce(
+            col, _block_col_sum_count, lambda ps: ps)
+        total = sum(p[0] for p in pairs)
+        cnt = sum(p[1] for p in pairs)
+        return total / builtins.max(cnt, 1)
+
+    def std(self, col: str, ddof: int = 1):
+        vals = np.concatenate([np.atleast_1d(v) for v in self._column_values(col)])
+        return float(np.std(vals, ddof=ddof))
+
+    def unique(self, col: str) -> List[Any]:
+        vals = np.concatenate([np.atleast_1d(v) for v in self._column_values(col)])
+        return sorted(np.unique(vals).tolist())
+
+    def _column_values(self, col: str) -> List[np.ndarray]:
+        task = ray_tpu.remote(lambda b, ops: _block_col(_apply_ops(b, ops), col))
+        return [v for v in ray_tpu.get(
+            [task.remote(r, self._ops) for r in self._block_refs]) if v is not None]
+
+    # ------------------------------------------------------------- writers
+    def _write(self, path_prefix: str, ext: str, write_block) -> List[str]:
+        import os
+
+        os.makedirs(path_prefix, exist_ok=True)
+        task = ray_tpu.remote(
+            lambda b, ops, p: write_block(_apply_ops(b, ops), p))
+        paths = [os.path.join(path_prefix, f"part-{i:05d}.{ext}")
+                 for i in builtins.range(len(self._block_refs))]
+        ray_tpu.get([task.remote(r, self._ops, p)
+                     for r, p in zip(self._block_refs, paths)])
+        return paths
+
+    def write_json(self, path: str) -> List[str]:
+        return self._write(path, "json", _write_block_json)
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write(path, "csv", _write_block_csv)
+
+    def write_parquet(self, path: str) -> List[str]:
+        return self._write(path, "parquet", _write_block_parquet)
 
     def take(self, limit: int = 20) -> List[Any]:
         out: List[Any] = []
@@ -253,6 +394,191 @@ class Datastream:
 
 
 Dataset = Datastream  # the reference renamed Dataset->Datastream in this era
+
+
+def _block_col(block: Block, col: str) -> Optional[np.ndarray]:
+    if _block_len(block) == 0:
+        return None
+    if isinstance(block, dict):
+        return np.asarray(block[col])
+    return np.asarray([r[col] for r in _block_rows(block)])
+
+
+def _block_col_sum(block: Block, col: str):
+    v = _block_col(block, col)
+    return None if v is None else v.sum()
+
+
+def _block_col_min(block: Block, col: str):
+    v = _block_col(block, col)
+    return None if v is None else v.min()
+
+
+def _block_col_max(block: Block, col: str):
+    v = _block_col(block, col)
+    return None if v is None else v.max()
+
+
+def _block_col_sum_count(block: Block, col: str):
+    v = _block_col(block, col)
+    return None if v is None else (v.sum(), len(v))
+
+
+def _write_block_json(block: Block, path: str) -> None:
+    import json
+
+    with open(path, "w") as f:
+        for r in _block_rows(block):
+            if isinstance(r, dict):
+                r = {k: (v.item() if isinstance(v, np.generic) else
+                         v.tolist() if isinstance(v, np.ndarray) else v)
+                     for k, v in r.items()}
+            f.write(json.dumps(r) + "\n")
+
+
+def _write_block_csv(block: Block, path: str) -> None:
+    import csv
+
+    rows = _block_rows(block)
+    with open(path, "w", newline="") as f:
+        if not rows:
+            return
+        if isinstance(rows[0], dict):
+            w = csv.DictWriter(f, fieldnames=list(rows[0]))
+            w.writeheader()
+            for r in rows:
+                w.writerow({k: (v.item() if isinstance(v, np.generic) else v)
+                            for k, v in r.items()})
+        else:
+            w = csv.writer(f)
+            for r in rows:
+                w.writerow([r])
+
+
+def _write_block_parquet(block: Block, path: str) -> None:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    if isinstance(block, dict):
+        table = pa.table({k: np.asarray(v) for k, v in block.items()})
+    else:
+        rows = _block_rows(block)
+        cols = {k: [r[k] for r in rows] for k in (rows[0] if rows else {})}
+        table = pa.table(cols)
+    pq.write_table(table, path)
+
+
+def _reverse_block(block: Block) -> Block:
+    if isinstance(block, dict):
+        return {k: np.asarray(v)[::-1].copy() for k, v in block.items()}
+    return list(reversed(_block_rows(block)))
+
+
+def _zip_merge(a_block: Block, ranges: List[tuple], *b_blocks: Block) -> Block:
+    pieces = [_slice_block(b, s, e) for b, (s, e) in zip(b_blocks, ranges)]
+    b_all = _concat_blocks(pieces) if pieces else []
+    rows_a = _block_rows(a_block)
+    rows_b = _block_rows(b_all)
+    merged = []
+    for ra, rb in zip(rows_a, rows_b):
+        ra = ra if isinstance(ra, dict) else {"value": ra}
+        rb = rb if isinstance(rb, dict) else {"value_1": rb}
+        m = dict(ra)
+        for k, v in rb.items():
+            m[k if k not in m else f"{k}_1"] = v
+        merged.append(m)
+    return _rows_to_block(merged)
+
+
+def _limit_exec_block(block: Block, ops: List[tuple], n: int) -> Block:
+    block = _apply_ops(block, ops)
+    return _slice_block(block, 0, min(n, _block_len(block)))
+
+
+@ray_tpu.remote
+def _count_block(block: Block) -> int:
+    return _block_len(block)
+
+
+class GroupedData:
+    """Result of `Datastream.groupby`: per-key aggregations over
+    hash-co-located partitions (reference `python/ray/data/grouped_data.py`)."""
+
+    def __init__(self, block_refs: List[ObjectRef], key):
+        self._refs = block_refs
+        self._key = key
+
+    def _agg(self, init, accum, col: Optional[str], out_name: str) -> Datastream:
+        key = self._key
+
+        def agg_block(block: Block) -> Block:
+            from ray_tpu.data.shuffle import _key_values
+
+            n = _block_len(block)
+            if n == 0:
+                return []
+            kv = _key_values(block, key)
+            rows = _block_rows(block)
+            groups: Dict[Any, Any] = {}
+            for i in builtins.range(n):
+                k = kv[i].item() if hasattr(kv[i], "item") else kv[i]
+                v = rows[i][col] if col is not None else rows[i]
+                groups[k] = accum(groups.get(k, init), v)
+            gname = key if isinstance(key, str) else "key"
+            return _rows_to_block(
+                [{gname: k, out_name: v} for k, v in groups.items()])
+
+        task = ray_tpu.remote(agg_block)
+        return Datastream([task.remote(r) for r in self._refs])
+
+    def count(self) -> Datastream:
+        return self._agg(0, lambda acc, _: acc + 1, None, "count()")
+
+    def sum(self, col: str) -> Datastream:
+        return self._agg(0, lambda acc, v: acc + v, col, f"sum({col})")
+
+    def min(self, col: str) -> Datastream:
+        return self._agg(float("inf"), lambda acc, v: builtins.min(acc, v),
+                         col, f"min({col})")
+
+    def max(self, col: str) -> Datastream:
+        return self._agg(float("-inf"), lambda acc, v: builtins.max(acc, v),
+                         col, f"max({col})")
+
+    def mean(self, col: str) -> Datastream:
+        summed = self._agg((0.0, 0), lambda acc, v: (acc[0] + v, acc[1] + 1),
+                           col, "_sc")
+        gname = self._key if isinstance(self._key, str) else "key"
+
+        def finish(row):
+            s, c = row["_sc"]
+            return {gname: row[gname], f"mean({col})": s / builtins.max(c, 1)}
+
+        return summed.map(finish)
+
+    def map_groups(self, fn: Callable[[List[Any]], Any]) -> Datastream:
+        key = self._key
+
+        def apply(block: Block) -> Block:
+            from ray_tpu.data.shuffle import _key_values
+
+            n = _block_len(block)
+            if n == 0:
+                return []
+            kv = _key_values(block, key)
+            rows = _block_rows(block)
+            groups: Dict[Any, List[Any]] = {}
+            for i in builtins.range(n):
+                k = kv[i].item() if hasattr(kv[i], "item") else kv[i]
+                groups.setdefault(k, []).append(rows[i])
+            out: List[Any] = []
+            for g in groups.values():
+                res = fn(g)
+                out.extend(res if isinstance(res, list) else [res])
+            return _rows_to_block(out)
+
+        task = ray_tpu.remote(apply)
+        return Datastream([task.remote(r) for r in self._refs])
 
 
 @ray_tpu.remote

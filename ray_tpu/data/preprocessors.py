@@ -1,0 +1,178 @@
+"""Preprocessors: fit/transform over Datastreams.
+
+Capability parity with the reference's `python/ray/data/preprocessors/`
+(scalers, encoders, chain, batch mapper, concatenator). Fit statistics are
+computed with distributed column reductions; transform is a lazy
+`map_batches` so it fuses into the block tasks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.datastream import Datastream
+
+
+class Preprocessor:
+    """fit(ds) learns state; transform(ds) applies it lazily."""
+
+    _fitted = False
+
+    def fit(self, ds: Datastream) -> "Preprocessor":
+        self._fit(ds)
+        self._fitted = True
+        return self
+
+    def transform(self, ds: Datastream) -> Datastream:
+        if not self._fitted and self._needs_fit():
+            raise RuntimeError(f"{type(self).__name__} must be fit first")
+        return ds.map_batches(self._transform_batch)
+
+    def fit_transform(self, ds: Datastream) -> Datastream:
+        return self.fit(ds).transform(ds)
+
+    def transform_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        return self._transform_batch(batch)
+
+    # -- subclass hooks
+    def _needs_fit(self) -> bool:
+        return True
+
+    def _fit(self, ds: Datastream) -> None:
+        pass
+
+    def _transform_batch(self, batch):
+        raise NotImplementedError
+
+
+class StandardScaler(Preprocessor):
+    """(x - mean) / std per column (reference `preprocessors/scaler.py`)."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+        self.stats: Dict[str, tuple] = {}
+
+    def _fit(self, ds: Datastream) -> None:
+        for c in self.columns:
+            self.stats[c] = (ds.mean(c), ds.std(c, ddof=0) or 1.0)
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            mean, std = self.stats[c]
+            out[c] = (np.asarray(batch[c], dtype=np.float64) - mean) / (std or 1.0)
+        return out
+
+
+class MinMaxScaler(Preprocessor):
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+        self.stats: Dict[str, tuple] = {}
+
+    def _fit(self, ds: Datastream) -> None:
+        for c in self.columns:
+            lo, hi = ds.min(c), ds.max(c)
+            self.stats[c] = (float(lo), float(hi))
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            lo, hi = self.stats[c]
+            rng = (hi - lo) or 1.0
+            out[c] = (np.asarray(batch[c], dtype=np.float64) - lo) / rng
+        return out
+
+
+class LabelEncoder(Preprocessor):
+    def __init__(self, label_column: str):
+        self.column = label_column
+        self.classes: List[Any] = []
+
+    def _fit(self, ds: Datastream) -> None:
+        self.classes = ds.unique(self.column)
+        self._index = {c: i for i, c in enumerate(self.classes)}
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        out[self.column] = np.asarray(
+            [self._index[v.item() if hasattr(v, "item") else v]
+             for v in np.atleast_1d(batch[self.column])])
+        return out
+
+
+class OneHotEncoder(Preprocessor):
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+        self.classes: Dict[str, List[Any]] = {}
+
+    def _fit(self, ds: Datastream) -> None:
+        for c in self.columns:
+            self.classes[c] = ds.unique(c)
+
+    def _transform_batch(self, batch):
+        out = {k: v for k, v in batch.items() if k not in self.columns}
+        for c in self.columns:
+            vals = np.atleast_1d(batch[c])
+            for cls in self.classes[c]:
+                out[f"{c}_{cls}"] = (vals == cls).astype(np.int64)
+        return out
+
+
+class Concatenator(Preprocessor):
+    """Pack feature columns into one float matrix column (the layout
+    `iter_batches` feeds straight to `jax.device_put`)."""
+
+    def __init__(self, include: Optional[List[str]] = None,
+                 exclude: Optional[List[str]] = None,
+                 output_column_name: str = "concat_out",
+                 dtype=np.float32):
+        self.include = include
+        self.exclude = set(exclude or [])
+        self.out = output_column_name
+        self.dtype = dtype
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _transform_batch(self, batch):
+        cols = self.include or [k for k in batch if k not in self.exclude]
+        mats = [np.asarray(batch[c], dtype=self.dtype).reshape(len(batch[c]), -1)
+                for c in cols]
+        out = {k: v for k, v in batch.items() if k not in cols}
+        out[self.out] = np.concatenate(mats, axis=1)
+        return out
+
+
+class BatchMapper(Preprocessor):
+    def __init__(self, fn: Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]):
+        self.fn = fn
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _transform_batch(self, batch):
+        return self.fn(batch)
+
+
+class Chain(Preprocessor):
+    def __init__(self, *preprocessors: Preprocessor):
+        self.stages = list(preprocessors)
+
+    def fit(self, ds: Datastream) -> "Chain":
+        for i, p in enumerate(self.stages):
+            p.fit(ds)
+            ds = p.transform(ds)
+        self._fitted = True
+        return self
+
+    def transform(self, ds: Datastream) -> Datastream:
+        for p in self.stages:
+            ds = p.transform(ds)
+        return ds
+
+    def _transform_batch(self, batch):
+        for p in self.stages:
+            batch = p._transform_batch(batch)
+        return batch

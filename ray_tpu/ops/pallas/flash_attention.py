@@ -28,7 +28,8 @@ _NEG_INF = -1e30
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                sm_scale: float, causal: bool, block_q: int, block_k: int):
+                sm_scale: float, causal: bool, block_q: int, block_k: int,
+                sq: int, sk: int):
     i_q = pl.program_id(1)
     i_k = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -39,23 +40,34 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # Causal: skip k blocks strictly above the diagonal band.
+    # Causal: row r attends keys <= r + (sk - sq) (diagonal offset aligns
+    # the query window to the END of the key axis — the KV-cache decode
+    # convention, matching _reference's tril(k=sk-sq)). Skip k blocks
+    # entirely above the band.
+    offset = sk - sq
     should_compute = True
     if causal:
-        should_compute = i_k * block_k <= i_q * block_q + block_q - 1
+        should_compute = (
+            i_k * block_k <= i_q * block_q + block_q - 1 + offset)
 
     @pl.when(should_compute)
     def _compute():
         q = q_ref[0].astype(jnp.float32)  # [bq, d]
         k = k_ref[0].astype(jnp.float32)  # [bk, d]
         v = v_ref[0].astype(jnp.float32)  # [bk, d]
+        # zero v's padded tail rows: their p weights are 0, but 0*garbage
+        # (NaN in interpret mode) would still poison the p@v accumulate
+        v_rows = jax.lax.broadcasted_iota(jnp.int32, v.shape, 0) + i_k * block_k
+        v = jnp.where(v_rows < sk, v, 0.0)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + i_k * block_k
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + i_q * block_q
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + i_k * block_k
-            s = jnp.where(cols <= rows, s, _NEG_INF)
+            s = jnp.where(cols <= rows + offset, s, _NEG_INF)
+        # mask the padded key tail of the last block (sk % block_k != 0)
+        s = jnp.where(cols < sk, s, _NEG_INF)
 
         m_prev = m_ref[:]                       # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -82,7 +94,7 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
     grid = (bh, cdiv(sq, bq), cdiv(sk, bk))
     return pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=bq, block_k=bk),
+                          block_q=bq, block_k=bk, sq=sq, sk=sk),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),

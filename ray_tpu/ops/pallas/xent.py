@@ -26,7 +26,7 @@ _BLOCK_V = 2048
 
 
 def _fwd_kernel(x_ref, label_ref, loss_ref, lse_ref, m_ref, l_ref, c_ref, *,
-                block_v: int):
+                block_v: int, vocab: int):
     j = pl.program_id(1)
     n_v = pl.num_programs(1)
 
@@ -36,9 +36,11 @@ def _fwd_kernel(x_ref, label_ref, loss_ref, lse_ref, m_ref, l_ref, c_ref, *,
         l_ref[:] = jnp.zeros_like(l_ref)
         c_ref[:] = jnp.zeros_like(c_ref)
 
-    x = x_ref[:].astype(jnp.float32)  # [br, bv]
     labels = label_ref[:]             # [br, 1] int32
-    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1) + j * block_v
+    cols = jax.lax.broadcasted_iota(
+        jnp.int32, (x_ref.shape[0], x_ref.shape[1]), 1) + j * block_v
+    # mask the padded vocab tail of the last block (vocab % block_v != 0)
+    x = jnp.where(cols < vocab, x_ref[:].astype(jnp.float32), _NEG_INF)
 
     m_prev = m_ref[:]
     m_new = jnp.maximum(m_prev, jnp.max(x, axis=-1, keepdims=True))
@@ -54,11 +56,12 @@ def _fwd_kernel(x_ref, label_ref, loss_ref, lse_ref, m_ref, l_ref, c_ref, *,
         loss_ref[:] = lse - c_ref[:]
 
 
-def _bwd_kernel(x_ref, label_ref, lse_ref, g_ref, dx_ref, *, block_v: int):
+def _bwd_kernel(x_ref, label_ref, lse_ref, g_ref, dx_ref, *, block_v: int,
+                vocab: int):
     j = pl.program_id(1)
     x = x_ref[:].astype(jnp.float32)
     cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1) + j * block_v
-    p = jnp.exp(x - lse_ref[:])
+    p = jnp.where(cols < vocab, jnp.exp(x - lse_ref[:]), 0.0)
     onehot = (cols == label_ref[:]).astype(jnp.float32)
     dx_ref[:] = ((p - onehot) * g_ref[:]).astype(dx_ref.dtype)
 
@@ -69,7 +72,7 @@ def _run_fwd(logits, labels2d):
     bv = min(_BLOCK_V, v)
     grid = (cdiv(rows, br), cdiv(v, bv))
     loss, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, block_v=bv),
+        functools.partial(_fwd_kernel, block_v=bv, vocab=v),
         grid=grid,
         in_specs=[
             pl.BlockSpec((br, bv), lambda i, j: (i, j), memory_space=pltpu.VMEM),
@@ -116,7 +119,7 @@ def _vjp_bwd(res, g):
     bv = min(_BLOCK_V, v)
     grid = (cdiv(rows, br), cdiv(v, bv))
     dx = pl.pallas_call(
-        functools.partial(_bwd_kernel, block_v=bv),
+        functools.partial(_bwd_kernel, block_v=bv, vocab=v),
         grid=grid,
         in_specs=[
             pl.BlockSpec((br, bv), lambda i, j: (i, j), memory_space=pltpu.VMEM),

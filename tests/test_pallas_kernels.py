@@ -99,3 +99,48 @@ def test_int8_quant_roundtrip():
     err = np.abs(np.asarray(back) - np.asarray(x))
     bound = np.asarray(scales) * 0.51
     assert (err <= bound).all()
+
+
+def test_flash_attention_kv_cache_decode():
+    """sq != sk: causal offset must align query window to end of keys."""
+    from ray_tpu.ops.pallas import flash_attention_pallas
+    from ray_tpu.ops.pallas.flash_attention import _reference
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 32), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (2, 200, 32), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (2, 200, 32), jnp.float32)
+    out = flash_attention_pallas(q, k, v, None, True, 4, 64)
+    ref = _reference(q, k, v, 1.0 / (32 ** 0.5), True)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_flash_attention_ragged_key_tail():
+    """sk not a multiple of block_k: padded key columns must be masked."""
+    from ray_tpu.ops.pallas import flash_attention_pallas
+    from ray_tpu.ops.pallas.flash_attention import _reference
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 50, 32), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 50, 32), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 50, 32), jnp.float32)
+    out = flash_attention_pallas(q, k, v, None, False, 32, 32)
+    ref = _reference(q, k, v, 1.0 / (32 ** 0.5), False)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_xent_ragged_vocab():
+    """V not a multiple of the vocab block: pad columns must not leak."""
+    from ray_tpu.ops.pallas import softmax_cross_entropy_pallas
+
+    logits = jax.random.normal(jax.random.PRNGKey(0), (8, 3000), jnp.float32)
+    labels = jax.random.randint(jax.random.PRNGKey(1), (8,), 0, 3000)
+    loss = softmax_cross_entropy_pallas(logits, labels)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    ref = lse - logits[jnp.arange(8), labels]
+    np.testing.assert_allclose(loss, ref, rtol=1e-5, atol=1e-5)
+    g = jax.grad(lambda l: jnp.mean(softmax_cross_entropy_pallas(l, labels)))(logits)
+
+    def ref_loss(l):
+        return jnp.mean(jax.nn.logsumexp(l, axis=-1) - l[jnp.arange(8), labels])
+
+    gr = jax.grad(ref_loss)(logits)
+    np.testing.assert_allclose(g, gr, rtol=1e-4, atol=1e-5)
