@@ -1,3 +1,7 @@
 from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
 from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.dqn import DQN, DQNConfig
+from ray_tpu.rllib.impala import IMPALA, ImpalaConfig
+from ray_tpu.rllib.es import ES, ESConfig
+from ray_tpu.rllib.replay_buffers import ReplayBuffer, PrioritizedReplayBuffer

@@ -1,0 +1,275 @@
+"""DQN: epsilon-greedy sampling fleet + replay buffer + double-DQN learner.
+
+Mirrors the reference's DQN anatomy (`rllib/algorithms/dqn/dqn.py`:
+sample → store → replay-sample → TD update → target sync) with the learner
+as a single jitted JAX update (double-DQN targets, optional prioritized
+replay with importance weights).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
+from ray_tpu.rllib.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
+
+
+from ray_tpu.rllib.models import init_mlp, mlp_forward, mlp_forward_np
+
+
+def init_q_params(rng_seed: int, obs_dim: int, num_actions: int,
+                  hidden: Tuple[int, ...] = (64, 64)) -> Dict[str, Any]:
+    return init_mlp(np.random.default_rng(rng_seed),
+                    (obs_dim, *hidden, num_actions),
+                    final_scale=np.sqrt(2.0 / hidden[-1]))
+
+
+def q_apply(params, obs, n_layers: int = 3):
+    return mlp_forward(params, obs, n_layers)
+
+
+_q_apply_np = mlp_forward_np
+
+
+@ray_tpu.remote
+class EpsilonGreedyWorker:
+    """Env-stepping actor collecting transitions under epsilon-greedy."""
+
+    def __init__(self, env_maker, num_envs: int, seed: int, obs_dim: int,
+                 num_actions: int):
+        self.vec = VectorEnv(env_maker, num_envs, seed)
+        self.obs = self.vec.reset()
+        self.rng = np.random.default_rng(seed)
+        self.params = None
+        self.num_actions = num_actions
+        self._ep_returns = np.zeros(num_envs, np.float32)
+        self._completed: List[float] = []
+
+    def set_weights(self, params) -> bool:
+        self.params = {k: np.asarray(v) for k, v in params.items()}
+        return True
+
+    def sample(self, num_steps: int, epsilon: float) -> Dict[str, np.ndarray]:
+        N = self.vec.num_envs
+        cols = {k: [] for k in ("obs", "actions", "rewards", "next_obs", "dones")}
+        for _ in range(num_steps):
+            q = _q_apply_np(self.params, self.obs)
+            greedy = q.argmax(-1)
+            explore = self.rng.random(N) < epsilon
+            random_a = self.rng.integers(0, self.num_actions, N)
+            actions = np.where(explore, random_a, greedy)
+            prev_obs = self.obs
+            self.obs, rewards, dones, _ = self.vec.step(actions)
+            cols["obs"].append(prev_obs)
+            cols["actions"].append(actions)
+            cols["rewards"].append(rewards)
+            cols["next_obs"].append(self.obs)
+            cols["dones"].append(dones.astype(np.float32))
+            self._ep_returns += rewards
+            for i, d in enumerate(dones):
+                if d:
+                    self._completed.append(float(self._ep_returns[i]))
+                    self._ep_returns[i] = 0.0
+        out = {k: np.concatenate(v) if v[0].ndim > 1 else np.stack(v).reshape(-1)
+               for k, v in cols.items()}
+        ep, self._completed = self._completed, []
+        out["episode_returns"] = np.array(ep, np.float32)
+        return out
+
+
+class DQNLearner:
+    """Double-DQN TD update, jitted."""
+
+    def __init__(self, obs_dim: int, num_actions: int, lr: float,
+                 gamma: float, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.params = init_q_params(seed, obs_dim, num_actions)
+        self.target_params = {k: v.copy() for k, v in self.params.items()}
+        self.optimizer = optax.adam(lr)
+        self.opt_state = self.optimizer.init(self.params)
+
+        def loss_fn(params, target_params, batch):
+            q = q_apply(params, batch["obs"])
+            q_taken = jnp.take_along_axis(
+                q, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+            # double DQN: online net picks argmax, target net evaluates
+            next_online = q_apply(params, batch["next_obs"])
+            next_a = jnp.argmax(next_online, axis=-1)
+            next_target = q_apply(target_params, batch["next_obs"])
+            next_q = jnp.take_along_axis(next_target, next_a[:, None], axis=-1)[:, 0]
+            target = batch["rewards"] + gamma * (1.0 - batch["dones"]) * \
+                jax.lax.stop_gradient(next_q)
+            td = q_taken - target
+            w = batch.get("weights", jnp.ones_like(td))
+            loss = (w * td ** 2).mean()
+            return loss, td
+
+        def update(params, opt_state, target_params, batch):
+            (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td
+
+        self._update = jax.jit(update)
+
+    def update_batch(self, batch: Dict[str, np.ndarray]):
+        import jax
+
+        self.params, self.opt_state, loss, td = self._update(
+            self.params, self.opt_state, self.target_params, batch)
+        return float(loss), np.asarray(jax.device_get(td))
+
+    def sync_target(self) -> None:
+        import jax
+
+        self.target_params = jax.device_get(self.params)
+
+    def get_weights(self):
+        import jax
+
+        return {k: np.asarray(v) for k, v in jax.device_get(self.params).items()}
+
+    def set_weights(self, weights):
+        import jax.numpy as jnp
+
+        self.params = {k: jnp.asarray(v) for k, v in weights.items()}
+        self.target_params = {k: np.asarray(v) for k, v in weights.items()}
+        self.opt_state = self.optimizer.init(self.params)
+
+
+class DQNConfig:
+    def __init__(self):
+        self.env_maker: Callable[[int], Any] = lambda seed: CartPoleEnv(seed)
+        self.obs_dim = CartPoleEnv.observation_dim
+        self.num_actions = CartPoleEnv.num_actions
+        self.num_rollout_workers = 2
+        self.num_envs_per_worker = 2
+        self.rollout_fragment_length = 32
+        self.lr = 5e-4
+        self.gamma = 0.99
+        self.buffer_capacity = 50_000
+        self.prioritized_replay = False
+        self.train_batch_size = 64
+        self.num_updates_per_step = 8
+        self.target_update_interval = 4     # in training_steps
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_steps = 50
+        self.learning_starts = 200           # min transitions before updates
+        self.seed = 0
+
+    def environment(self, env_maker=None, *, obs_dim=None, num_actions=None):
+        if env_maker is not None:
+            self.env_maker = env_maker
+        if obs_dim is not None:
+            self.obs_dim = obs_dim
+        if num_actions is not None:
+            self.num_actions = num_actions
+        return self
+
+    def rollouts(self, *, num_rollout_workers=None, num_envs_per_worker=None,
+                 rollout_fragment_length=None):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown DQN option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "DQN":
+        return DQN({"dqn_config": self})
+
+
+class DQN(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        cfg: DQNConfig = config.get("dqn_config") or DQNConfig()
+        self.cfg = cfg
+        self.learner = DQNLearner(cfg.obs_dim, cfg.num_actions, cfg.lr,
+                                  cfg.gamma, cfg.seed)
+        if cfg.prioritized_replay:
+            self.buffer = PrioritizedReplayBuffer(cfg.buffer_capacity,
+                                                  seed=cfg.seed)
+        else:
+            self.buffer = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
+        self.workers = [
+            EpsilonGreedyWorker.options(num_cpus=1).remote(
+                cfg.env_maker, cfg.num_envs_per_worker,
+                cfg.seed + 1000 * (i + 1), cfg.obs_dim, cfg.num_actions)
+            for i in range(cfg.num_rollout_workers)]
+        self._broadcast()
+        self._reward_history: List[float] = []
+
+    def _broadcast(self) -> None:
+        w = self.learner.get_weights()
+        ray_tpu.get([wk.set_weights.remote(w) for wk in self.workers])
+
+    def _epsilon(self) -> float:
+        cfg = self.cfg
+        frac = min(1.0, self.iteration / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        eps = self._epsilon()
+        samples = ray_tpu.get([
+            wk.sample.remote(cfg.rollout_fragment_length, eps)
+            for wk in self.workers])
+        n_new = 0
+        for s in samples:
+            ep = s.pop("episode_returns")
+            self._reward_history.extend(ep.tolist())
+            self.buffer.add_batch(s)
+            n_new += len(s["actions"])
+        self._reward_history = self._reward_history[-100:]
+
+        losses = []
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_step):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                idx = batch.pop("batch_indexes", None)
+                loss, td = self.learner.update_batch(batch)
+                losses.append(loss)
+                if idx is not None:
+                    self.buffer.update_priorities(idx, td)
+            if self.iteration % cfg.target_update_interval == 0:
+                self.learner.sync_target()
+            self._broadcast()
+        mean_reward = float(np.mean(self._reward_history)) \
+            if self._reward_history else 0.0
+        return {
+            "episode_reward_mean": mean_reward,
+            "epsilon": eps,
+            "buffer_size": len(self.buffer),
+            "num_env_steps_sampled": n_new,
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+        }
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.learner.set_weights(weights)
+        self._broadcast()
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

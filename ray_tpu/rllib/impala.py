@@ -1,0 +1,241 @@
+"""IMPALA: asynchronous sampling with V-trace off-policy correction.
+
+Mirrors the reference's IMPALA control flow (`rllib/algorithms/impala/`):
+rollout workers sample continuously with whatever weights they last saw;
+the learner consumes batches as they land (`ray.wait` on in-flight sample
+futures) and corrects the policy lag with V-trace (Espeholt et al. 2018):
+
+    rho_t = min(rho_bar, pi(a|s)/mu(a|s))
+    v_s   = V(s) + sum_k gamma^k (prod c) rho delta_k
+
+The learner update is one jitted JAX function; scan carries the V-trace
+recursion so the whole correction compiles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.env import CartPoleEnv
+from ray_tpu.rllib.ppo import RolloutWorker, init_policy_params, policy_apply
+
+
+def vtrace_targets(behavior_logp, target_logp, rewards, values, last_value,
+                   dones, gamma: float, rho_bar: float = 1.0,
+                   c_bar: float = 1.0):
+    """V-trace value targets + policy-gradient advantages over [T, N].
+
+    Pure jnp; runs under jit via lax.scan (time-reversed recursion).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    rho = jnp.minimum(jnp.exp(target_logp - behavior_logp), rho_bar)
+    c = jnp.minimum(jnp.exp(target_logp - behavior_logp), c_bar)
+    next_values = jnp.concatenate([values[1:], last_value[None]], axis=0)
+    nonterminal = 1.0 - dones
+    deltas = rho * (rewards + gamma * next_values * nonterminal - values)
+
+    def body(acc, xs):
+        delta_t, c_t, nt_t = xs
+        acc = delta_t + gamma * c_t * nt_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        body, jnp.zeros_like(last_value),
+        (deltas, c, nonterminal), reverse=True)
+    vs = vs_minus_v + values
+    next_vs = jnp.concatenate([vs[1:], last_value[None]], axis=0)
+    pg_adv = rho * (rewards + gamma * next_vs * nonterminal - values)
+    return vs, pg_adv
+
+
+class ImpalaLearner:
+    def __init__(self, obs_dim: int, num_actions: int, lr: float,
+                 gamma: float, vf_coeff: float, entropy_coeff: float,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.params = init_policy_params(seed, obs_dim, num_actions)
+        self.optimizer = optax.rmsprop(lr, decay=0.99, eps=0.1)
+        self.opt_state = self.optimizer.init(self.params)
+
+        def loss_fn(params, batch):
+            T, N = batch["actions"].shape
+            logits, values = policy_apply(params, batch["obs"])  # [T,N,A],[T,N]
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+            vs, pg_adv = vtrace_targets(
+                batch["logp"], jax.lax.stop_gradient(logp), batch["rewards"],
+                jax.lax.stop_gradient(values), batch["last_value"],
+                batch["dones"], gamma)
+            pg_loss = -(logp * jax.lax.stop_gradient(pg_adv)).mean()
+            vf_loss = 0.5 * ((values - jax.lax.stop_gradient(vs)) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pg_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+            return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        self._update = jax.jit(update)
+
+    def update_batch(self, batch) -> Dict[str, float]:
+        import jax
+
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, batch)
+        return {k: float(v) for k, v in jax.device_get(aux).items()}
+
+    def get_weights(self):
+        import jax
+
+        return {k: np.asarray(v) for k, v in jax.device_get(self.params).items()}
+
+    def set_weights(self, weights):
+        import jax.numpy as jnp
+
+        self.params = {k: jnp.asarray(v) for k, v in weights.items()}
+        self.opt_state = self.optimizer.init(self.params)
+
+
+class ImpalaConfig:
+    def __init__(self):
+        self.env_maker: Callable[[int], Any] = lambda seed: CartPoleEnv(seed)
+        self.obs_dim = CartPoleEnv.observation_dim
+        self.num_actions = CartPoleEnv.num_actions
+        self.num_rollout_workers = 2
+        self.num_envs_per_worker = 4
+        self.rollout_fragment_length = 64
+        self.lr = 1e-3
+        self.gamma = 0.99
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.max_inflight = 2          # sample futures in flight per worker
+        self.broadcast_interval = 1    # learner updates between weight pushes
+        self.seed = 0
+
+    def environment(self, env_maker=None, *, obs_dim=None, num_actions=None):
+        if env_maker is not None:
+            self.env_maker = env_maker
+        if obs_dim is not None:
+            self.obs_dim = obs_dim
+        if num_actions is not None:
+            self.num_actions = num_actions
+        return self
+
+    def rollouts(self, *, num_rollout_workers=None, num_envs_per_worker=None,
+                 rollout_fragment_length=None):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown IMPALA option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "IMPALA":
+        return IMPALA({"impala_config": self})
+
+
+class IMPALA(Algorithm):
+    """Async actor-learner: keeps `max_inflight` sample calls outstanding
+    per worker; each training_step consumes whatever has landed."""
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        cfg: ImpalaConfig = config.get("impala_config") or ImpalaConfig()
+        self.cfg = cfg
+        self.learner = ImpalaLearner(
+            cfg.obs_dim, cfg.num_actions, cfg.lr, cfg.gamma, cfg.vf_coeff,
+            cfg.entropy_coeff, cfg.seed)
+        self.workers = [
+            RolloutWorker.options(num_cpus=1).remote(
+                cfg.env_maker, cfg.num_envs_per_worker,
+                cfg.seed + 1000 * (i + 1), cfg.obs_dim, cfg.num_actions)
+            for i in range(cfg.num_rollout_workers)]
+        w = self.learner.get_weights()
+        ray_tpu.get([wk.set_weights.remote(w) for wk in self.workers])
+        self._inflight: Dict[Any, int] = {}   # future -> worker index
+        for i, wk in enumerate(self.workers):
+            for _ in range(cfg.max_inflight):
+                self._inflight[wk.sample.remote(cfg.rollout_fragment_length)] = i
+        self._reward_history: List[float] = []
+        self._updates_since_broadcast = 0
+        # always-present loss keys so callers never KeyError on a quiet step
+        self._last_stats: Dict[str, float] = {
+            "total_loss": float("nan"), "policy_loss": float("nan"),
+            "vf_loss": float("nan"), "entropy": float("nan")}
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        done, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                               timeout=30.0)
+        n_steps = 0
+        for ref in done:
+            widx = self._inflight.pop(ref)
+            wk = self.workers[widx]
+            try:
+                batch = ray_tpu.get(ref)
+            except Exception:
+                # worker died mid-sample (reference FaultAwareApply): push
+                # current weights (it may have restarted) and resubmit
+                wk.set_weights.remote(self.learner.get_weights())
+                self._inflight[wk.sample.remote(cfg.rollout_fragment_length)] = widx
+                continue
+            self._reward_history.extend(batch.pop("episode_returns").tolist())
+            batch.pop("values", None)  # learner recomputes values on-device
+            jb = {k: jnp.asarray(v) for k, v in batch.items()}
+            self._last_stats = self.learner.update_batch(jb)
+            n_steps += batch["actions"].size
+            self._updates_since_broadcast += 1
+            if self._updates_since_broadcast >= cfg.broadcast_interval:
+                # push fresh weights only to the worker we're about to relaunch
+                wk.set_weights.remote(self.learner.get_weights())
+                self._updates_since_broadcast = 0
+            self._inflight[wk.sample.remote(cfg.rollout_fragment_length)] = widx
+        stats = self._last_stats
+        self._reward_history = self._reward_history[-100:]
+        mean_reward = float(np.mean(self._reward_history)) \
+            if self._reward_history else 0.0
+        return {
+            "episode_reward_mean": mean_reward,
+            "num_env_steps_sampled": n_steps,
+            **stats,
+        }
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.learner.set_weights(weights)
+        w = self.learner.get_weights()
+        ray_tpu.get([wk.set_weights.remote(w) for wk in self.workers])
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

@@ -25,29 +25,23 @@ from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
 # ------------------------------------------------------------- policy model
 
 
+from ray_tpu.rllib.models import init_mlp, mlp_hidden
+
+
 def init_policy_params(rng_seed: int, obs_dim: int, num_actions: int,
                        hidden: Tuple[int, ...] = (64, 64)) -> Dict[str, Any]:
     rng = np.random.default_rng(rng_seed)
-    sizes = (obs_dim, *hidden)
-    params: Dict[str, Any] = {}
-    for i in range(len(sizes) - 1):
-        params[f"w{i}"] = (rng.standard_normal((sizes[i], sizes[i + 1]))
-                           * np.sqrt(2.0 / sizes[i])).astype(np.float32)
-        params[f"b{i}"] = np.zeros(sizes[i + 1], np.float32)
-    params["w_pi"] = (rng.standard_normal((sizes[-1], num_actions)) * 0.01).astype(np.float32)
+    params = init_mlp(rng, (obs_dim, *hidden))
+    params["w_pi"] = (rng.standard_normal((hidden[-1], num_actions)) * 0.01).astype(np.float32)
     params["b_pi"] = np.zeros(num_actions, np.float32)
-    params["w_v"] = (rng.standard_normal((sizes[-1], 1)) * 1.0).astype(np.float32)
+    params["w_v"] = (rng.standard_normal((hidden[-1], 1)) * 1.0).astype(np.float32)
     params["b_v"] = np.zeros(1, np.float32)
     return params
 
 
 def policy_apply(params, obs, n_hidden: int = 2):
     """Returns (logits, value). Works under numpy AND jax.numpy."""
-    import jax.numpy as jnp
-
-    x = obs
-    for i in range(n_hidden):
-        x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
+    x = mlp_hidden(params, obs, n_hidden)
     logits = x @ params["w_pi"] + params["b_pi"]
     value = (x @ params["w_v"] + params["b_v"])[..., 0]
     return logits, value

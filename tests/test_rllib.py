@@ -88,3 +88,115 @@ def test_ppo_save_restore(ray_start_regular):
     for k in w1:
         np.testing.assert_array_equal(w1[k], w2[k])
     algo2.stop()
+
+
+def test_replay_buffer_ring_and_sampling():
+    from ray_tpu.rllib.replay_buffers import ReplayBuffer
+
+    buf = ReplayBuffer(capacity=10, seed=0)
+    buf.add_batch({"x": np.arange(8, dtype=np.float32)})
+    assert len(buf) == 8
+    buf.add_batch({"x": np.arange(8, 16, dtype=np.float32)})
+    assert len(buf) == 10  # wrapped
+    s = buf.sample(32)
+    assert s["x"].shape == (32,)
+    # oldest entries (0..5) were overwritten
+    assert s["x"].min() >= 6
+
+
+def test_prioritized_buffer_weights_and_update():
+    from ray_tpu.rllib.replay_buffers import PrioritizedReplayBuffer
+
+    buf = PrioritizedReplayBuffer(capacity=100, seed=0)
+    buf.add_batch({"x": np.arange(50, dtype=np.float32)})
+    s = buf.sample(16)
+    assert "weights" in s and "batch_indexes" in s
+    assert s["weights"].max() <= 1.0 + 1e-6
+    buf.update_priorities(s["batch_indexes"], np.ones(16) * 5.0)
+    # prioritized entries should now dominate sampling
+    s2 = buf.sample(256)
+    hit = np.isin(s2["batch_indexes"], s["batch_indexes"]).mean()
+    assert hit > 0.3
+
+
+def test_vtrace_reduces_to_gae_like_targets_on_policy():
+    """On-policy (rho=1): vs must equal discounted TD(lambda=1)-style returns."""
+    import jax.numpy as jnp
+    from ray_tpu.rllib.impala import vtrace_targets
+
+    T, N = 5, 3
+    rng = np.random.default_rng(0)
+    rewards = jnp.asarray(rng.normal(size=(T, N)).astype(np.float32))
+    values = jnp.asarray(rng.normal(size=(T, N)).astype(np.float32))
+    last_value = jnp.asarray(rng.normal(size=(N,)).astype(np.float32))
+    dones = jnp.zeros((T, N), jnp.float32)
+    logp = jnp.asarray(rng.normal(size=(T, N)).astype(np.float32))
+    vs, pg_adv = vtrace_targets(logp, logp, rewards, values, last_value,
+                                dones, gamma=0.9)
+    # manual recursion with rho=c=1
+    v_np = np.asarray(values)
+    r_np = np.asarray(rewards)
+    nv = np.asarray(last_value)
+    expect = np.zeros((T, N), np.float32)
+    acc = np.zeros(N, np.float32)
+    next_v = nv
+    for t in reversed(range(T)):
+        delta = r_np[t] + 0.9 * next_v - v_np[t]
+        acc = delta + 0.9 * acc
+        expect[t] = acc + v_np[t]
+        next_v = v_np[t]
+    np.testing.assert_allclose(np.asarray(vs), expect, rtol=1e-5, atol=1e-5)
+
+
+def test_dqn_trains_on_cartpole(ray_start_regular):
+    from ray_tpu.rllib import DQNConfig
+
+    algo = (DQNConfig()
+            .rollouts(num_rollout_workers=1, num_envs_per_worker=2,
+                      rollout_fragment_length=64)
+            .training(learning_starts=64, num_updates_per_step=4,
+                      epsilon_decay_steps=10)
+            .build())
+    try:
+        last = {}
+        for _ in range(6):
+            last = algo.train()
+        assert last["buffer_size"] > 64
+        assert np.isfinite(last["loss"])
+        assert last["episode_reward_mean"] > 0
+    finally:
+        algo.stop()
+
+
+def test_impala_trains_on_cartpole(ray_start_regular):
+    from ray_tpu.rllib import ImpalaConfig
+
+    algo = (ImpalaConfig()
+            .rollouts(num_rollout_workers=1, num_envs_per_worker=2,
+                      rollout_fragment_length=32)
+            .build())
+    try:
+        last = {}
+        for _ in range(5):
+            last = algo.train()
+        assert last["num_env_steps_sampled"] > 0
+        assert np.isfinite(last["total_loss"])
+    finally:
+        algo.stop()
+
+
+def test_es_improves_on_cartpole(ray_start_regular):
+    from ray_tpu.rllib import ESConfig
+
+    algo = (ESConfig()
+            .training(num_workers=2, episodes_per_batch=8,
+                      max_episode_steps=200)
+            .build())
+    try:
+        first = algo.train()["episode_reward_mean"]
+        last = first
+        for _ in range(4):
+            last = algo.train()["episode_reward_mean"]
+        assert last > 9.0  # random CartPole ~9.x with argmax policy start
+    finally:
+        algo.stop()
