@@ -249,6 +249,29 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: ModelConfig,
     return forward_with_aux(params, tokens, cfg, positions, mesh)[0]
 
 
+def split_batch(batch: Dict[str, jax.Array]):
+    """Normalize a batch to (inputs, targets, mask): accepts pre-shifted
+    {"inputs", "targets"} or {"tokens": [b, s+1]}, optional "loss_mask"."""
+    if "inputs" in batch:
+        return batch["inputs"], batch["targets"], batch.get("loss_mask")
+    tokens = batch["tokens"]
+    mask = batch.get("loss_mask")
+    return tokens[:, :-1], tokens[:, 1:], (None if mask is None else mask[:, 1:])
+
+
+def token_nll(logits: jax.Array, targets: jax.Array,
+              mask: Optional[jax.Array] = None) -> jax.Array:
+    """Mean next-token NLL over [..., s, vocab] logits / [..., s] targets,
+    masked if a [..., s] mask is given."""
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    target_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - target_logit
+    if mask is not None:
+        maskf = mask.astype(jnp.float32)
+        return jnp.sum(nll * maskf) / jnp.maximum(jnp.sum(maskf), 1.0)
+    return jnp.mean(nll)
+
+
 def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
             cfg: ModelConfig, mesh=None):
     """Next-token cross entropy.
@@ -257,24 +280,9 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
     {"inputs": [b, s], "targets": [b, s]} — the latter keeps s divisible by
     the sp axis for sequence parallelism. Optional {"loss_mask": [b, s]}.
     """
-    if "inputs" in batch:
-        inputs, targets = batch["inputs"], batch["targets"]
-        mask = batch.get("loss_mask")
-    else:
-        tokens = batch["tokens"]
-        inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        mask = batch.get("loss_mask")
-        if mask is not None:
-            mask = mask[:, 1:]
+    inputs, targets, mask = split_batch(batch)
     logits, moe_aux = forward_with_aux(params, inputs, cfg, mesh=mesh)
-    logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    target_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    nll = logz - target_logit
-    if mask is not None:
-        maskf = mask.astype(jnp.float32)
-        loss = jnp.sum(nll * maskf) / jnp.maximum(jnp.sum(maskf), 1.0)
-    else:
-        loss = jnp.mean(nll)
+    loss = token_nll(logits, targets, mask)
     if cfg.n_experts > 0:
         loss = loss + cfg.moe_aux_weight * moe_aux
-    return loss, {"loss": loss, "ntokens": nll.size, "moe_aux": moe_aux}
+    return loss, {"loss": loss, "ntokens": targets.size, "moe_aux": moe_aux}

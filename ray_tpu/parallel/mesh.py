@@ -31,36 +31,46 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
-    """Sizes of each parallelism axis. -1 on `dp` means 'fill'."""
+    """Sizes of each parallelism axis. -1 on `dp` means 'fill'.
+
+    `pp` (pipeline parallel) is manual-mode: the pp axis is only used by
+    `ray_tpu.parallel.pipeline` (shard_map over 'pp'); the auto-sharded
+    train step requires pp == 1.
+    """
 
     dp: int = -1
     fsdp: int = 1
     tp: int = 1
     sp: int = 1
+    pp: int = 1
 
     def resolve(self, n_devices: int) -> "MeshConfig":
-        fixed = self.fsdp * self.tp * self.sp
+        fixed = self.pp * self.fsdp * self.tp * self.sp
         dp = self.dp
         if dp == -1:
             if n_devices % fixed != 0:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by fsdp*tp*sp={fixed}")
+                    f"{n_devices} devices not divisible by pp*fsdp*tp*sp={fixed}")
             dp = n_devices // fixed
         if dp * fixed != n_devices:
             raise ValueError(
-                f"mesh {dp}x{self.fsdp}x{self.tp}x{self.sp} != {n_devices} devices")
-        return MeshConfig(dp=dp, fsdp=self.fsdp, tp=self.tp, sp=self.sp)
+                f"mesh {dp}x{self.pp}x{self.fsdp}x{self.tp}x{self.sp} "
+                f"!= {n_devices} devices")
+        return MeshConfig(dp=dp, fsdp=self.fsdp, tp=self.tp, sp=self.sp,
+                          pp=self.pp)
 
     @property
-    def shape(self) -> Tuple[int, int, int, int]:
-        return (self.dp, self.fsdp, self.tp, self.sp)
+    def shape(self) -> Tuple[int, ...]:
+        return (self.dp, self.pp, self.fsdp, self.tp, self.sp)
 
 
-AXIS_NAMES = ("dp", "fsdp", "tp", "sp")
+# pp sits between dp and fsdp: stage boundaries cross lower-bandwidth links
+# than tp/sp (which stay innermost on ICI neighbors).
+AXIS_NAMES = ("dp", "pp", "fsdp", "tp", "sp")
 
 
 def make_mesh(config: MeshConfig, devices: Optional[Sequence[Any]] = None) -> Mesh:
-    """Build a Mesh with (dp, fsdp, tp, sp) axes over the given devices.
+    """Build a Mesh with (dp, pp, fsdp, tp, sp) axes over the given devices.
 
     Axis order is chosen so the innermost (fastest-varying) axes hold the
     highest-bandwidth collectives: tp/sp innermost map to adjacent chips on
