@@ -1,7 +1,15 @@
-from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
+from ray_tpu.rllib.env import (
+    CartPoleEnv, ContinuousVectorEnv, PendulumEnv, VectorEnv)
 from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.a2c import A2C, A2CConfig
+from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.impala import IMPALA, ImpalaConfig
 from ray_tpu.rllib.es import ES, ESConfig
+from ray_tpu.rllib.sac import SAC, SACConfig
+from ray_tpu.rllib.ddpg import DDPG, DDPGConfig, TD3, TD3Config
+from ray_tpu.rllib.offline import (
+    BC, BCConfig, CQL, CQLConfig, MARWIL, MARWILConfig, collect_episodes)
+from ray_tpu.rllib.bandit import BanditLinTS, BanditLinUCB, LinearBanditEnv
 from ray_tpu.rllib.replay_buffers import ReplayBuffer, PrioritizedReplayBuffer

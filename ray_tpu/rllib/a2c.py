@@ -1,0 +1,184 @@
+"""A2C: synchronous advantage actor-critic.
+
+Mirrors the reference's A2C (`rllib/algorithms/a2c/a2c.py`): the PPO
+anatomy minus the surrogate clipping — one parallel sample round, GAE
+advantages, a single on-policy gradient step per iteration. Reuses the PPO
+rollout fleet (same actor, same policy net); the learner is one jitted
+policy-gradient + value + entropy update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.env import CartPoleEnv
+from ray_tpu.rllib.ppo import RolloutWorker, compute_gae, init_policy_params, policy_apply
+
+
+class A2CLearner:
+    """Single jitted pg + vf + entropy update (no clipping, no epochs)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, lr: float,
+                 vf_coeff: float = 0.5, entropy_coeff: float = 0.01,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.params = init_policy_params(seed, obs_dim, num_actions)
+        self.optimizer = optax.adam(lr)
+        self.opt_state = self.optimizer.init(self.params)
+
+        def loss_fn(params, batch):
+            logits, value = policy_apply(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=-1)[:, 0]
+            pg = -(logp * batch["advantages"]).mean()
+            vf = 0.5 * ((value - batch["returns"]) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pg + vf_coeff * vf - entropy_coeff * entropy
+            return total, {"policy_loss": pg, "vf_loss": vf, "entropy": entropy}
+
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        self._update = jax.jit(update)
+
+    def update_once(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, batch)
+        return {k: float(v) for k, v in jax.device_get(aux).items()}
+
+    def get_weights(self):
+        import jax
+
+        return {k: np.asarray(v) for k, v in jax.device_get(self.params).items()}
+
+    def set_weights(self, weights):
+        import jax.numpy as jnp
+
+        self.params = {k: jnp.asarray(v) for k, v in weights.items()}
+        self.opt_state = self.optimizer.init(self.params)
+
+
+class A2CConfig:
+    def __init__(self):
+        self.env_maker: Callable[[int], Any] = lambda seed: CartPoleEnv(seed)
+        self.obs_dim = CartPoleEnv.observation_dim
+        self.num_actions = CartPoleEnv.num_actions
+        self.num_rollout_workers = 2
+        self.num_envs_per_worker = 4
+        self.rollout_fragment_length = 32
+        self.lr = 1e-3
+        self.gamma = 0.99
+        self.lambda_ = 1.0
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.seed = 0
+
+    def environment(self, env_maker=None, *, obs_dim=None, num_actions=None):
+        if env_maker is not None:
+            self.env_maker = env_maker
+        if obs_dim is not None:
+            self.obs_dim = obs_dim
+        if num_actions is not None:
+            self.num_actions = num_actions
+        return self
+
+    def rollouts(self, *, num_rollout_workers=None, num_envs_per_worker=None,
+                 rollout_fragment_length=None):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown A2C option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "A2C":
+        return A2C({"a2c_config": self})
+
+
+class A2C(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        cfg: A2CConfig = config.get("a2c_config") or A2CConfig()
+        self.cfg = cfg
+        self.learner = A2CLearner(
+            cfg.obs_dim, cfg.num_actions, cfg.lr, cfg.vf_coeff,
+            cfg.entropy_coeff, cfg.seed)
+        self.workers = [
+            RolloutWorker.options(num_cpus=1).remote(
+                cfg.env_maker, cfg.num_envs_per_worker,
+                cfg.seed + 1000 * (i + 1), cfg.obs_dim, cfg.num_actions)
+            for i in range(cfg.num_rollout_workers)]
+        self._broadcast_weights()
+        self._reward_history: List[float] = []
+        self._total_steps = 0
+
+    def _broadcast_weights(self) -> None:
+        w = self.learner.get_weights()
+        ray_tpu.get([wk.set_weights.remote(w) for wk in self.workers])
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        samples = ray_tpu.get([
+            wk.sample.remote(cfg.rollout_fragment_length) for wk in self.workers])
+        flats, episode_returns = [], []
+        for batch in samples:
+            adv, ret = compute_gae(batch, cfg.gamma, cfg.lambda_)
+            T, N = batch["actions"].shape
+            flats.append({
+                "obs": batch["obs"].reshape(T * N, -1),
+                "actions": batch["actions"].reshape(-1),
+                "advantages": adv.reshape(-1),
+                "returns": ret.reshape(-1),
+            })
+            episode_returns.extend(batch["episode_returns"].tolist())
+        flat = {k: np.concatenate([f[k] for f in flats]) for k in flats[0]}
+        adv = flat["advantages"]
+        flat["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        self._total_steps += int(flat["actions"].size)
+        stats = self.learner.update_once(flat)
+        self._broadcast_weights()
+        if episode_returns:
+            self._reward_history.extend(episode_returns)
+            self._reward_history = self._reward_history[-100:]
+        return {
+            "episode_reward_mean": (float(np.mean(self._reward_history))
+                                    if self._reward_history else 0.0),
+            "num_env_steps_sampled": self._total_steps,
+            **stats,
+        }
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.learner.set_weights(weights)
+        self._broadcast_weights()
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

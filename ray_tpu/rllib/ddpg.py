@@ -1,0 +1,314 @@
+"""DDPG + TD3: deterministic-policy off-policy continuous control.
+
+Mirrors the reference's DDPG/TD3 (`rllib/algorithms/ddpg/`,
+`rllib/algorithms/td3/`): deterministic tanh actor with exploration noise,
+Q critic(s) with polyak targets. TD3 adds the three tricks — twin critics,
+target policy smoothing, delayed actor updates — as config flags on the
+same learner, exactly how the reference derives TD3 from DDPG.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.env import PendulumEnv
+from ray_tpu.rllib.models import init_mlp, mlp_forward, mlp_forward_np
+from ray_tpu.rllib.replay_buffers import ReplayBuffer
+from ray_tpu.rllib.sac import ContinuousWorkerBase, q_value
+
+
+def init_ddpg_params(seed: int, obs_dim: int, action_dim: int,
+                     twin_q: bool,
+                     hidden: Tuple[int, ...] = (256, 256)) -> Dict[str, Any]:
+    rng = np.random.default_rng(seed)
+    params = {
+        "actor": init_mlp(rng, (obs_dim, *hidden, action_dim),
+                          final_scale=0.01),
+        "q1": init_mlp(rng, (obs_dim + action_dim, *hidden, 1)),
+    }
+    if twin_q:
+        params["q2"] = init_mlp(rng, (obs_dim + action_dim, *hidden, 1))
+    return params
+
+
+def actor_apply(actor_params, obs, max_action: float):
+    import jax.numpy as jnp
+
+    return jnp.tanh(
+        mlp_forward(actor_params, obs, len(actor_params) // 2)) * max_action
+
+
+@ray_tpu.remote
+class NoisyActorWorker(ContinuousWorkerBase):
+    """Env actor: deterministic policy + Gaussian exploration noise."""
+
+    def __init__(self, env_maker, num_envs: int, seed: int, obs_dim: int,
+                 action_dim: int, max_action: float, noise_scale: float):
+        super().__init__(env_maker, num_envs, seed, obs_dim, action_dim,
+                         max_action)
+        self.noise_scale = noise_scale
+
+    def _select_actions(self, obs: np.ndarray) -> np.ndarray:
+        mean = np.tanh(mlp_forward_np(self.actor, obs)) * self.max_action
+        noise = self.rng.standard_normal((len(obs), self.action_dim)) \
+            * self.noise_scale * self.max_action
+        return np.clip(mean + noise, -self.max_action, self.max_action)
+
+
+class DDPGLearner:
+    """Jitted critic + (optionally delayed) actor update with polyak sync."""
+
+    def __init__(self, obs_dim: int, action_dim: int, max_action: float,
+                 actor_lr: float, critic_lr: float, gamma: float, tau: float,
+                 twin_q: bool, smooth_target_policy: bool,
+                 target_noise: float, target_noise_clip: float,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.twin_q = twin_q
+        self.params = init_ddpg_params(seed, obs_dim, action_dim, twin_q)
+        self.target = jax.tree.map(lambda v: v.copy(), self.params)
+        self.actor_opt = optax.adam(actor_lr)
+        self.critic_opt = optax.adam(critic_lr)
+        critic_keys = ["q1"] + (["q2"] if twin_q else [])
+        self.actor_opt_state = self.actor_opt.init(self.params["actor"])
+        self.critic_opt_state = self.critic_opt.init(
+            {k: self.params[k] for k in critic_keys})
+        self._key = jax.random.PRNGKey(seed)
+
+        def critic_loss(critics, target, batch, key):
+            next_a = actor_apply(target["actor"], batch["next_obs"], max_action)
+            if smooth_target_policy:
+                noise = jnp.clip(
+                    jax.random.normal(key, next_a.shape) * target_noise,
+                    -target_noise_clip, target_noise_clip)
+                next_a = jnp.clip(next_a + noise, -max_action, max_action)
+            tq = q_value(target["q1"], batch["next_obs"], next_a)
+            if twin_q:
+                tq = jnp.minimum(
+                    tq, q_value(target["q2"], batch["next_obs"], next_a))
+            backup = jax.lax.stop_gradient(
+                batch["rewards"] + gamma * (1 - batch["dones"]) * tq)
+            loss = ((q_value(critics["q1"], batch["obs"], batch["actions"])
+                     - backup) ** 2).mean()
+            if twin_q:
+                loss += ((q_value(critics["q2"], batch["obs"], batch["actions"])
+                          - backup) ** 2).mean()
+            return loss
+
+        def actor_loss(actor, params, batch):
+            a = actor_apply(actor, batch["obs"], max_action)
+            return -q_value(params["q1"], batch["obs"], a).mean()
+
+        def update(params, target, actor_opt_state, critic_opt_state, batch,
+                   key, do_actor_update):
+            critics = {k: params[k] for k in critic_keys}
+            c_loss, c_grads = jax.value_and_grad(critic_loss)(
+                critics, target, batch, key)
+            c_up, critic_opt_state = self.critic_opt.update(
+                c_grads, critic_opt_state, critics)
+            critics = optax.apply_updates(critics, c_up)
+            params = {**params, **critics}
+
+            def run_actor(operand):
+                params, actor_opt_state = operand
+                a_loss, a_grads = jax.value_and_grad(actor_loss)(
+                    params["actor"], params, batch)
+                a_up, actor_opt_state = self.actor_opt.update(
+                    a_grads, actor_opt_state, params["actor"])
+                return ({**params,
+                         "actor": optax.apply_updates(params["actor"], a_up)},
+                        actor_opt_state, a_loss)
+
+            def skip_actor(operand):
+                params, actor_opt_state = operand
+                return params, actor_opt_state, jnp.zeros(())
+
+            params, actor_opt_state, a_loss = jax.lax.cond(
+                do_actor_update, run_actor, skip_actor,
+                (params, actor_opt_state))
+            target = jax.tree.map(
+                lambda t, p: (1 - tau) * t + tau * p, target, params)
+            return (params, target, actor_opt_state, critic_opt_state,
+                    {"critic_loss": c_loss, "actor_loss": a_loss})
+
+        self._update = jax.jit(update)
+
+    def update_batch(self, batch, do_actor_update: bool) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        self._key, sub = jax.random.split(self._key)
+        (self.params, self.target, self.actor_opt_state,
+         self.critic_opt_state, aux) = self._update(
+            self.params, self.target, self.actor_opt_state,
+            self.critic_opt_state, batch, sub, jnp.asarray(do_actor_update))
+        return {k: float(v) for k, v in jax.device_get(aux).items()}
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, jax.device_get(self.params))
+
+    def set_weights(self, weights):
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, weights)
+        self.target = jax.tree.map(lambda v: v.copy(), self.params)
+
+
+class DDPGConfig:
+    _algo_cls_name = "DDPG"
+
+    def __init__(self):
+        self.env_maker: Callable[[int], Any] = lambda seed: PendulumEnv(seed)
+        self.obs_dim = PendulumEnv.observation_dim
+        self.action_dim = PendulumEnv.action_dim
+        self.max_action = PendulumEnv.max_action
+        self.num_rollout_workers = 1
+        self.num_envs_per_worker = 1
+        self.rollout_fragment_length = 64
+        self.actor_lr = 1e-3
+        self.critic_lr = 1e-3
+        self.gamma = 0.99
+        self.tau = 0.005
+        self.exploration_noise = 0.1
+        self.buffer_size = 100_000
+        self.train_batch_size = 256
+        self.num_updates_per_step = 8
+        self.learning_starts = 256
+        # TD3 tricks (off for plain DDPG)
+        self.twin_q = False
+        self.smooth_target_policy = False
+        self.target_noise = 0.2
+        self.target_noise_clip = 0.5
+        self.policy_delay = 1
+        self.seed = 0
+
+    def environment(self, env_maker=None, *, obs_dim=None, action_dim=None,
+                    max_action=None):
+        if env_maker is not None:
+            self.env_maker = env_maker
+        if obs_dim is not None:
+            self.obs_dim = obs_dim
+        if action_dim is not None:
+            self.action_dim = action_dim
+        if max_action is not None:
+            self.max_action = max_action
+        return self
+
+    def rollouts(self, *, num_rollout_workers=None, num_envs_per_worker=None,
+                 rollout_fragment_length=None):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self):
+        return DDPG({"ddpg_config": self})
+
+
+class TD3Config(DDPGConfig):
+    """DDPG config with the TD3 defaults switched on
+    (reference `rllib/algorithms/td3/td3.py`)."""
+
+    def __init__(self):
+        super().__init__()
+        self.twin_q = True
+        self.smooth_target_policy = True
+        self.policy_delay = 2
+
+    def build(self):
+        return TD3({"ddpg_config": self})
+
+
+class DDPG(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        cfg: DDPGConfig = config.get("ddpg_config") or DDPGConfig()
+        self.cfg = cfg
+        self.learner = DDPGLearner(
+            cfg.obs_dim, cfg.action_dim, cfg.max_action, cfg.actor_lr,
+            cfg.critic_lr, cfg.gamma, cfg.tau, cfg.twin_q,
+            cfg.smooth_target_policy, cfg.target_noise,
+            cfg.target_noise_clip, cfg.seed)
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self.workers = [
+            NoisyActorWorker.options(num_cpus=1).remote(
+                cfg.env_maker, cfg.num_envs_per_worker,
+                cfg.seed + 1000 * (i + 1), cfg.obs_dim, cfg.action_dim,
+                cfg.max_action, cfg.exploration_noise)
+            for i in range(cfg.num_rollout_workers)]
+        self._broadcast_weights()
+        self._reward_history: List[float] = []
+        self._total_steps = 0
+        self._update_count = 0
+
+    def _broadcast_weights(self) -> None:
+        actor = self.learner.get_weights()["actor"]
+        ray_tpu.get([w.set_weights.remote(actor) for w in self.workers])
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        random_phase = self._total_steps < cfg.learning_starts
+        samples = ray_tpu.get([
+            w.sample.remote(cfg.rollout_fragment_length, random_phase)
+            for w in self.workers])
+        for batch in samples:
+            self.buffer.add_batch({
+                k: batch[k] for k in
+                ("obs", "actions", "rewards", "next_obs", "dones")})
+            self._total_steps += int(batch["actions"].shape[0])
+            self._reward_history.extend(batch["episode_returns"].tolist())
+        self._reward_history = self._reward_history[-100:]
+        stats: Dict[str, float] = {}
+        if len(self.buffer) >= cfg.train_batch_size:
+            for _ in range(cfg.num_updates_per_step):
+                self._update_count += 1
+                mb = self.buffer.sample(cfg.train_batch_size)
+                stats = self.learner.update_batch(
+                    {k: mb[k] for k in
+                     ("obs", "actions", "rewards", "next_obs", "dones")},
+                    self._update_count % cfg.policy_delay == 0)
+            self._broadcast_weights()
+        return {
+            "episode_reward_mean": (float(np.mean(self._reward_history))
+                                    if self._reward_history else 0.0),
+            "num_env_steps_sampled": self._total_steps,
+            **stats,
+        }
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.learner.set_weights(weights)
+        self._broadcast_weights()
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+
+class TD3(DDPG):
+    """TD3 = DDPG + twin critics + target smoothing + delayed actor
+    (reference rllib/algorithms/td3)."""

@@ -214,6 +214,7 @@ class DQN(Algorithm):
             for i in range(cfg.num_rollout_workers)]
         self._broadcast()
         self._reward_history: List[float] = []
+        self._total_steps = 0
 
     def _broadcast(self) -> None:
         w = self.learner.get_weights()
@@ -236,6 +237,7 @@ class DQN(Algorithm):
             self._reward_history.extend(ep.tolist())
             self.buffer.add_batch(s)
             n_new += len(s["actions"])
+            self._total_steps += len(s["actions"])
         self._reward_history = self._reward_history[-100:]
 
         losses = []
@@ -256,7 +258,7 @@ class DQN(Algorithm):
             "episode_reward_mean": mean_reward,
             "epsilon": eps,
             "buffer_size": len(self.buffer),
-            "num_env_steps_sampled": n_new,
+            "num_env_steps_sampled": self._total_steps,
             "loss": float(np.mean(losses)) if losses else float("nan"),
         }
 

@@ -62,12 +62,72 @@ class CartPoleEnv:
         return self._state.astype(np.float32), 1.0, done, {}
 
 
-class VectorEnv:
-    """N independent env copies stepped together (reference vector_env.py)."""
+class PendulumEnv:
+    """Pendulum-v1 dynamics: continuous torque control, reward in [-16.27, 0].
 
-    def __init__(self, env_fn: Callable[[int], Any], num_envs: int, seed: int = 0):
+    The continuous-control counterpart to CartPole for SAC/DDPG/TD3 learning
+    tests (the reference trains these on gym Pendulum in
+    rllib/tuned_examples/sac, ddpg).
+    """
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+    MAX_STEPS = 200
+
+    observation_dim = 3
+    action_dim = 1
+    max_action = MAX_TORQUE
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+        self._theta = 0.0
+        self._theta_dot = 0.0
+        self._steps = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array(
+            [np.cos(self._theta), np.sin(self._theta), self._theta_dot],
+            np.float32)
+
+    def reset(self) -> np.ndarray:
+        self._theta = self._rng.uniform(-np.pi, np.pi)
+        self._theta_dot = self._rng.uniform(-1.0, 1.0)
+        self._steps = 0
+        return self._obs()
+
+    def step(self, action) -> Tuple[np.ndarray, float, bool, dict]:
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          -self.MAX_TORQUE, self.MAX_TORQUE))
+        th, thdot = self._theta, self._theta_dot
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2
+        thdot = thdot + (
+            3 * self.G / (2 * self.L) * np.sin(th)
+            + 3.0 / (self.M * self.L**2) * u) * self.DT
+        thdot = float(np.clip(thdot, -self.MAX_SPEED, self.MAX_SPEED))
+        th = th + thdot * self.DT
+        self._theta, self._theta_dot = th, thdot
+        self._steps += 1
+        done = self._steps >= self.MAX_STEPS
+        return self._obs(), -float(cost), done, {}
+
+
+class VectorEnv:
+    """N independent env copies stepped together (reference vector_env.py).
+
+    `discrete` controls the per-env action cast: int for discrete envs,
+    pass-through arrays for continuous ones.
+    """
+
+    def __init__(self, env_fn: Callable[[int], Any], num_envs: int,
+                 seed: int = 0, discrete: bool = True):
         self.envs = [env_fn(seed + i) for i in range(num_envs)]
         self.num_envs = num_envs
+        self._cast = int if discrete else (lambda a: a)
 
     def reset(self) -> np.ndarray:
         return np.stack([e.reset() for e in self.envs])
@@ -75,7 +135,7 @@ class VectorEnv:
     def step(self, actions) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List[dict]]:
         obs, rews, dones, infos = [], [], [], []
         for e, a in zip(self.envs, actions):
-            o, r, d, i = e.step(int(a))
+            o, r, d, i = e.step(self._cast(a))
             if d:
                 o = e.reset()
             obs.append(o)
@@ -83,3 +143,10 @@ class VectorEnv:
             dones.append(d)
             infos.append(i)
         return np.stack(obs), np.array(rews, np.float32), np.array(dones), infos
+
+
+class ContinuousVectorEnv(VectorEnv):
+    """VectorEnv without the int() action cast, for continuous control."""
+
+    def __init__(self, env_fn: Callable[[int], Any], num_envs: int, seed: int = 0):
+        super().__init__(env_fn, num_envs, seed, discrete=False)

@@ -180,6 +180,7 @@ class IMPALA(Algorithm):
             for _ in range(cfg.max_inflight):
                 self._inflight[wk.sample.remote(cfg.rollout_fragment_length)] = i
         self._reward_history: List[float] = []
+        self._total_steps = 0
         self._updates_since_broadcast = 0
         # always-present loss keys so callers never KeyError on a quiet step
         self._last_stats: Dict[str, float] = {
@@ -209,6 +210,7 @@ class IMPALA(Algorithm):
             jb = {k: jnp.asarray(v) for k, v in batch.items()}
             self._last_stats = self.learner.update_batch(jb)
             n_steps += batch["actions"].size
+            self._total_steps += int(batch["actions"].size)
             self._updates_since_broadcast += 1
             if self._updates_since_broadcast >= cfg.broadcast_interval:
                 # push fresh weights only to the worker we're about to relaunch
@@ -221,7 +223,7 @@ class IMPALA(Algorithm):
             if self._reward_history else 0.0
         return {
             "episode_reward_mean": mean_reward,
-            "num_env_steps_sampled": n_steps,
+            "num_env_steps_sampled": self._total_steps,
             **stats,
         }
 

@@ -269,6 +269,7 @@ class PPO(Algorithm):
         self._rng = np.random.default_rng(cfg.seed)
         self._broadcast_weights()
         self._reward_history: List[float] = []
+        self._total_steps = 0
 
     def _broadcast_weights(self) -> None:
         w = self.learner.get_weights()
@@ -296,6 +297,7 @@ class PPO(Algorithm):
         flat = {k: np.concatenate([f[k] for f in flats]) for k in flats[0]}
         adv = flat["advantages"]
         flat["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        self._total_steps += int(flat["actions"].size)
         # 3. learner update
         stats = self.learner.update_minibatches(
             flat, cfg.num_sgd_iter, cfg.sgd_minibatch_size, self._rng)
@@ -307,7 +309,7 @@ class PPO(Algorithm):
         mean_reward = float(np.mean(self._reward_history)) if self._reward_history else 0.0
         return {
             "episode_reward_mean": mean_reward,
-            "num_env_steps_sampled": int(flat["actions"].size),
+            "num_env_steps_sampled": self._total_steps,
             **stats,
         }
 

@@ -1,0 +1,356 @@
+"""SAC: soft actor-critic for continuous control.
+
+Mirrors the reference's SAC (`rllib/algorithms/sac/sac.py`): off-policy
+replay, twin soft Q critics with target networks, a tanh-squashed Gaussian
+policy, and automatic entropy-temperature tuning. Sampling runs on env
+actors; the learner is one jitted JAX update (critic + actor + alpha in a
+single step, polyak target sync).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.env import ContinuousVectorEnv, PendulumEnv
+from ray_tpu.rllib.models import init_mlp, mlp_forward, mlp_forward_np
+from ray_tpu.rllib.replay_buffers import ReplayBuffer
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+def init_sac_params(seed: int, obs_dim: int, action_dim: int,
+                    hidden: Tuple[int, ...] = (256, 256)) -> Dict[str, Any]:
+    rng = np.random.default_rng(seed)
+    return {
+        "actor": init_mlp(rng, (obs_dim, *hidden, 2 * action_dim),
+                          final_scale=0.01),
+        "q1": init_mlp(rng, (obs_dim + action_dim, *hidden, 1)),
+        "q2": init_mlp(rng, (obs_dim + action_dim, *hidden, 1)),
+    }
+
+
+def actor_dist(actor_params, obs, action_dim: int):
+    """Returns (mean, log_std) of the pre-squash Gaussian."""
+    import jax.numpy as jnp
+
+    out = mlp_forward(actor_params, obs, len(actor_params) // 2)
+    mean, log_std = out[..., :action_dim], out[..., action_dim:]
+    log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+    return mean, log_std
+
+
+def sample_action(actor_params, obs, key, action_dim: int, max_action: float):
+    """Reparameterized tanh-Gaussian sample with log-prob correction."""
+    import jax
+    import jax.numpy as jnp
+
+    mean, log_std = actor_dist(actor_params, obs, action_dim)
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(key, mean.shape)
+    pre = mean + std * eps
+    a = jnp.tanh(pre)
+    # log N(pre; mean, std) - sum log(1 - tanh^2) [change of variables]
+    logp = (-0.5 * (eps**2 + 2 * log_std + jnp.log(2 * jnp.pi))).sum(-1)
+    logp -= (2 * (jnp.log(2.0) - pre - jax.nn.softplus(-2 * pre))).sum(-1)
+    return a * max_action, logp
+
+
+def q_value(q_params, obs, action):
+    import jax.numpy as jnp
+
+    x = jnp.concatenate([obs, action], axis=-1)
+    return mlp_forward(q_params, x, len(q_params) // 2)[..., 0]
+
+
+class ContinuousWorkerBase:
+    """Shared env-actor loop for continuous control: random warmup phase,
+    transition collection, episode-return bookkeeping. Subclasses implement
+    `_select_actions` (the exploration policy) on a numpy actor copy."""
+
+    def __init__(self, env_maker, num_envs: int, seed: int,
+                 obs_dim: int, action_dim: int, max_action: float):
+        self.vec = ContinuousVectorEnv(env_maker, num_envs, seed)
+        self.obs = self.vec.reset()
+        self.rng = np.random.default_rng(seed)
+        self.actor = None
+        self.action_dim = action_dim
+        self.max_action = max_action
+        self._ep_returns = np.zeros(num_envs, np.float32)
+        self._completed: List[float] = []
+
+    def set_weights(self, actor) -> bool:
+        self.actor = {k: np.asarray(v) for k, v in actor.items()}
+        return True
+
+    def _select_actions(self, obs: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def sample(self, num_steps: int, random_policy: bool = False):
+        N = self.vec.num_envs
+        cols = {k: [] for k in ("obs", "actions", "rewards", "next_obs", "dones")}
+        for _ in range(num_steps):
+            if random_policy or self.actor is None:
+                actions = self.rng.uniform(
+                    -self.max_action, self.max_action, (N, self.action_dim))
+            else:
+                actions = self._select_actions(self.obs)
+            actions = actions.astype(np.float32)
+            prev = self.obs
+            self.obs, rewards, dones, _ = self.vec.step(actions)
+            cols["obs"].append(prev)
+            cols["actions"].append(actions)
+            cols["rewards"].append(rewards)
+            cols["next_obs"].append(self.obs)
+            cols["dones"].append(dones.astype(np.float32))
+            self._ep_returns += rewards
+            for i, d in enumerate(dones):
+                if d:
+                    self._completed.append(float(self._ep_returns[i]))
+                    self._ep_returns[i] = 0.0
+        out = {k: np.concatenate(v) if v[0].ndim > 1 else np.stack(v).reshape(-1)
+               for k, v in cols.items()}
+        ep, self._completed = self._completed, []
+        out["episode_returns"] = np.array(ep, np.float32)
+        return out
+
+
+@ray_tpu.remote
+class ContinuousSampleWorker(ContinuousWorkerBase):
+    """Env actor sampling with a numpy copy of the tanh-Gaussian policy."""
+
+    def _select_actions(self, obs: np.ndarray) -> np.ndarray:
+        out = mlp_forward_np(self.actor, obs)
+        mean, log_std = out[..., :self.action_dim], out[..., self.action_dim:]
+        log_std = np.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+        pre = mean + np.exp(log_std) * self.rng.standard_normal(mean.shape)
+        return np.tanh(pre) * self.max_action
+
+
+class SACLearner:
+    """Jitted twin-Q soft policy iteration with auto-alpha."""
+
+    def __init__(self, obs_dim: int, action_dim: int, max_action: float,
+                 lr: float, gamma: float, tau: float,
+                 target_entropy: float, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.params = init_sac_params(seed, obs_dim, action_dim)
+        self.target = {"q1": {k: v.copy() for k, v in self.params["q1"].items()},
+                       "q2": {k: v.copy() for k, v in self.params["q2"].items()}}
+        self.log_alpha = jnp.zeros(())
+        self.optimizer = optax.adam(lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.alpha_opt = optax.adam(lr)
+        self.alpha_opt_state = self.alpha_opt.init(self.log_alpha)
+        self._key = jax.random.PRNGKey(seed)
+
+        def critic_loss(params, target, log_alpha, batch, key):
+            next_a, next_logp = sample_action(
+                params["actor"], batch["next_obs"], key, action_dim, max_action)
+            tq = jnp.minimum(
+                q_value(target["q1"], batch["next_obs"], next_a),
+                q_value(target["q2"], batch["next_obs"], next_a))
+            alpha = jnp.exp(log_alpha)
+            backup = batch["rewards"] + gamma * (1 - batch["dones"]) * (
+                tq - alpha * next_logp)
+            backup = jax.lax.stop_gradient(backup)
+            q1 = q_value(params["q1"], batch["obs"], batch["actions"])
+            q2 = q_value(params["q2"], batch["obs"], batch["actions"])
+            return ((q1 - backup) ** 2).mean() + ((q2 - backup) ** 2).mean()
+
+        def actor_loss(params, log_alpha, batch, key):
+            a, logp = sample_action(
+                params["actor"], batch["obs"], key, action_dim, max_action)
+            q = jnp.minimum(q_value(params["q1"], batch["obs"], a),
+                            q_value(params["q2"], batch["obs"], a))
+            alpha = jnp.exp(log_alpha)
+            return (alpha * logp - q).mean(), logp
+
+        def update(params, target, log_alpha, opt_state, alpha_opt_state,
+                   batch, key):
+            k1, k2 = jax.random.split(key)
+            c_loss, c_grads = jax.value_and_grad(critic_loss)(
+                params, target, log_alpha, batch, k1)
+
+            def a_loss_fn(p):
+                l, logp = actor_loss(
+                    {**params, "actor": p["actor"]}, log_alpha, batch, k2)
+                return l, logp
+
+            (a_loss, logp), a_grads = jax.value_and_grad(
+                a_loss_fn, has_aux=True)({"actor": params["actor"]})
+            grads = {"actor": a_grads["actor"],
+                     "q1": c_grads["q1"], "q2": c_grads["q2"]}
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            # alpha update toward target entropy
+            al_grad = jax.grad(
+                lambda la: (-jnp.exp(la) * jax.lax.stop_gradient(
+                    logp + target_entropy)).mean())(log_alpha)
+            al_up, alpha_opt_state = self.alpha_opt.update(
+                al_grad, alpha_opt_state, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, al_up)
+            target_new = jax.tree.map(
+                lambda t, p: (1 - tau) * t + tau * p,
+                target, {"q1": params["q1"], "q2": params["q2"]})
+            aux = {"critic_loss": c_loss, "actor_loss": a_loss,
+                   "alpha": jnp.exp(log_alpha), "entropy": -logp.mean()}
+            return params, target_new, log_alpha, opt_state, alpha_opt_state, aux
+
+        self._update = jax.jit(update)
+
+    def update_batch(self, batch) -> Dict[str, float]:
+        import jax
+
+        self._key, sub = jax.random.split(self._key)
+        (self.params, self.target, self.log_alpha, self.opt_state,
+         self.alpha_opt_state, aux) = self._update(
+            self.params, self.target, self.log_alpha, self.opt_state,
+            self.alpha_opt_state, batch, sub)
+        return {k: float(v) for k, v in jax.device_get(aux).items()}
+
+    def get_weights(self):
+        import jax
+
+        out = jax.tree.map(np.asarray, jax.device_get(self.params))
+        out["log_alpha"] = float(jax.device_get(self.log_alpha))
+        return out
+
+    def set_weights(self, weights):
+        import jax
+        import jax.numpy as jnp
+
+        weights = dict(weights)
+        log_alpha = weights.pop("log_alpha", 0.0)
+        self.log_alpha = jnp.asarray(log_alpha)
+        self.params = jax.tree.map(jnp.asarray, weights)
+        self.target = {
+            "q1": {k: np.asarray(v).copy() for k, v in weights["q1"].items()},
+            "q2": {k: np.asarray(v).copy() for k, v in weights["q2"].items()}}
+        self.opt_state = self.optimizer.init(self.params)
+        self.alpha_opt_state = self.alpha_opt.init(self.log_alpha)
+
+
+class SACConfig:
+    def __init__(self):
+        self.env_maker: Callable[[int], Any] = lambda seed: PendulumEnv(seed)
+        self.obs_dim = PendulumEnv.observation_dim
+        self.action_dim = PendulumEnv.action_dim
+        self.max_action = PendulumEnv.max_action
+        self.num_rollout_workers = 1
+        self.num_envs_per_worker = 1
+        self.rollout_fragment_length = 64
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.tau = 0.005
+        self.target_entropy = None   # default: -action_dim
+        self.buffer_size = 100_000
+        self.train_batch_size = 256
+        self.num_updates_per_step = 8
+        self.learning_starts = 256
+        self.seed = 0
+
+    def environment(self, env_maker=None, *, obs_dim=None, action_dim=None,
+                    max_action=None):
+        if env_maker is not None:
+            self.env_maker = env_maker
+        if obs_dim is not None:
+            self.obs_dim = obs_dim
+        if action_dim is not None:
+            self.action_dim = action_dim
+        if max_action is not None:
+            self.max_action = max_action
+        return self
+
+    def rollouts(self, *, num_rollout_workers=None, num_envs_per_worker=None,
+                 rollout_fragment_length=None):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown SAC option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "SAC":
+        return SAC({"sac_config": self})
+
+
+class SAC(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        cfg: SACConfig = config.get("sac_config") or SACConfig()
+        self.cfg = cfg
+        target_entropy = (cfg.target_entropy if cfg.target_entropy is not None
+                          else -float(cfg.action_dim))
+        self.learner = SACLearner(
+            cfg.obs_dim, cfg.action_dim, cfg.max_action, cfg.lr, cfg.gamma,
+            cfg.tau, target_entropy, cfg.seed)
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self.workers = [
+            ContinuousSampleWorker.options(num_cpus=1).remote(
+                cfg.env_maker, cfg.num_envs_per_worker,
+                cfg.seed + 1000 * (i + 1), cfg.obs_dim, cfg.action_dim,
+                cfg.max_action)
+            for i in range(cfg.num_rollout_workers)]
+        self._broadcast_weights()
+        self._reward_history: List[float] = []
+        self._total_steps = 0
+
+    def _broadcast_weights(self) -> None:
+        actor = self.learner.get_weights()["actor"]
+        ray_tpu.get([w.set_weights.remote(actor) for w in self.workers])
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        random_phase = self._total_steps < cfg.learning_starts
+        samples = ray_tpu.get([
+            w.sample.remote(cfg.rollout_fragment_length, random_phase)
+            for w in self.workers])
+        for batch in samples:
+            self.buffer.add_batch({
+                k: batch[k] for k in
+                ("obs", "actions", "rewards", "next_obs", "dones")})
+            self._total_steps += int(batch["actions"].shape[0])
+            self._reward_history.extend(batch["episode_returns"].tolist())
+        self._reward_history = self._reward_history[-100:]
+        stats: Dict[str, float] = {}
+        if len(self.buffer) >= cfg.train_batch_size:
+            for _ in range(cfg.num_updates_per_step):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                stats = self.learner.update_batch({
+                    k: mb[k] for k in
+                    ("obs", "actions", "rewards", "next_obs", "dones")})
+            self._broadcast_weights()
+        return {
+            "episode_reward_mean": (float(np.mean(self._reward_history))
+                                    if self._reward_history else 0.0),
+            "num_env_steps_sampled": self._total_steps,
+            **stats,
+        }
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.learner.set_weights(weights)
+        self._broadcast_weights()
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
