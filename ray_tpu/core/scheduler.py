@@ -17,7 +17,9 @@ ride ICI instead of DCN.
 
 from __future__ import annotations
 
+import os
 import random
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -51,6 +53,33 @@ class NodeView:
 
 
 class SchedulingPolicy:
+    """Scheduling decisions, natively accelerated when the C++ library
+    builds (core/native_scheduler.py); the Python paths below remain the
+    executable spec and the fallback."""
+
+    def __init__(self):
+        self._native = None
+        self._native_lock = threading.Lock()
+        if os.environ.get("RAY_TPU_NATIVE_SCHEDULER", "1") != "0":
+            try:
+                from ray_tpu.core.native_scheduler import NativeScheduler
+
+                self._native = NativeScheduler(
+                    get_config().scheduler_spread_threshold)
+            except Exception:
+                self._native = None
+
+    def _native_select(self, nodes: List[NodeView], demand: Dict[str, float],
+                       strategy: str, prefer_node: Optional[bytes]):
+        # One lock around sync+select: callers (raylet dispatch loop, GCS rpc
+        # + health threads) share this policy, and the native node table is
+        # stateful between the two calls.
+        with self._native_lock:
+            self._native.set_spread_threshold(
+                get_config().scheduler_spread_threshold)
+            self._native.sync_nodes(nodes)
+            return self._native.select(demand, strategy, prefer_node)
+
     def select_node(
         self,
         nodes: List[NodeView],
@@ -75,7 +104,17 @@ class SchedulingPolicy:
             for n in nodes:
                 if n.node_id == strategy.node_id and (n.is_feasible(demand)):
                     return n.node_id
-            return self._hybrid(nodes, demand, prefer_node) if strategy.soft else None
+            if not strategy.soft:
+                return None
+            if self._native is not None:
+                return self._native_select(nodes, demand, "HYBRID", prefer_node)
+            return self._hybrid([n for n in nodes if n.is_feasible(demand)],
+                                demand, prefer_node)
+
+        if self._native is not None:
+            native_strategy = "SPREAD" if strategy.name == "SPREAD" else "HYBRID"
+            return self._native_select(nodes, demand, native_strategy,
+                                       prefer_node)
 
         feasible = [n for n in nodes if n.is_feasible(demand)]
         if not feasible:
@@ -113,13 +152,18 @@ class SchedulingPolicy:
         strategy: str,
     ) -> Optional[List[bytes]]:
         """Return a node id per bundle, or None if infeasible."""
-        if strategy in ("STRICT_PACK", "PACK"):
-            placement = self._pack(nodes, bundles, strict=(strategy == "STRICT_PACK"))
-        elif strategy in ("STRICT_SPREAD", "SPREAD"):
-            placement = self._spread(nodes, bundles, strict=(strategy == "STRICT_SPREAD"))
-        else:
+        if strategy not in ("STRICT_PACK", "PACK", "STRICT_SPREAD", "SPREAD"):
             raise ValueError(f"unknown placement strategy {strategy}")
-        return placement
+        if self._native is not None:
+            try:
+                with self._native_lock:
+                    self._native.sync_nodes(nodes)
+                    return self._native.place_bundles(bundles, strategy)
+            except RuntimeError:
+                pass  # e.g. output-buffer overflow on huge placements
+        if strategy in ("STRICT_PACK", "PACK"):
+            return self._pack(nodes, bundles, strict=(strategy == "STRICT_PACK"))
+        return self._spread(nodes, bundles, strict=(strategy == "STRICT_SPREAD"))
 
     def _pack(self, nodes: List[NodeView], bundles, strict: bool) -> Optional[List[bytes]]:
         # TPU slice-awareness: try to satisfy all bundles within one slice's

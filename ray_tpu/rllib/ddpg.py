@@ -162,6 +162,10 @@ class DDPGLearner:
 
         self.params = jax.tree.map(jnp.asarray, weights)
         self.target = jax.tree.map(lambda v: v.copy(), self.params)
+        critic_keys = ["q1"] + (["q2"] if self.twin_q else [])
+        self.actor_opt_state = self.actor_opt.init(self.params["actor"])
+        self.critic_opt_state = self.critic_opt.init(
+            {k: self.params[k] for k in critic_keys})
 
 
 class DDPGConfig:

@@ -88,6 +88,20 @@ def from_rows(rows: List[Dict[str, Any]]) -> Dict[str, np.ndarray]:
 # ------------------------------------------------------------- algorithms
 
 
+def discounted_returns_to_go(rewards: np.ndarray, dones: np.ndarray,
+                             gamma: float) -> np.ndarray:
+    """Per-episode discounted return-to-go over flat transition columns;
+    episode boundaries come from the dones flags."""
+    out = np.zeros_like(rewards, dtype=np.float32)
+    acc = 0.0
+    for t in reversed(range(len(rewards))):
+        if dones[t]:
+            acc = 0.0
+        acc = rewards[t] + gamma * acc
+        out[t] = acc
+    return out
+
+
 class _OfflineBase(Algorithm):
     """Shared setup: dataset + minibatch iterator."""
 
@@ -99,6 +113,13 @@ class _OfflineBase(Algorithm):
         self.dataset: Dict[str, np.ndarray] = config["dataset"] \
             if "dataset" in config else cfg.dataset
         assert self.dataset is not None, "offline algorithms need a dataset"
+        # Recompute return-to-go with THIS algorithm's gamma (the dataset's
+        # precomputed column is undiscounted; reference MARWIL discounts).
+        gamma = getattr(cfg, "gamma", 1.0)
+        if gamma < 1.0 and "rewards" in self.dataset and "dones" in self.dataset:
+            self.dataset = dict(self.dataset)
+            self.dataset["mc_returns"] = discounted_returns_to_go(
+                self.dataset["rewards"], self.dataset["dones"], gamma)
         self._rng = np.random.default_rng(cfg.seed)
         self._build_learner()
 

@@ -1,0 +1,134 @@
+"""Native C++ scheduler: build, correctness, and fuzzed parity with the
+pure-Python policy spec (core/scheduler.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu.core import native_scheduler
+from ray_tpu.core.scheduler import NodeView, SchedulingPolicy
+from ray_tpu.core.task_spec import SchedulingStrategy
+
+pytestmark = pytest.mark.skipif(
+    not native_scheduler.available(), reason="g++ toolchain unavailable")
+
+
+def _python_policy() -> SchedulingPolicy:
+    os.environ["RAY_TPU_NATIVE_SCHEDULER"] = "0"
+    try:
+        return SchedulingPolicy()
+    finally:
+        del os.environ["RAY_TPU_NATIVE_SCHEDULER"]
+
+
+def _native_policy() -> SchedulingPolicy:
+    p = SchedulingPolicy()
+    assert p._native is not None
+    return p
+
+
+def _mk_node(i: int, cpu_t, cpu_a, tpu_t=0.0, tpu_a=0.0, slice_label=None):
+    total = {"CPU": cpu_t}
+    avail = {"CPU": cpu_a}
+    if tpu_t:
+        total["TPU"] = tpu_t
+        avail["TPU"] = tpu_a
+    labels = {"tpu_slice": slice_label} if slice_label else {}
+    return NodeView(node_id=bytes([i]) * 8, total=total, available=avail,
+                    labels=labels)
+
+
+def test_native_basic_select_packs_until_threshold():
+    sched = native_scheduler.NativeScheduler(0.5)
+    sched.upsert_node(b"\x01" * 8, {"CPU": 8}, {"CPU": 8})
+    sched.upsert_node(b"\x02" * 8, {"CPU": 8}, {"CPU": 2})
+    # both under/over threshold: node1 util 0 (<0.5 → truncated 0),
+    # node2 util 0.75 → hybrid picks node1
+    assert sched.select({"CPU": 1}) == b"\x01" * 8
+    # prefer-node tie-break: make both truncated-0 and available
+    sched.upsert_node(b"\x02" * 8, {"CPU": 8}, {"CPU": 8})
+    assert sched.select({"CPU": 1}, prefer_node=b"\x02" * 8) == b"\x02" * 8
+
+
+def test_native_infeasible_returns_none():
+    sched = native_scheduler.NativeScheduler(0.5)
+    sched.upsert_node(b"\x01" * 8, {"CPU": 2}, {"CPU": 2})
+    assert sched.select({"GPU": 1}) is None
+    assert sched.select({"CPU": 4}) is None  # infeasible vs total
+
+
+def test_native_strict_pack_single_node_then_slice():
+    sched = native_scheduler.NativeScheduler(0.5)
+    sched.upsert_node(b"\x01" * 8, {"CPU": 2}, {"CPU": 2},
+                      labels={"tpu_slice": "s0"})
+    sched.upsert_node(b"\x02" * 8, {"CPU": 2}, {"CPU": 2},
+                      labels={"tpu_slice": "s0"})
+    bundles = [{"CPU": 2}, {"CPU": 2}]
+    # no single node fits both; the s0 slice group does
+    placement = sched.place_bundles(bundles, "STRICT_PACK")
+    assert placement == [b"\x01" * 8, b"\x02" * 8]
+
+
+def test_native_strict_spread_needs_distinct_nodes():
+    sched = native_scheduler.NativeScheduler(0.5)
+    sched.upsert_node(b"\x01" * 8, {"CPU": 8}, {"CPU": 8})
+    assert sched.place_bundles([{"CPU": 1}, {"CPU": 1}],
+                               "STRICT_SPREAD") is None
+    sched.upsert_node(b"\x02" * 8, {"CPU": 8}, {"CPU": 8})
+    placement = sched.place_bundles([{"CPU": 1}, {"CPU": 1}], "STRICT_SPREAD")
+    assert placement is not None
+    assert placement[0] != placement[1]
+
+
+def test_fixed_point_exactness():
+    """0.1 added ten times must exactly exhaust a 1.0-CPU node."""
+    sched = native_scheduler.NativeScheduler(0.5)
+    sched.upsert_node(b"\x01" * 8, {"CPU": 1.0}, {"CPU": 1.0})
+    placement = sched.place_bundles([{"CPU": 0.1}] * 10, "PACK")
+    assert placement == [b"\x01" * 8] * 10
+    assert sched.place_bundles([{"CPU": 0.1}] * 11, "PACK") is None
+
+
+def _random_nodes(rng, n):
+    nodes = []
+    for i in range(1, n + 1):
+        cpu_t = float(rng.integers(1, 16))
+        cpu_a = float(rng.integers(0, int(cpu_t) + 1))
+        tpu_t = float(rng.choice([0, 4, 8]))
+        tpu_a = float(rng.integers(0, int(tpu_t) + 1)) if tpu_t else 0.0
+        slice_label = rng.choice([None, "s0", "s1"])
+        nodes.append(_mk_node(i, cpu_t, cpu_a, tpu_t, tpu_a, slice_label))
+    return nodes
+
+
+def test_fuzz_select_parity_with_python_spec():
+    rng = np.random.default_rng(0)
+    py = _python_policy()
+    nat = _native_policy()
+    for trial in range(200):
+        nodes = _random_nodes(rng, int(rng.integers(1, 6)))
+        demand = {"CPU": float(rng.integers(0, 8))}
+        if rng.random() < 0.5:
+            demand["TPU"] = float(rng.integers(1, 8))
+        strategy = SchedulingStrategy(
+            name="SPREAD" if rng.random() < 0.5 else "DEFAULT")
+        prefer = nodes[0].node_id if rng.random() < 0.5 else None
+        got_py = py.select_node(nodes, demand, strategy, prefer_node=prefer)
+        got_nat = nat.select_node(nodes, demand, strategy, prefer_node=prefer)
+        assert got_py == got_nat, (trial, demand, strategy.name, got_py, got_nat)
+
+
+def test_fuzz_place_bundles_parity_with_python_spec():
+    rng = np.random.default_rng(1)
+    py = _python_policy()
+    nat = _native_policy()
+    for trial in range(200):
+        nodes = _random_nodes(rng, int(rng.integers(1, 5)))
+        n_bundles = int(rng.integers(1, 5))
+        bundles = [{"CPU": float(rng.integers(1, 5))} for _ in range(n_bundles)]
+        strategy = str(rng.choice(
+            ["PACK", "STRICT_PACK", "SPREAD", "STRICT_SPREAD"]))
+        got_py = py.place_bundles(nodes, bundles, strategy)
+        got_nat = nat.place_bundles(nodes, bundles, strategy)
+        assert got_py == got_nat, (trial, strategy, bundles, got_py, got_nat)
