@@ -1,10 +1,18 @@
 from ray_tpu.tune.search import choice, grid_search, loguniform, randint, uniform
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler, PopulationBasedTraining
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler, FIFOScheduler, HyperBandScheduler, MedianStoppingRule,
+    PopulationBasedTraining)
+from ray_tpu.tune.searchers import (
+    BayesOptSearcher, ConcurrencyLimiter, RandomSearcher, Searcher,
+    TPESearcher)
 from ray_tpu.tune.tuner import TuneConfig, Tuner, ResultGrid
 from ray_tpu.tune.session import report, get_checkpoint
 
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "report", "get_checkpoint",
     "grid_search", "uniform", "loguniform", "choice", "randint",
-    "FIFOScheduler", "ASHAScheduler", "PopulationBasedTraining",
+    "FIFOScheduler", "ASHAScheduler", "HyperBandScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining",
+    "Searcher", "RandomSearcher", "TPESearcher", "BayesOptSearcher",
+    "ConcurrencyLimiter",
 ]

@@ -13,6 +13,7 @@ Reference mechanics replicated (SURVEY appendix K):
 from __future__ import annotations
 
 import random
+import statistics
 from typing import Any, Dict, List, Optional
 
 CONTINUE = "CONTINUE"
@@ -128,3 +129,78 @@ class PopulationBasedTraining:
                 factor = self._rng.choice([0.8, 1.2])
                 config[key] = type(config[key])(config[key] * factor)
         return config
+
+
+class MedianStoppingRule:
+    """Stop a trial at step t when its running-average metric falls below
+    the median of other trials' running averages at comparable steps
+    (reference `schedulers/median_stopping_rule.py`)."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 grace_period: int = 4, min_samples_required: int = 3,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self.time_attr = time_attr
+        # trial_id -> (sum, count) of reported values
+        self._running: Dict[str, List[float]] = {}
+
+    def _val(self, result) -> Optional[float]:
+        if self.metric not in result:
+            return None
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        v = self._val(result)
+        if v is None:
+            return CONTINUE
+        acc = self._running.setdefault(trial.trial_id, [0.0, 0])
+        acc[0] += v
+        acc[1] += 1
+        t = int(result.get(self.time_attr, 0))
+        if t < self.grace_period:
+            return CONTINUE
+        others = [s / c for tid, (s, c) in self._running.items()
+                  if tid != trial.trial_id and c > 0]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        median = statistics.median(others)
+        my_avg = acc[0] / acc[1]
+        return STOP if my_avg < median else CONTINUE
+
+
+class HyperBandScheduler:
+    """Bracketed successive halving: trials are assigned round-robin to
+    brackets with staggered grace periods (the HyperBand s-sweep,
+    reference `schedulers/hyperband.py`), and each bracket runs the ASHA
+    halving rule at its own rung ladder — the asynchronous formulation of
+    HyperBand the reference recommends in practice."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 max_t: int = 81, reduction_factor: int = 3,
+                 num_brackets: int = 3,
+                 time_attr: str = "training_iteration"):
+        self.brackets = []
+        grace = 1
+        for _ in range(max(1, num_brackets)):
+            self.brackets.append(ASHAScheduler(
+                metric=metric, mode=mode, max_t=max_t,
+                grace_period=grace, reduction_factor=reduction_factor,
+                time_attr=time_attr))
+            grace *= reduction_factor
+        self._assignment: Dict[str, int] = {}
+        self._next = 0
+
+    def _bracket_for(self, trial) -> "ASHAScheduler":
+        idx = self._assignment.get(trial.trial_id)
+        if idx is None:
+            idx = self._next % len(self.brackets)
+            self._assignment[trial.trial_id] = idx
+            self._next += 1
+        return self.brackets[idx]
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        return self._bracket_for(trial).on_trial_result(runner, trial, result)

@@ -103,6 +103,7 @@ class TuneConfig:
     metric: str = "score"
     mode: str = "max"
     scheduler: Any = None
+    search_alg: Any = None            # Searcher (tune.searchers); None = variants
     resources_per_trial: Optional[Dict[str, float]] = None
     seed: int = 0
 
@@ -139,6 +140,25 @@ class TrialRunner:
                        for i, c in enumerate(configs)]
         self.cfg = tune_config
         self.scheduler = tune_config.scheduler or FIFOScheduler()
+        self.searcher = tune_config.search_alg
+        # with a searcher, trials are created adaptively up to num_samples
+        self._target = (tune_config.num_samples if self.searcher is not None
+                        else len(self.trials))
+
+    def _maybe_suggest_trials(self) -> None:
+        """Ask the searcher for new configs while slots are free."""
+        if self.searcher is None:
+            return
+        running = sum(1 for t in self.trials if t.state == "RUNNING")
+        pending = sum(1 for t in self.trials if t.state == "PENDING")
+        while (len(self.trials) < self._target
+               and running + pending < self.cfg.max_concurrent_trials):
+            trial_id = f"trial_{len(self.trials):05d}"
+            config = self.searcher.suggest(trial_id)
+            if config is None:
+                break  # e.g. ConcurrencyLimiter saturated
+            self.trials.append(Trial(trial_id=trial_id, config=config))
+            pending += 1
 
     # ----------------------------------------------------------- lifecycle
     def _start_trial(self, trial: Trial,
@@ -179,11 +199,21 @@ class TrialRunner:
 
     # ----------------------------------------------------------- main loop
     def run(self) -> None:
+        idle_retries = 0
         while True:
+            self._maybe_suggest_trials()
             running = [t for t in self.trials if t.state == "RUNNING"]
             pending = [t for t in self.trials if t.state == "PENDING"]
             if not running and not pending:
+                if (self.searcher is not None
+                        and len(self.trials) < self._target
+                        and idle_retries < 100):
+                    # searcher declined to suggest right now (limiter); retry
+                    idle_retries += 1
+                    time.sleep(0.02)
+                    continue
                 return
+            idle_retries = 0
             while pending and len(running) < self.cfg.max_concurrent_trials:
                 t = pending.pop(0)
                 self._start_trial(t)
@@ -203,6 +233,7 @@ class TrialRunner:
         except Exception as e:
             trial.error = str(e)
             self._stop_trial(trial, "ERROR")
+            self._notify_searcher(trial)
             return
         if result.get("__done__"):
             if result.get("__error__"):
@@ -211,6 +242,7 @@ class TrialRunner:
             else:
                 self._finalize_checkpoint(trial)
                 self._stop_trial(trial, "TERMINATED")
+            self._notify_searcher(trial)
             return
         trial.last_result = result
         trial.history.append(result)
@@ -220,8 +252,17 @@ class TrialRunner:
         if decision == STOP:
             self._finalize_checkpoint(trial)
             self._stop_trial(trial, "TERMINATED")
+            self._notify_searcher(trial)
         else:
             trial.pending = trial.actor.next_result.remote()
+
+    def _notify_searcher(self, trial: Trial) -> None:
+        if self.searcher is not None:
+            try:
+                self.searcher.on_trial_complete(
+                    trial.trial_id, trial.last_result or None)
+            except Exception:
+                logger.exception("searcher on_trial_complete failed")
 
     def _finalize_checkpoint(self, trial: Trial) -> None:
         if trial.actor is not None:
@@ -247,8 +288,12 @@ class Tuner:
         self._run_config = run_config
 
     def fit(self) -> ResultGrid:
-        configs = generate_configs(self._space, self._cfg.num_samples,
-                                   self._cfg.seed)
+        if self._cfg.search_alg is not None:
+            # adaptive search: every config comes from the searcher
+            configs: List[Dict[str, Any]] = []
+        else:
+            configs = generate_configs(self._space, self._cfg.num_samples,
+                                       self._cfg.seed)
         runner = TrialRunner(self._fn, configs, self._cfg)
         runner.run()
         results = []

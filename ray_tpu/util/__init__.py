@@ -17,6 +17,7 @@ from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util import collective
 from ray_tpu.util import metrics
 from ray_tpu.util import queue
+from ray_tpu.util import multiprocessing
 
 __all__ = [
     "PlacementGroup",
@@ -28,4 +29,5 @@ __all__ = [
     "collective",
     "metrics",
     "queue",
+    "multiprocessing",
 ]

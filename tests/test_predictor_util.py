@@ -1,0 +1,66 @@
+"""Predictor/BatchPredictor, multiprocessing Pool shim, joblib backend."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+def test_jax_predictor_from_checkpoint():
+    from ray_tpu.train import JaxPredictor
+
+    ckpt = Checkpoint.from_dict(
+        {"params": {"w": np.array([[2.0], [3.0]], np.float32)}})
+
+    def apply_fn(params, batch):
+        return batch["x"] @ params["w"]
+
+    pred = JaxPredictor.from_checkpoint(ckpt, apply_fn=apply_fn)
+    out = pred.predict({"x": np.array([[1.0, 1.0], [2.0, 0.0]], np.float32)})
+    np.testing.assert_allclose(out["predictions"][:, 0], [5.0, 4.0])
+
+
+def test_batch_predictor_over_datastream(ray_start_regular):
+    from ray_tpu.data import from_items
+    from ray_tpu.train import BatchPredictor, JaxPredictor
+
+    ckpt = Checkpoint.from_dict(
+        {"params": {"w": np.array([[1.0], [1.0]], np.float32)}})
+
+    def apply_fn(params, batch):
+        return batch["x"] @ params["w"]
+
+    ds = from_items([{"x": np.array([float(i), float(i)], np.float32)}
+                     for i in range(8)]).repartition(4)
+    bp = BatchPredictor.from_checkpoint(ckpt, JaxPredictor, apply_fn=apply_fn)
+    out = bp.predict(ds, num_actors=2)
+    rows = out.take_all()
+    got = sorted(float(r["predictions"][0]) for r in rows)
+    assert got == [2.0 * i for i in range(8)]
+
+
+def test_multiprocessing_pool_map(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        assert pool.map(lambda x: x * x, range(10)) == [i * i for i in range(10)]
+        assert pool.apply(lambda a, b: a + b, (2, 3)) == 5
+        assert pool.starmap(lambda a, b: a * b, [(1, 2), (3, 4)]) == [2, 12]
+        assert sorted(pool.imap_unordered(lambda x: -x, range(5))) == \
+            [-4, -3, -2, -1, 0]
+        r = pool.map_async(lambda x: x + 1, [1, 2, 3])
+        assert r.get(timeout=30) == [2, 3, 4]
+    with pytest.raises(ValueError):
+        pool.map(lambda x: x, [1])
+
+
+def test_joblib_backend(ray_start_regular):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_backend
+
+    register_backend()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(lambda x: x * 10)(i)
+                                for i in range(6))
+    assert out == [0, 10, 20, 30, 40, 50]
