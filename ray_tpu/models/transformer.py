@@ -42,7 +42,8 @@ class ModelConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
-    remat: str = "full"          # "none" | "full"
+    remat: str = "full"          # "none" | "full" | "dots" (selective)
+    loss_chunk: int = 0          # >0: chunked cross-entropy (seq chunk size)
     use_ring_attention: bool = False  # set when mesh sp > 1
     tie_embeddings: bool = False
     # Mixture of Experts: n_experts > 0 replaces the dense FFN with a
@@ -214,9 +215,30 @@ def _layer(cfg: ModelConfig, mesh, x, layer_params, cos, sin):
     return x, jnp.zeros((), jnp.float32)
 
 
-def forward_with_aux(params: Dict[str, Any], tokens: jax.Array, cfg: ModelConfig,
-                     positions: Optional[jax.Array] = None, mesh=None):
-    """tokens [b, s] -> (logits [b, s, vocab] fp32, moe_aux_loss scalar).
+def maybe_remat(layer_fn, cfg: ModelConfig):
+    """Wrap a layer body per cfg.remat: "full" recomputes everything in the
+    backward pass; "dots" keeps matmul outputs resident and recomputes only
+    the cheap elementwise/norm ops — most of full remat's memory win at a
+    fraction of its recompute FLOPs."""
+    if cfg.remat == "full":
+        return jax.checkpoint(layer_fn)
+    if cfg.remat == "dots":
+        return jax.checkpoint(
+            layer_fn,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    return layer_fn
+
+
+def lm_head_weights(params: Dict[str, Any], cfg: ModelConfig) -> jax.Array:
+    """[d_model, vocab] output-projection weights in activation dtype."""
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return head.astype(cfg.dtype)
+
+
+def forward_features_with_aux(params: Dict[str, Any], tokens: jax.Array,
+                              cfg: ModelConfig,
+                              positions: Optional[jax.Array] = None, mesh=None):
+    """tokens [b, s] -> (features [b, s, d] after final norm, moe_aux scalar).
 
     `mesh` is required when `cfg.use_ring_attention` (the sp shard_map needs
     it); everything else is pure sharding-annotation-driven SPMD.
@@ -227,9 +249,7 @@ def forward_with_aux(params: Dict[str, Any], tokens: jax.Array, cfg: ModelConfig
     cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
     cos, sin = cos[None], sin[None]  # add batch dim
 
-    layer_fn = functools.partial(_layer, cfg, mesh)
-    if cfg.remat == "full":
-        layer_fn = jax.checkpoint(layer_fn)
+    layer_fn = maybe_remat(functools.partial(_layer, cfg, mesh), cfg)
 
     def body(carry, lp):
         x, aux = carry
@@ -239,8 +259,14 @@ def forward_with_aux(params: Dict[str, Any], tokens: jax.Array, cfg: ModelConfig
     (x, aux_total), _ = jax.lax.scan(
         body, (x, jnp.zeros((), jnp.float32)), params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+    return x, aux_total
+
+
+def forward_with_aux(params: Dict[str, Any], tokens: jax.Array, cfg: ModelConfig,
+                     positions: Optional[jax.Array] = None, mesh=None):
+    """tokens [b, s] -> (logits [b, s, vocab] fp32, moe_aux_loss scalar)."""
+    x, aux_total = forward_features_with_aux(params, tokens, cfg, positions, mesh)
+    logits = (x @ lm_head_weights(params, cfg)).astype(jnp.float32)
     return logits, aux_total
 
 
@@ -272,6 +298,41 @@ def token_nll(logits: jax.Array, targets: jax.Array,
     return jnp.mean(nll)
 
 
+def chunked_token_nll(x: jax.Array, head: jax.Array, targets: jax.Array,
+                      mask: Optional[jax.Array], chunk: int) -> jax.Array:
+    """Mean NLL without materializing the full [b, s, vocab] fp32 logits.
+
+    Scans the sequence in `chunk`-sized pieces; each piece's lm-head matmul
+    + softmax runs under jax.checkpoint, so the backward pass recomputes a
+    [b, chunk, vocab] tile at a time instead of holding ~b*s*vocab*4 bytes
+    of logits (2+ GiB at 8x2048x32k) resident. The lm-head recompute is
+    ~2dV/token extra FLOPs — under 10% of the model forward — traded for
+    the HBM working set, which is what lets bigger batches fit.
+    """
+    b, s, d = x.shape
+    n_chunks = s // chunk
+    assert n_chunks * chunk == s, (s, chunk)
+    xs = x.reshape(b, n_chunks, chunk, d).swapaxes(0, 1)      # [nc, b, c, d]
+    ts = targets.reshape(b, n_chunks, chunk).swapaxes(0, 1)   # [nc, b, c]
+    maskf = (mask.astype(jnp.float32) if mask is not None
+             else jnp.ones_like(targets, jnp.float32))
+    ms = maskf.reshape(b, n_chunks, chunk).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def chunk_nll(x_c, t_c, m_c):
+        logits = (x_c @ head).astype(jnp.float32)             # [b, c, V]
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
+        return ((logz - tgt) * m_c).sum()
+
+    def body(acc, xs_t):
+        x_c, t_c, m_c = xs_t
+        return acc + chunk_nll(x_c, t_c, m_c), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xs, ts, ms))
+    return total / jnp.maximum(maskf.sum(), 1.0)
+
+
 def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
             cfg: ModelConfig, mesh=None):
     """Next-token cross entropy.
@@ -281,8 +342,18 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
     the sp axis for sequence parallelism. Optional {"loss_mask": [b, s]}.
     """
     inputs, targets, mask = split_batch(batch)
-    logits, moe_aux = forward_with_aux(params, inputs, cfg, mesh=mesh)
-    loss = token_nll(logits, targets, mask)
+    if cfg.loss_chunk:
+        if targets.shape[-1] % cfg.loss_chunk != 0:
+            raise ValueError(
+                f"loss_chunk={cfg.loss_chunk} must divide the target length "
+                f"{targets.shape[-1]} (note {{'tokens'}} batches lose one "
+                f"position to the shift)")
+        x, moe_aux = forward_features_with_aux(params, inputs, cfg, mesh=mesh)
+        loss = chunked_token_nll(x, lm_head_weights(params, cfg), targets,
+                                 mask, cfg.loss_chunk)
+    else:
+        logits, moe_aux = forward_with_aux(params, inputs, cfg, mesh=mesh)
+        loss = token_nll(logits, targets, mask)
     if cfg.n_experts > 0:
         loss = loss + cfg.moe_aux_weight * moe_aux
     return loss, {"loss": loss, "ntokens": targets.size, "moe_aux": moe_aux}

@@ -78,3 +78,39 @@ def test_sharded_matches_unsharded():
     sharded = shard_pytree(params, p_sh)
     loss_8dev, _ = jax.jit(lambda p, b: loss_fn(p, b, cfg))(sharded, batch)
     np.testing.assert_allclose(float(loss_1dev), float(loss_8dev), rtol=1e-5)
+
+
+def test_chunked_loss_matches_dense():
+    """cfg.loss_chunk computes identical loss+grads without full logits."""
+    import dataclasses
+
+    cfg = ModelConfig.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    batch = _batch(jax.random.PRNGKey(1), cfg, batch=2, seq=32)
+    cfg_c = dataclasses.replace(cfg, loss_chunk=8)
+
+    loss_d, _ = loss_fn(params, batch, cfg)
+    loss_c, _ = loss_fn(params, batch, cfg_c)
+    np.testing.assert_allclose(float(loss_d), float(loss_c), rtol=2e-5)
+
+    g_d = jax.grad(lambda p: loss_fn(p, batch, cfg)[0])(params)
+    g_c = jax.grad(lambda p: loss_fn(p, batch, cfg_c)[0])(params)
+    for leaf in ("final_norm", "lm_head", "embed"):
+        np.testing.assert_allclose(g_d[leaf], g_c[leaf], rtol=1e-4,
+                                   atol=1e-6, err_msg=leaf)
+
+    with pytest.raises(ValueError, match="loss_chunk"):
+        loss_fn(params, batch, dataclasses.replace(cfg, loss_chunk=7))
+
+
+def test_selective_remat_matches_full():
+    """remat='dots' (selective checkpoint policy) is numerically identical."""
+    import dataclasses
+
+    cfg = ModelConfig.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    batch = _batch(jax.random.PRNGKey(1), cfg, batch=2, seq=32)
+    loss_ref, _ = loss_fn(params, batch, cfg)
+    cfg_d = dataclasses.replace(cfg, remat="dots")
+    loss_dots, _ = jax.jit(lambda p: loss_fn(p, batch, cfg_d))(params)
+    np.testing.assert_allclose(float(loss_ref), float(loss_dots), rtol=2e-5)
