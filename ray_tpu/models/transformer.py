@@ -10,7 +10,8 @@ Functional JAX, TPU-first:
   - bfloat16 activations/weights with fp32 RMSNorm statistics and fp32
     logits for the softmax-cross-entropy;
   - attention is the pallas flash kernel on TPU; with sequence parallelism
-    (mesh sp>1) it switches to ring attention over the sp axis.
+    (mesh sp>1) it switches to ring attention (K/V ppermute rotation) or
+    Ulysses (head<->seq all-to-all) over the sp axis per cfg.seq_parallel.
 
 The reference has no model zoo of its own (it delegates to torch; SURVEY
 §2.4) — this model is the equivalent of the torch models its Train/RLlib
@@ -45,6 +46,10 @@ class ModelConfig:
     remat: str = "full"          # "none" | "full" | "dots" (selective)
     loss_chunk: int = 0          # >0: chunked cross-entropy (seq chunk size)
     use_ring_attention: bool = False  # set when mesh sp > 1
+    # sequence-parallel scheme when sp > 1: "ring" (K/V rotation via
+    # ppermute) or "ulysses" (head<->seq all-to-all); "" = dense attention.
+    # use_ring_attention=True is kept as an alias for seq_parallel="ring".
+    seq_parallel: str = ""
     tie_embeddings: bool = False
     # Mixture of Experts: n_experts > 0 replaces the dense FFN with a
     # top-2-gated MoE (ops/moe.py); experts shard over the "expert" axis.
@@ -189,14 +194,23 @@ def _layer(cfg: ModelConfig, mesh, x, layer_params, cos, sin):
     k = apply_rotary(k, cos, sin)
     # [b, heads, s, hd]
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-    if cfg.use_ring_attention:
+    sp_scheme = cfg.seq_parallel or ("ring" if cfg.use_ring_attention else "")
+    if sp_scheme == "ring":
+        from ray_tpu.ops.ring_attention import ring_attention_sharded
+
         rep = cfg.n_heads // cfg.n_kv_heads
         if rep > 1:
             k = jnp.repeat(k, rep, axis=1)
             v = jnp.repeat(v, rep, axis=1)
-        from ray_tpu.ops.ring_attention import ring_attention_sharded
-
         attn = ring_attention_sharded(mesh, q, k, v, causal=True)
+    elif sp_scheme == "ulysses":
+        # GQA expansion happens inside the kernel, after the all-to-all —
+        # KV heads cross ICI unexpanded
+        from ray_tpu.ops.ulysses import ulysses_attention_sharded
+
+        attn = ulysses_attention_sharded(mesh, q, k, v, causal=True)
+    elif sp_scheme:
+        raise ValueError(f"unknown seq_parallel scheme {sp_scheme!r}")
     else:
         attn = attention(q, k, v, causal=True)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
@@ -240,7 +254,8 @@ def forward_features_with_aux(params: Dict[str, Any], tokens: jax.Array,
                               positions: Optional[jax.Array] = None, mesh=None):
     """tokens [b, s] -> (features [b, s, d] after final norm, moe_aux scalar).
 
-    `mesh` is required when `cfg.use_ring_attention` (the sp shard_map needs
+    `mesh` is required when any sequence-parallel scheme is active
+    (`cfg.seq_parallel` or `cfg.use_ring_attention` — the sp shard_map needs
     it); everything else is pure sharding-annotation-driven SPMD.
     """
     if positions is None:

@@ -114,3 +114,19 @@ def test_selective_remat_matches_full():
     cfg_d = dataclasses.replace(cfg, remat="dots")
     loss_dots, _ = jax.jit(lambda p: loss_fn(p, batch, cfg_d))(params)
     np.testing.assert_allclose(float(loss_ref), float(loss_dots), rtol=2e-5)
+
+
+def test_train_step_with_ulysses_sequence_parallel():
+    import dataclasses
+
+    cfg = dataclasses.replace(ModelConfig.tiny(), seq_parallel="ulysses")
+    mesh = make_virtual_mesh(8, MeshConfig(dp=2, fsdp=1, tp=2, sp=2))
+    step_fn, init_fn, sh = make_train_step(cfg, mesh, default_optimizer(1e-3))
+    state = init_fn(jax.random.PRNGKey(0))
+    batch = _batch(jax.random.PRNGKey(1), cfg, batch=4, seq=64)
+    batch = jax.device_put(batch, {k: batch_sharding(mesh)[k] for k in batch})
+    losses = []
+    for _ in range(5):
+        state, metrics = step_fn(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
