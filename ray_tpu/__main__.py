@@ -27,6 +27,11 @@ def main(argv=None) -> int:
         node_main(argv[1:])
         return 0
 
+    if argv and argv[0] == "client-server":
+        from ray_tpu.client.server import main as client_server_main
+
+        return client_server_main(argv[1:])
+
     parser = argparse.ArgumentParser(prog="ray_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
 

@@ -72,6 +72,24 @@ def init(
         logging.basicConfig(level=log_level)
         from ray_tpu.core.worker import CoreWorker, set_current_worker
 
+        if address is not None and address.startswith("ray://"):
+            # Remote-driver client mode (reference Ray Client,
+            # python/ray/util/client/worker.py:81): a thin client over one
+            # RPC connection; the real driver lives in the client server.
+            ignored = {"num_cpus": num_cpus, "resources": resources,
+                       "labels": labels,
+                       "object_store_memory": object_store_memory}
+            bad = [k for k, v in ignored.items() if v is not None]
+            if bad:
+                raise ValueError(
+                    f"{bad} cannot be set in client mode — the cluster was "
+                    f"configured where the client server runs")
+            from ray_tpu.client import ClientWorker
+
+            _worker = ClientWorker(address)
+            atexit.register(shutdown)
+            return {"gcs_address": _worker.gcs_address, "client": True}
+
         if address is None:
             from ray_tpu.core.node import HeadNode
 

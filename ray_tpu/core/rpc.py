@@ -93,8 +93,9 @@ class RpcServer:
 
     DEFERRED = Deferred()
 
-    def __init__(self, host: str = "127.0.0.1"):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._host = host
+        self._requested_port = port
         self._handlers: Dict[str, Callable] = {}
         self._loop: asyncio.AbstractEventLoop = None  # type: ignore
         self._thread: Optional[threading.Thread] = None
@@ -128,7 +129,8 @@ class RpcServer:
         asyncio.set_event_loop(self._loop)
 
         async def _serve():
-            self._server = await asyncio.start_server(self._handle_conn, self._host, 0)
+            self._server = await asyncio.start_server(
+                self._handle_conn, self._host, self._requested_port)
             self.port = self._server.sockets[0].getsockname()[1]
             self._started.set()
 

@@ -1,0 +1,137 @@
+"""HuggingFace <-> ray_tpu weight conversion for the Llama model family.
+
+The reference has no model zoo (it wraps torch models; SURVEY §2.4), but its
+Train/RLlib users bring HF checkpoints — this module gives those users the
+same on-ramp: `load_hf_llama()` maps a `transformers` LlamaForCausalLM
+(object, state dict, or local checkpoint path) onto the layer-stacked
+`ray_tpu.models.transformer` pytree.
+
+Conventions line up exactly: HF Llama uses half-split ("rotate_half") RoPE
+with inv_freq = theta^(-2i/d), the same scheme as `ops/layers.apply_rotary`
+— so projections map with plain transposes, no head permutation. HF linear
+weights are stored [out, in] and applied as x @ W.T; ours are stored
+[in, out] and applied as x @ W, hence every projection transposes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.transformer import ModelConfig
+
+
+def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> ModelConfig:
+    """ModelConfig from a transformers LlamaConfig(-compatible) object."""
+    return ModelConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads",
+                           hf_config.num_attention_heads),
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        norm_eps=float(hf_config.rms_norm_eps),
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        dtype=dtype,
+    )
+
+
+def _to_np(t) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor
+        return t.detach().to("cpu").float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def params_from_hf_state_dict(state: Dict[str, Any], cfg: ModelConfig
+                              ) -> Dict[str, Any]:
+    """Map an HF LlamaForCausalLM state dict to the transformer pytree.
+
+    Accepts either `model.`-prefixed keys (full LlamaForCausalLM) or bare
+    ones (LlamaModel). Layer leaves are stacked on a leading L axis to
+    match `init_params` / the lax.scan forward.
+    """
+    pre = "model." if any(k.startswith("model.") for k in state) else ""
+
+    def get(key: str) -> np.ndarray:
+        return _to_np(state[key])
+
+    def stacked(fmt: str, transpose: bool) -> jnp.ndarray:
+        mats = [get(fmt.format(i=i)) for i in range(cfg.n_layers)]
+        arr = np.stack([m.T if transpose else m for m in mats])
+        return jnp.asarray(arr).astype(cfg.dtype)
+
+    layers = {
+        "attn_norm": stacked(pre + "layers.{i}.input_layernorm.weight", False),
+        "wq": stacked(pre + "layers.{i}.self_attn.q_proj.weight", True),
+        "wk": stacked(pre + "layers.{i}.self_attn.k_proj.weight", True),
+        "wv": stacked(pre + "layers.{i}.self_attn.v_proj.weight", True),
+        "wo": stacked(pre + "layers.{i}.self_attn.o_proj.weight", True),
+        "mlp_norm": stacked(pre + "layers.{i}.post_attention_layernorm.weight",
+                            False),
+        "w_gate": stacked(pre + "layers.{i}.mlp.gate_proj.weight", True),
+        "w_up": stacked(pre + "layers.{i}.mlp.up_proj.weight", True),
+        "w_down": stacked(pre + "layers.{i}.mlp.down_proj.weight", True),
+    }
+    params: Dict[str, Any] = {
+        "embed": jnp.asarray(get(pre + "embed_tokens.weight")).astype(cfg.dtype),
+        "final_norm": jnp.asarray(get(pre + "norm.weight")).astype(cfg.dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_embeddings:
+        head = state.get("lm_head.weight")
+        if head is None:
+            raise ValueError(
+                "state dict has no lm_head.weight but cfg.tie_embeddings is "
+                "False — pass a full LlamaForCausalLM state dict, or set "
+                "tie_embeddings=True if the checkpoint ties the output head "
+                "to the embeddings")
+        params["lm_head"] = jnp.asarray(_to_np(head).T).astype(cfg.dtype)
+    return params
+
+
+def load_hf_llama(model_or_path: Any, dtype: Any = jnp.bfloat16
+                  ) -> Tuple[Dict[str, Any], ModelConfig]:
+    """(params, cfg) from an HF model object or local checkpoint path."""
+    if isinstance(model_or_path, str):
+        from transformers import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.from_pretrained(model_or_path)
+    else:
+        model = model_or_path
+    cfg = config_from_hf(model.config, dtype=dtype)
+    params = params_from_hf_state_dict(model.state_dict(), cfg)
+    return params, cfg
+
+
+def state_dict_from_params(params: Dict[str, Any], cfg: ModelConfig
+                           ) -> Dict[str, np.ndarray]:
+    """Inverse mapping, for exporting trained weights back to HF tooling."""
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(
+            params["embed"], dtype=np.float32),
+        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    if not cfg.tie_embeddings:
+        out["lm_head.weight"] = np.asarray(params["lm_head"], np.float32).T
+    names = {
+        "attn_norm": ("input_layernorm.weight", False),
+        "wq": ("self_attn.q_proj.weight", True),
+        "wk": ("self_attn.k_proj.weight", True),
+        "wv": ("self_attn.v_proj.weight", True),
+        "wo": ("self_attn.o_proj.weight", True),
+        "mlp_norm": ("post_attention_layernorm.weight", False),
+        "w_gate": ("mlp.gate_proj.weight", True),
+        "w_up": ("mlp.up_proj.weight", True),
+        "w_down": ("mlp.down_proj.weight", True),
+    }
+    for ours, (theirs, transpose) in names.items():
+        stack = np.asarray(params["layers"][ours], np.float32)
+        for i in range(cfg.n_layers):
+            m = stack[i]
+            out[f"model.layers.{i}.{theirs}"] = m.T if transpose else m
+    return out

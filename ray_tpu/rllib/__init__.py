@@ -7,6 +7,8 @@ from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.impala import IMPALA, ImpalaConfig
 from ray_tpu.rllib.es import ES, ESConfig
+from ray_tpu.rllib.ars import ARS, ARSConfig
+from ray_tpu.rllib.apex import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.sac import SAC, SACConfig
 from ray_tpu.rllib.ddpg import DDPG, DDPGConfig, TD3, TD3Config
 from ray_tpu.rllib.offline import (
