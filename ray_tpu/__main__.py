@@ -51,6 +51,16 @@ def main(argv=None) -> int:
     p_tl = sub.add_parser("timeline", help="dump chrome trace json")
     p_tl.add_argument("--output", default="timeline.json")
 
+    p_serve = sub.add_parser("serve", help="model serving")
+    serve_sub = p_serve.add_subparsers(dest="serve_cmd", required=True)
+    p_sv_deploy = serve_sub.add_parser("deploy")
+    p_sv_deploy.add_argument("config_file")
+    p_sv_deploy.add_argument("--address", required=True)
+    p_sv_status = serve_sub.add_parser("status")
+    p_sv_status.add_argument("--address", required=True)
+    p_sv_down = serve_sub.add_parser("shutdown")
+    p_sv_down.add_argument("--address", required=True)
+
     p_job = sub.add_parser("job", help="job submission")
     job_sub = p_job.add_subparsers(dest="job_cmd", required=True)
     p_job_submit = job_sub.add_parser("submit")
@@ -97,6 +107,19 @@ def main(argv=None) -> int:
 
         tracing.dump(args.output)
         print(f"wrote {args.output}")
+        return 0
+
+    if args.cmd == "serve":
+        _connect(args.address)
+        from ray_tpu import serve
+
+        if args.serve_cmd == "deploy":
+            print(json.dumps(serve.deploy_config_file(args.config_file)))
+        elif args.serve_cmd == "status":
+            print(json.dumps(serve.status(), indent=2))
+        else:
+            serve.shutdown()
+            print("serve shut down")
         return 0
 
     if args.cmd == "job":

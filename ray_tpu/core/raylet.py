@@ -27,6 +27,7 @@ from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_store import SharedObjectStore
 from ray_tpu.core.scheduler import NodeView, SchedulingPolicy
+from ray_tpu.core.runtime_env_manager import env_key as _env_key
 from ray_tpu.core.task_spec import TaskSpec, TaskType
 
 logger = logging.getLogger(__name__)
@@ -42,6 +43,7 @@ class WorkerHandle:
     actor_id: Optional[ActorID] = None    # dedicated actor worker
     current_task: Optional[TaskSpec] = None
     idle_since: float = field(default_factory=time.monotonic)
+    env_key: Optional[str] = None         # pip runtime-env pool this worker serves
     # resources held for the actor's lifetime: (bundle_key | None, demand)
     actor_charge: Optional[Tuple[Optional[Tuple], Dict[str, float]]] = None
 
@@ -83,7 +85,12 @@ class Raylet:
         self._workers: Dict[WorkerID, WorkerHandle] = {}
         self._idle_workers: deque[WorkerID] = deque()
         self._starting: List[subprocess.Popen] = []
+        self._starting_env: Dict[int, str] = {}  # pid -> env_key
+        self._env_spawning: set = set()          # env_keys mid-creation
         self._pending_actor_specs: deque = deque()
+        from ray_tpu.core.runtime_env_manager import RuntimeEnvManager
+
+        self._env_manager = RuntimeEnvManager()
 
         # cluster view: node_id hex -> {address, total, available, labels, alive}
         self._cluster_view: Dict[str, dict] = {}
@@ -240,20 +247,29 @@ class Raylet:
                     handle.proc = p
                     self._starting.remove(p)
                     break
+            spawned_env = self._starting_env.pop(payload["pid"], None)
+            handle.env_key = payload.get("env_key") or spawned_env
             self._workers[wid] = handle
             conn.on_close.append(lambda c, wid=wid: self._on_worker_disconnect(wid))
             if payload.get("worker_type") == "driver":
                 return {"node_id": self.node_id.binary(), "gcs_address": self.gcs_address}
-            # a fresh worker: give it a pending actor spec or mark idle
-            if self._pending_actor_specs:
-                spec = self._pending_actor_specs.popleft()
+            # a fresh worker: give it a pending actor spec (from the same
+            # runtime-env pool) or mark idle
+            spec = None
+            for s in self._pending_actor_specs:
+                if _env_key(s.runtime_env) == handle.env_key:
+                    spec = s
+                    break
+            if spec is not None:
+                self._pending_actor_specs.remove(spec)
                 self._assign_actor(handle, spec)
             else:
                 self._idle_workers.append(wid)
         self._schedule()
         return {"node_id": self.node_id.binary(), "gcs_address": self.gcs_address}
 
-    def _spawn_worker(self) -> None:
+    def _spawn_worker(self, env_key: Optional[str] = None,
+                      runtime_env: Optional[dict] = None) -> None:
         env = dict(os.environ)
         env.update(self.worker_env)
         env.setdefault("JAX_PLATFORMS", "cpu")  # workers default to CPU JAX
@@ -265,13 +281,65 @@ class Raylet:
         existing = env.get("PYTHONPATH", "")
         if pkg_root not in existing.split(os.pathsep):
             env["PYTHONPATH"] = pkg_root + (os.pathsep + existing if existing else "")
+        python = sys.executable
+        if env_key is not None:
+            # venv-backed pip env: resolve (and lazily create) the
+            # interpreter off the scheduler thread, then spawn from it
+            env["RAY_TPU_RUNTIME_ENV_KEY"] = env_key
+            with self._lock:
+                if env_key in self._env_spawning:
+                    return  # one spawn per env at a time while creating
+                self._env_spawning.add(env_key)
+
+            def create_and_spawn():
+                try:
+                    py = self._env_manager.python_for(runtime_env)
+                except RuntimeError as e:
+                    logger.warning("%s", e)
+                    self._fail_env_tasks(env_key, str(e))
+                    return
+                finally:
+                    with self._lock:
+                        self._env_spawning.discard(env_key)
+                self._launch_worker(py, env)
+
+            threading.Thread(target=create_and_spawn, daemon=True,
+                             name="runtime-env-create").start()
+            return
+        self._launch_worker(python, env)
+
+    def _launch_worker(self, python: str, env: Dict[str, str]) -> None:
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main",
+            [python, "-m", "ray_tpu.core.worker_main",
              "--raylet", self._server.address, "--gcs", self.gcs_address,
              "--node-id", self.node_id.hex()],
             env=env,
         )
-        self._starting.append(proc)
+        with self._lock:
+            self._starting.append(proc)
+            key = env.get("RAY_TPU_RUNTIME_ENV_KEY")
+            if key:
+                self._starting_env[proc.pid] = key
+
+    def _fail_env_tasks(self, env_key: str, msg: str) -> None:
+        """Fail every queued task/actor whose pip env could not be built."""
+        with self._lock:
+            bad_tasks = [qt for qt in self._queue
+                         if _env_key(qt.spec.runtime_env) == env_key]
+            for qt in bad_tasks:
+                self._queue.remove(qt)
+            bad_actors = [s for s in self._pending_actor_specs
+                          if _env_key(s.runtime_env) == env_key]
+            for s in bad_actors:
+                self._pending_actor_specs.remove(s)
+        for qt in bad_tasks:
+            self._notify_owner_task_failed(qt.spec, msg)
+        for s in bad_actors:
+            try:
+                self._gcs.notify("actor_failed", {
+                    "actor_id": s.actor_id, "reason": msg})
+            except Exception:
+                pass
 
     def _on_worker_disconnect(self, wid: WorkerID) -> None:
         with self._lock:
@@ -297,6 +365,13 @@ class Raylet:
             except Exception:
                 pass
         self._schedule()
+
+    def _notify_owner_task_failed(self, spec: TaskSpec, msg: str) -> None:
+        try:
+            owner = self._peer(spec.owner_address)
+            owner.notify("task_failed", {"task_id": spec.task_id, "error": msg})
+        except Exception:
+            logger.warning("could not notify owner of failed task %s", spec.task_id)
 
     def _notify_owner_worker_died(self, spec: TaskSpec) -> None:
         from ray_tpu.core.exceptions import WorkerCrashedError
@@ -372,10 +447,16 @@ class Raylet:
                 if not self._resources_ok(spec, demand):
                     pending.append(qt)
                     continue
-                handle = self._acquire_worker()
+                ekey = _env_key(spec.runtime_env)
+                if ekey is not None:
+                    env_err = self._env_manager.creation_error(ekey)
+                    if env_err is not None:
+                        self._notify_owner_task_failed(spec, env_err)
+                        continue
+                handle = self._acquire_worker(ekey)
                 if handle is None:
                     pending.append(qt)
-                    self._maybe_spawn()
+                    self._maybe_spawn(ekey, spec.runtime_env)
                     continue
                 self._charge_resources(spec, demand)
                 handle.current_task = spec
@@ -458,17 +539,26 @@ class Raylet:
             for r, q in demand.items():
                 pool[r] = pool.get(r, 0.0) + q
 
-    def _acquire_worker(self) -> Optional[WorkerHandle]:
-        while self._idle_workers:
-            wid = self._idle_workers.popleft()
+    def _acquire_worker(self, env_key: Optional[str] = None
+                        ) -> Optional[WorkerHandle]:
+        """Pop an idle worker from the matching runtime-env pool."""
+        for wid in list(self._idle_workers):
             w = self._workers.get(wid)
-            if w is not None and w.conn.alive:
+            if w is None or not w.conn.alive:
+                self._idle_workers.remove(wid)
+                continue
+            if w.env_key == env_key:
+                self._idle_workers.remove(wid)
                 return w
         return None
 
-    def _maybe_spawn(self) -> None:
+    def _maybe_spawn(self, env_key: Optional[str] = None,
+                     runtime_env: Optional[dict] = None) -> None:
+        if env_key is not None and \
+                self._env_manager.creation_error(env_key) is not None:
+            return  # creation already failed; don't respawn forever
         if len(self._starting) < get_config().maximum_startup_concurrency:
-            self._spawn_worker()
+            self._spawn_worker(env_key, runtime_env)
 
     def rpc_task_done(self, conn, req_id, payload):
         wid: WorkerID = payload["worker_id"]
@@ -492,11 +582,18 @@ class Raylet:
     def rpc_create_actor(self, conn, req_id, payload):
         """Push from GCS: lease a dedicated worker and instantiate."""
         spec = payload["spec"]
+        ekey = _env_key(spec.runtime_env)
+        if ekey is not None:
+            env_err = self._env_manager.creation_error(ekey)
+            if env_err is not None:
+                self._gcs.notify("actor_failed", {
+                    "actor_id": spec.actor_id, "reason": env_err})
+                return True
         with self._lock:
-            handle = self._acquire_worker()
+            handle = self._acquire_worker(ekey)
             if handle is None:
                 self._pending_actor_specs.append(spec)
-                self._maybe_spawn()
+                self._maybe_spawn(ekey, spec.runtime_env)
                 return True
             self._assign_actor(handle, spec)
         return True

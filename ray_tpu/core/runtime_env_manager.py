@@ -1,0 +1,126 @@
+"""Runtime-env manager: venv-backed pip environments for workers.
+
+Equivalent of the reference's runtime-env agent
+(`dashboard/modules/runtime_env/runtime_env_agent.py:161` +
+`_private/runtime_env/pip.py`): a `pip` runtime env resolves to a cached
+virtualenv (created with --system-site-packages so jax/numpy resolve from
+the base image — the reference's pip plugin inherits site-packages the same
+way), and workers for that env are spawned from the venv's interpreter.
+Environments are content-addressed by the normalized spec, created once
+under a filesystem lock, and reused across jobs; creation failures are
+remembered so queued work fails fast instead of respawning forever.
+
+Lightweight fields (env_vars, working_dir) are applied in-process by the
+worker (`core/worker.py _apply_runtime_env`) and need no dedicated pool.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_BASE = "/tmp/ray_tpu/runtime_envs"
+
+
+def env_key(runtime_env: Optional[dict]) -> Optional[str]:
+    """Stable key for envs that need a dedicated worker pool; None when any
+    worker can run the task after in-process env application."""
+    if not runtime_env:
+        return None
+    pip = runtime_env.get("pip")
+    if not pip:
+        return None
+    if isinstance(pip, dict):  # {"packages": [...]} form
+        pip = pip.get("packages", [])
+    spec = {"pip": sorted(str(p) for p in pip)}
+    return hashlib.sha1(json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
+
+
+class RuntimeEnvManager:
+    """Creates and caches venvs; thread-safe, one creation per key."""
+
+    def __init__(self, base_dir: str = _DEFAULT_BASE):
+        self.base_dir = base_dir
+        self._lock = threading.Lock()
+        self._locks: Dict[str, threading.Lock] = {}
+        self._failed: Dict[str, str] = {}
+
+    def _key_lock(self, key: str) -> threading.Lock:
+        with self._lock:
+            return self._locks.setdefault(key, threading.Lock())
+
+    def creation_error(self, key: str) -> Optional[str]:
+        return self._failed.get(key)
+
+    def python_for(self, runtime_env: dict) -> str:
+        """Blocking: return the env's python executable, creating the venv
+        on first use. Raises RuntimeError on (possibly cached) failure."""
+        import fcntl
+
+        key = env_key(runtime_env)
+        assert key is not None
+        with self._key_lock(key):
+            if key in self._failed:
+                raise RuntimeError(self._failed[key])
+            env_dir = os.path.join(self.base_dir, key)
+            py = os.path.join(env_dir, "bin", "python")
+            marker = os.path.join(env_dir, ".ready")
+            if os.path.exists(marker):
+                return py
+            # cross-process lock: multiple raylets (in-process Cluster or
+            # co-hosted nodes) share /tmp/ray_tpu/runtime_envs — exactly one
+            # builds the env, the rest wait and reuse it
+            os.makedirs(self.base_dir, exist_ok=True)
+            with open(os.path.join(self.base_dir, f".{key}.lock"), "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+                try:
+                    if os.path.exists(marker):
+                        return py
+                    pip = runtime_env.get("pip")
+                    if isinstance(pip, dict):
+                        pip = pip.get("packages", [])
+                    try:
+                        self._create(env_dir, py, [str(p) for p in pip])
+                    except Exception as e:
+                        msg = f"runtime env creation failed for pip={pip}: {e}"
+                        self._failed[key] = msg
+                        raise RuntimeError(msg) from None
+                    with open(marker, "w") as f:
+                        f.write(json.dumps({"pip": pip}))
+                    return py
+                finally:
+                    fcntl.flock(lk, fcntl.LOCK_UN)
+
+    def _create(self, env_dir: str, py: str, pip: list) -> None:
+        import sysconfig
+
+        os.makedirs(self.base_dir, exist_ok=True)
+        logger.info("creating runtime env at %s (pip=%s)", env_dir, pip)
+        subprocess.run(
+            [sys.executable, "-m", "venv", "--system-site-packages", env_dir],
+            check=True, capture_output=True)
+        # When this process itself runs in a venv, --system-site-packages
+        # points at the *base* interpreter, not our parent venv — link the
+        # parent's site-packages too (after the env's own dir, so installed
+        # packages shadow inherited ones).
+        child_purelib = subprocess.run(
+            [py, "-c", "import sysconfig; print(sysconfig.get_paths()['purelib'])"],
+            check=True, capture_output=True, text=True).stdout.strip()
+        parent_purelib = sysconfig.get_paths()["purelib"]
+        if parent_purelib != child_purelib:
+            with open(os.path.join(child_purelib, "_parent_site.pth"), "w") as f:
+                f.write(parent_purelib + "\n")
+        if pip:
+            r = subprocess.run(
+                [py, "-m", "pip", "install", "--no-input", *pip],
+                capture_output=True, text=True, timeout=600)
+            if r.returncode != 0:
+                raise RuntimeError(r.stderr[-2000:])

@@ -209,6 +209,7 @@ class CoreWorker:
             "worker_type": mode,
             "address": self._server.address,
             "pid": os.getpid(),
+            "env_key": os.environ.get("RAY_TPU_RUNTIME_ENV_KEY"),
         })
         self.node_id = reply["node_id"]
         self._registered.set()
@@ -622,6 +623,28 @@ class CoreWorker:
         self._pending_tasks.pop(task_id, None)
         err_blob = serialization.dumps(
             WorkerCrashedError(f"worker died while running {spec.method_name}"))
+        for oid in spec.return_object_ids():
+            with self._obj_lock:
+                st = self._objects.get(oid)
+                if st is not None and st.state == "pending":
+                    st.state = "error"
+                    st.inline_blob = err_blob
+                    self._obj_cv.notify_all()
+            self._notify_info_waiters(oid)
+        self._unpin_after_task(spec)
+        return True
+
+    def rpc_task_failed(self, conn, req_id, payload):
+        """Raylet push: task cannot run (e.g. runtime-env creation failed).
+        Deterministic — fail the returns without retrying."""
+        task_id: TaskID = payload["task_id"]
+        pend = self._pending_tasks.pop(task_id, None)
+        if pend is None:
+            return True
+        spec = pend[0]
+        from ray_tpu.core.exceptions import RuntimeEnvSetupError
+
+        err_blob = serialization.dumps(RuntimeEnvSetupError(payload["error"]))
         for oid in spec.return_object_ids():
             with self._obj_lock:
                 st = self._objects.get(oid)

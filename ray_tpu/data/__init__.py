@@ -7,9 +7,12 @@ from ray_tpu.data.datastream import (
     from_numpy,
     range as range_,
     range_tensor,
+    read_binary_files,
     read_csv,
     read_json,
+    read_numpy,
     read_parquet,
+    read_tfrecords,
     read_text,
 )
 

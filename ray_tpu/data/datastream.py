@@ -314,6 +314,27 @@ class Datastream:
     def write_parquet(self, path: str) -> List[str]:
         return self._write(path, "parquet", _write_block_parquet)
 
+    def write_tfrecords(self, path: str) -> List[str]:
+        return self._write(path, "tfrecords", _write_block_tfrecords)
+
+    def train_test_split(self, test_size: Union[int, float], *,
+                         shuffle: bool = False, seed: Optional[int] = None):
+        """(train, test) split (reference Datastream.train_test_split)."""
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        n = ds.count()
+        n_test = int(n * test_size) if isinstance(test_size, float) else test_size
+        return ds.split_at_indices([n - n_test])
+
+    def split_at_indices(self, indices: List[int]) -> List["Datastream"]:
+        """Split into len(indices)+1 streams at global row offsets."""
+        rows = self.take_all()
+        out = []
+        prev = 0
+        for idx in list(indices) + [len(rows)]:
+            out.append(from_items(rows[prev:idx], parallelism=1))
+            prev = idx
+        return out
+
     def take(self, limit: int = 20) -> List[Any]:
         out: List[Any] = []
         for ref in self._executed_refs():
@@ -737,3 +758,61 @@ def read_parquet(paths: Union[str, List[str]]) -> Datastream:
         return {c: table[c].to_numpy() for c in table.column_names}
 
     return Datastream([load.remote(p) for p in paths])
+
+
+def read_numpy(paths: Union[str, List[str]]) -> Datastream:
+    """.npy files, one tensor column per file (reference numpy datasource)."""
+    paths = [paths] if isinstance(paths, str) else list(paths)
+
+    @ray_tpu.remote
+    def load(path: str) -> Block:
+        return {"data": np.load(path)}
+
+    return Datastream([load.remote(p) for p in paths])
+
+
+def read_binary_files(paths: Union[str, List[str]],
+                      include_paths: bool = False) -> Datastream:
+    """Raw bytes per file (reference binary datasource)."""
+    paths = [paths] if isinstance(paths, str) else list(paths)
+
+    @ray_tpu.remote
+    def load(path: str) -> Block:
+        with open(path, "rb") as f:
+            data = f.read()
+        row = {"bytes": data}
+        if include_paths:
+            row["path"] = path
+        return [row]
+
+    return Datastream([load.remote(p) for p in paths])
+
+
+def read_tfrecords(paths: Union[str, List[str]]) -> Datastream:
+    """tf.train.Example TFRecord files, decoded without a TF dependency
+    (ray_tpu.data.tfrecord; reference tfrecords_datasource.py). Scalar
+    features unwrap to scalars, multi-element ones stay arrays/lists."""
+    paths = [paths] if isinstance(paths, str) else list(paths)
+
+    @ray_tpu.remote
+    def load(path: str) -> Block:
+        from ray_tpu.data.tfrecord import decode_example, read_records
+
+        rows = []
+        for rec in read_records(path):
+            row = {}
+            for k, v in decode_example(rec).items():
+                if len(v) == 1:
+                    v = v[0]
+                row[k] = v
+            rows.append(row)
+        return _rows_to_block(rows)
+
+    return Datastream([load.remote(p) for p in paths])
+
+
+def _write_block_tfrecords(block: Block, path: str) -> None:
+    from ray_tpu.data.tfrecord import encode_example, write_records
+
+    write_records(path, [encode_example(
+        {k: v for k, v in row.items()}) for row in _block_rows(block)])

@@ -1,29 +1,37 @@
 """RuntimeEnv: per-task/actor environment configuration.
 
 Mirrors the reference's public dataclass
-(`python/ray/runtime_env/runtime_env.py`) for the fields this build
-supports natively: `env_vars` and `working_dir` are applied in the worker
-before execution (ray_tpu/core/worker.py `_apply_runtime_env`). Conda/pip
-isolation would require per-env worker pools (reference
-`_private/runtime_env/{conda,pip}.py` + agent); that is a round-2+ item and
-raises NotImplementedError rather than silently ignoring.
+(`python/ray/runtime_env/runtime_env.py`). `env_vars` and `working_dir` are
+applied in-process by the executing worker (core/worker.py
+`_apply_runtime_env`); `pip` resolves to a cached virtualenv-backed worker
+pool on each node (core/runtime_env_manager.py, the equivalent of the
+reference's `_private/runtime_env/pip.py` + per-env worker pools in
+`src/ray/raylet/worker_pool.cc:1664`). Conda is not supported — pip covers
+the isolation story without a conda toolchain in the image.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Union
 
 
 class RuntimeEnv(dict):
     def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
                  working_dir: Optional[str] = None,
-                 pip: Optional[list] = None, conda: Optional[str] = None):
-        if pip or conda:
+                 pip: Optional[Union[List[str], Dict]] = None,
+                 conda: Optional[str] = None):
+        if conda:
             raise NotImplementedError(
-                "pip/conda runtime envs need per-env worker pools (planned); "
-                "supported fields: env_vars, working_dir")
+                "conda runtime envs are not supported; use pip")
         super().__init__()
         if env_vars:
             self["env_vars"] = dict(env_vars)
         if working_dir:
             self["working_dir"] = working_dir
+        if pip:
+            if isinstance(pip, str):
+                # requirements.txt path, read client-side like the reference
+                with open(pip) as f:
+                    pip = [ln.strip() for ln in f
+                           if ln.strip() and not ln.startswith("#")]
+            self["pip"] = list(pip) if not isinstance(pip, dict) else pip

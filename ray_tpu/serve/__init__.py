@@ -2,8 +2,12 @@ from ray_tpu.serve.api import (
     deployment,
     run,
     shutdown,
+    status,
+    delete,
     get_deployment_handle,
     start_http_proxy,
+    AutoscalingConfig,
     Deployment,
     DeploymentHandle,
 )
+from ray_tpu.serve.config import deploy_config_file, load_config

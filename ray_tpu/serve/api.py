@@ -332,22 +332,72 @@ def _get_or_create_controller():
             name=CONTROLLER_NAME, num_cpus=0, max_concurrency=8).remote()
 
 
+def _collect_graph(root: Deployment, order: List[Deployment],
+                   seen: set, visiting: set) -> None:
+    """Topo-sort the deployment DAG reachable through bound init args
+    (reference deployment-graph build, _private/deployment_graph_build.py)."""
+    if id(root) in visiting:
+        raise ValueError(f"deployment graph has a cycle at {root.name!r}")
+    if id(root) in seen:
+        return
+    visiting.add(id(root))
+    for a in list(root.init_args) + list((root.init_kwargs or {}).values()):
+        if isinstance(a, Deployment):
+            _collect_graph(a, order, seen, visiting)
+    visiting.discard(id(root))
+    seen.add(id(root))
+    order.append(root)
+
+
+def _resolve_arg(a):
+    return DeploymentHandle(a.name) if isinstance(a, Deployment) else a
+
+
 def run(target: Deployment, *, name: str = "default") -> DeploymentHandle:
-    """Deploy and return a handle (reference serve.run, api.py:460)."""
+    """Deploy (a graph of) deployments and return the root handle
+    (reference serve.run, api.py:460). Bound init args that are themselves
+    deployments deploy first and arrive as DeploymentHandles — the
+    composition model of the reference's deployment graphs."""
     controller = _get_or_create_controller()
-    ray_tpu.get(controller.deploy.remote(
-        target.name,
-        cloudpickle.dumps(target.func_or_class),
-        target.init_args,
-        target.init_kwargs,
-        target.num_replicas,
-        target.ray_actor_options,
-        target.autoscaling_config,
-        target.max_concurrent_queries,
-    ))
+    order: List[Deployment] = []
+    _collect_graph(target, order, set(), set())
+    names = [d.name for d in order]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate deployment names in graph: {names}")
+    for d in order:
+        init_args = tuple(_resolve_arg(a) for a in d.init_args)
+        init_kwargs = {k: _resolve_arg(v)
+                       for k, v in (d.init_kwargs or {}).items()} or None
+        ray_tpu.get(controller.deploy.remote(
+            d.name,
+            cloudpickle.dumps(d.func_or_class),
+            init_args,
+            init_kwargs,
+            d.num_replicas,
+            d.ray_actor_options,
+            d.autoscaling_config,
+            d.max_concurrent_queries,
+        ))
     handle = DeploymentHandle(target.name)
     handle._refresh()
     return handle
+
+
+def status() -> Dict[str, Any]:
+    """Deployment -> {target, replicas} (reference serve.status)."""
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return {}
+    return ray_tpu.get(controller.list_deployments.remote())
+
+
+def delete(name: str) -> bool:
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return False
+    return ray_tpu.get(controller.delete_deployment.remote(name))
 
 
 def get_deployment_handle(name: str) -> DeploymentHandle:
@@ -380,12 +430,11 @@ class _HTTPProxyActor:
         proxy = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
-            def do_POST(self):
-                name = self.path.strip("/")
-                length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length) if length else b"{}"
+            def _serve(self, payload):
+                from urllib.parse import urlparse
+
+                name = urlparse(self.path).path.strip("/")
                 try:
-                    payload = json.loads(body) if body else {}
                     handle = proxy._handles.setdefault(
                         name, DeploymentHandle(name))
                     out = ray_tpu.get(handle.remote(payload), timeout=60)
@@ -398,6 +447,27 @@ class _HTTPProxyActor:
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b"{}"
+                try:
+                    payload = json.loads(body) if body else {}
+                except ValueError as e:
+                    data = json.dumps({"error": f"bad JSON body: {e}"}).encode()
+                    self.send_response(400)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                self._serve(payload)
+
+            def do_GET(self):
+                from urllib.parse import parse_qsl, urlparse
+
+                query = dict(parse_qsl(urlparse(self.path).query))
+                self._serve(query)
 
             def log_message(self, *a):
                 pass

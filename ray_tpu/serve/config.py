@@ -1,0 +1,85 @@
+"""Declarative Serve config (reference `python/ray/serve/schema.py` +
+`serve deploy` in `python/ray/serve/scripts.py`).
+
+Schema (YAML or JSON):
+
+    applications:
+      - name: my_app              # optional; defaults to the root deployment
+        import_path: pkg.mod:app  # module attr holding a (bound) Deployment
+        deployments:              # optional per-deployment overrides
+          - name: Model
+            num_replicas: 3
+
+`deploy_config_file` imports each application's root deployment, applies
+overrides, and `serve.run`s it.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict, List
+
+from ray_tpu.serve import api as serve_api
+
+
+def load_config(path: str) -> Dict[str, Any]:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    if not isinstance(cfg, dict) or "applications" not in cfg:
+        raise ValueError(f"{path}: expected a mapping with 'applications'")
+    return cfg
+
+
+def _import_target(import_path: str) -> serve_api.Deployment:
+    if ":" not in import_path:
+        raise ValueError(
+            f"import_path {import_path!r} must be 'module.path:attribute'")
+    mod_name, attr = import_path.split(":", 1)
+    target = getattr(importlib.import_module(mod_name), attr)
+    if not isinstance(target, serve_api.Deployment):
+        raise TypeError(f"{import_path} is {type(target)}, not a Deployment")
+    return target
+
+
+def _apply_overrides(root: serve_api.Deployment,
+                     overrides: List[Dict[str, Any]]) -> serve_api.Deployment:
+    by_name = {o["name"]: {k: v for k, v in o.items() if k != "name"}
+               for o in overrides}
+    # memoized by identity: a diamond graph's shared node must stay one
+    # object, or serve.run sees two same-named deployments and rejects it
+    rewritten: Dict[int, serve_api.Deployment] = {}
+    in_progress: set = set()
+
+    def rewrite(d: serve_api.Deployment) -> serve_api.Deployment:
+        if id(d) in rewritten:
+            return rewritten[id(d)]
+        if id(d) in in_progress:
+            raise ValueError(f"deployment graph has a cycle at {d.name!r}")
+        in_progress.add(id(d))
+        new_args = tuple(rewrite(a) if isinstance(a, serve_api.Deployment)
+                         else a for a in d.init_args)
+        new_kwargs = {k: rewrite(v) if isinstance(v, serve_api.Deployment)
+                      else v for k, v in (d.init_kwargs or {}).items()} or None
+        out = d.options(init_args=new_args, init_kwargs=new_kwargs)
+        if out.name in by_name:
+            out = out.options(**by_name[out.name])
+        in_progress.discard(id(d))
+        rewritten[id(d)] = out
+        return out
+
+    return rewrite(root)
+
+
+def deploy_config_file(path: str) -> Dict[str, Any]:
+    """Deploy every application in the config; returns {app_name: root}."""
+    cfg = load_config(path)
+    deployed: Dict[str, str] = {}
+    for app in cfg["applications"]:
+        root = _import_target(app["import_path"])
+        if app.get("deployments"):
+            root = _apply_overrides(root, app["deployments"])
+        serve_api.run(root, name=app.get("name", root.name))
+        deployed[app.get("name", root.name)] = root.name
+    return deployed

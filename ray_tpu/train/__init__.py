@@ -1,2 +1,3 @@
 from ray_tpu.train.step import TrainState, make_train_step, make_init_fn, batch_sharding
 from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor
+from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer

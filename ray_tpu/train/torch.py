@@ -1,0 +1,103 @@
+"""TorchTrainer: torch.distributed DDP over the actor worker group.
+
+Mirrors the reference's Torch backend (`python/ray/train/torch/config.py:29`,
+`_setup_torch_process_group:69` and `train_loop_utils.py prepare_model/
+prepare_data_loader`): the trainer reserves a rendezvous port, every worker
+actor joins a gloo process group before the user loop runs, and
+`prepare_model`/`prepare_data_loader` wrap the user's module/loader in DDP +
+DistributedSampler. gloo (CPU) is the backend — on this framework the TPU
+compute path is JAX (`JaxTrainer`); TorchTrainer exists so reference users'
+torch training code ports over unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air import session as air_session
+from ray_tpu.train.trainer import DataParallelTrainer, _takes_arg
+
+logger = logging.getLogger(__name__)
+
+_MASTER_KEY = "_torch_master_addr"
+
+
+@dataclass
+class TorchConfig:
+    backend: str = "gloo"
+    init_timeout_s: float = 120.0
+
+
+def get_device():
+    import torch
+
+    return torch.device("cpu")
+
+
+def prepare_model(model):
+    """Wrap in DDP when world_size > 1 (reference train_loop_utils.py:25)."""
+    import torch.distributed as dist
+
+    if dist.is_initialized() and dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(loader):
+    """Re-batch with a DistributedSampler so each rank sees its shard."""
+    import torch.distributed as dist
+    import torch.utils.data as tud
+
+    if not (dist.is_initialized() and dist.get_world_size() > 1):
+        return loader
+    sampler = tud.distributed.DistributedSampler(loader.dataset)
+    return tud.DataLoader(loader.dataset, batch_size=loader.batch_size,
+                          sampler=sampler, num_workers=0,
+                          collate_fn=loader.collate_fn)
+
+
+def _wrap_with_process_group(train_loop: Callable, torch_config: TorchConfig):
+    def wrapped(config: Dict[str, Any]):
+        import datetime
+
+        import torch.distributed as dist
+
+        addr = config.pop(_MASTER_KEY)
+        rank = air_session.get_world_rank()
+        world = air_session.get_world_size()
+        dist.init_process_group(
+            torch_config.backend, init_method=f"tcp://{addr}",
+            rank=rank, world_size=world,
+            timeout=datetime.timedelta(seconds=torch_config.init_timeout_s))
+        try:
+            train_loop(config) if _takes_arg(train_loop) else train_loop()
+        finally:
+            dist.destroy_process_group()
+
+    return wrapped
+
+
+class TorchTrainer(DataParallelTrainer):
+    """(reference `python/ray/train/torch/torch_trainer.py`)."""
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 torch_config: Optional[TorchConfig] = None, **kwargs):
+        self._torch_config = torch_config or TorchConfig()
+        super().__init__(
+            _wrap_with_process_group(train_loop_per_worker,
+                                     self._torch_config),
+            **kwargs)
+
+    def _fit_once(self, checkpoint):
+        # fresh rendezvous address per attempt (reference config.py:69 picks
+        # a port on the rank-0 node; workers here share this host)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        self._config[_MASTER_KEY] = f"127.0.0.1:{port}"
+        return super()._fit_once(checkpoint)

@@ -122,5 +122,6 @@ def test_runtime_env_env_vars(ray_start_regular):
 
     assert ray_tpu.get(read_env.remote()) == "hello"
 
+    assert RuntimeEnv(pip=["requests"])["pip"] == ["requests"]
     with pytest.raises(NotImplementedError):
-        RuntimeEnv(pip=["requests"])
+        RuntimeEnv(conda="myenv")

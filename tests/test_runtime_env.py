@@ -1,0 +1,90 @@
+"""Pip runtime envs: venv-backed per-env worker pools (offline-safe —
+installs a local package path, no index access)."""
+
+import os
+import shutil
+import subprocess
+import textwrap
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def local_pkg(tmp_path_factory):
+    """A minimal installable package at a local path."""
+    root = tmp_path_factory.mktemp("pkg") / "tpu_testpkg"
+    (root / "tpu_testpkg").mkdir(parents=True)
+    (root / "tpu_testpkg" / "__init__.py").write_text(
+        "MAGIC = 'runtime-env-works'\n")
+    (root / "pyproject.toml").write_text(textwrap.dedent("""\
+        [build-system]
+        requires = ["setuptools"]
+        build-backend = "setuptools.build_meta"
+
+        [project]
+        name = "tpu-testpkg"
+        version = "0.1"
+    """))
+    return str(root)
+
+
+def test_env_key_stability():
+    from ray_tpu.core.runtime_env_manager import env_key
+
+    assert env_key(None) is None
+    assert env_key({"env_vars": {"A": "1"}}) is None
+    k1 = env_key({"pip": ["b", "a"]})
+    assert k1 == env_key({"pip": ["a", "b"]})
+    assert k1 != env_key({"pip": ["a"]})
+    assert env_key({"pip": {"packages": ["a", "b"]}}) == k1
+
+
+@pytest.mark.slow
+def test_pip_runtime_env_task(ray_start_regular, local_pkg):
+    @ray_tpu.remote
+    def probe():
+        import tpu_testpkg
+
+        return tpu_testpkg.MAGIC, tpu_testpkg.__file__
+
+    # no runtime env: the package must NOT be importable
+    with pytest.raises(Exception, match="tpu_testpkg"):
+        ray_tpu.get(probe.remote(), timeout=120)
+
+    r = probe.options(
+        runtime_env={"pip": ["--no-index", "--no-build-isolation", local_pkg]}
+    ).remote()
+    magic, path = ray_tpu.get(r, timeout=300)
+    assert magic == "runtime-env-works"
+    assert "/runtime_envs/" in path  # imported from the venv, not base site
+
+
+@pytest.mark.slow
+def test_pip_runtime_env_actor(ray_start_regular, local_pkg):
+    @ray_tpu.remote
+    class EnvActor:
+        def probe(self):
+            import tpu_testpkg
+
+            return tpu_testpkg.MAGIC
+
+    a = EnvActor.options(runtime_env={
+        "pip": ["--no-index", "--no-build-isolation", local_pkg]}).remote()
+    assert ray_tpu.get(a.probe.remote(), timeout=300) == "runtime-env-works"
+    ray_tpu.kill(a)
+
+
+@pytest.mark.slow
+def test_pip_runtime_env_failure_propagates(ray_start_regular):
+    from ray_tpu.core.exceptions import RuntimeEnvSetupError
+
+    @ray_tpu.remote
+    def never_runs():
+        return 1
+
+    r = never_runs.options(runtime_env={
+        "pip": ["--no-index", "/nonexistent/definitely-not-a-package"]}).remote()
+    with pytest.raises(RuntimeEnvSetupError):
+        ray_tpu.get(r, timeout=300)

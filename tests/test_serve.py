@@ -117,3 +117,81 @@ def test_autoscaling_up(serve_cluster):
         time.sleep(0.2)
     assert scaled, "controller never scaled up under queue pressure"
     assert ray_tpu.get(refs, timeout=60) == ["ok"] * 8
+
+
+def test_deployment_graph_composition(serve_cluster):
+    """Bound deployments as init args deploy first and arrive as handles
+    (reference deployment graphs, _private/deployment_graph_build.py)."""
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, doubler):
+            self.doubler = doubler
+
+        def __call__(self, x):
+            return ray_tpu.get(self.doubler.remote(x)) + 1
+
+    handle = serve.run(Ingress.bind(Doubler.bind()))
+    assert ray_tpu.get(handle.remote(21), timeout=60) == 43
+
+    st = serve.status()
+    assert set(st) >= {"Doubler", "Ingress"}
+    assert st["Ingress"]["replicas"] == 1
+
+
+def test_deployment_graph_cycle_rejected(serve_cluster):
+    @serve.deployment
+    class A:
+        pass
+
+    a = A.bind()
+    b = A.options(name="B").bind(a)
+    a.init_args = (b,)  # mutate to close the loop: a -> b -> a
+    with pytest.raises(ValueError, match="cycle"):
+        serve.run(a)
+
+
+def test_http_proxy_get(serve_cluster):
+    @serve.deployment
+    def Echo(payload):
+        return payload
+
+    serve.run(Echo.bind())
+    _, port = serve.start_http_proxy()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/Echo?a=1&b=x", timeout=60) as resp:
+        out = json.loads(resp.read())
+    assert out["result"] == {"a": "1", "b": "x"}
+
+
+def test_serve_config_file_deploy(serve_cluster, tmp_path):
+    app_mod = tmp_path / "my_serve_app.py"
+    app_mod.write_text(
+        "from ray_tpu import serve\n"
+        "@serve.deployment\n"
+        "def Hello(payload):\n"
+        "    return 'hello ' + str(payload.get('who'))\n"
+        "app = Hello.bind()\n")
+    cfg = tmp_path / "serve.yaml"
+    cfg.write_text(
+        "applications:\n"
+        "  - name: hello_app\n"
+        "    import_path: my_serve_app:app\n"
+        "    deployments:\n"
+        "      - name: Hello\n"
+        "        num_replicas: 2\n")
+    import sys
+
+    sys.path.insert(0, str(tmp_path))
+    try:
+        deployed = serve.deploy_config_file(str(cfg))
+        assert deployed == {"hello_app": "Hello"}
+        h = serve.get_deployment_handle("Hello")
+        assert ray_tpu.get(h.remote({"who": "tpu"}), timeout=60) == "hello tpu"
+        assert serve.status()["Hello"]["target"] == 2
+    finally:
+        sys.path.remove(str(tmp_path))

@@ -104,3 +104,46 @@ def test_checkpoint_dir_roundtrip(tmp_path):
     path = ckpt.to_directory(str(tmp_path / "ck"))
     loaded = Checkpoint.from_directory(path).to_dict()
     np.testing.assert_array_equal(loaded["params"]["w"], data["params"]["w"])
+
+
+def test_torch_trainer_ddp_gloo(ray_start_regular):
+    """Real torch.distributed DDP (gloo) across 2 worker actors: gradients
+    must synchronize, so both ranks converge to identical weights."""
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    from ray_tpu.air import session
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.train.torch import TorchTrainer, prepare_model
+
+    def loop(config):
+        import numpy as np
+        import torch
+        import torch.distributed as dist
+
+        torch.manual_seed(session.get_world_rank())  # different init per rank
+        model = prepare_model(torch.nn.Linear(4, 1))
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        g = torch.Generator().manual_seed(100 + session.get_world_rank())
+        for _ in range(30):
+            x = torch.randn(16, 4, generator=g)
+            y = x.sum(-1, keepdim=True)
+            loss = ((model(x) - y) ** 2).mean()
+            opt.zero_grad()
+            loss.backward()
+            opt.step()
+        w = model.module.weight.detach().numpy().copy()
+        gathered = [None, None]
+        dist.all_gather_object(gathered, w)
+        np.testing.assert_allclose(gathered[0], gathered[1], atol=1e-6)
+        session.report({"loss": float(loss), "rank": session.get_world_rank(),
+                        "weight0": float(w[0, 0])})
+
+    trainer = TorchTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2,
+                                           resources_per_worker={"CPU": 1}))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["loss"] < 0.1
+    # DDP synced: final weight approached the true coefficient 1.0
+    assert abs(result.metrics["weight0"] - 1.0) < 0.2
