@@ -54,9 +54,12 @@ def _masked_attention(q, k, v, mask):
 
 
 def prefill(params: Dict, tokens: jax.Array, cfg: ModelConfig,
-            max_len: int) -> Tuple[jax.Array, Dict]:
-    """Process the prompt; returns (last-position logits [b, vocab], cache).
+            max_len: int, logits_index: Optional[jax.Array] = None
+            ) -> Tuple[jax.Array, Dict]:
+    """Process the prompt; returns (logits [b, vocab], cache).
 
+    Logits come from the last position, or from `logits_index` [b] when the
+    prompt is right-padded (the causal mask keeps positions < index exact).
     cache = {"k": [L,b,kvh,max_len,hd], "v": ..., "length": scalar}.
     """
     b, s = tokens.shape
@@ -87,7 +90,12 @@ def prefill(params: Dict, tokens: jax.Array, cfg: ModelConfig,
     x, (k_all, v_all) = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x[:, -1] @ head.astype(cfg.dtype)).astype(jnp.float32)
+    if logits_index is None:
+        sel = x[:, -1]
+    else:
+        sel = jnp.take_along_axis(
+            x, logits_index[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    logits = (sel @ head.astype(cfg.dtype)).astype(jnp.float32)
     cache = {"k": k_all, "v": v_all, "length": jnp.asarray(s, jnp.int32)}
     return logits, cache
 

@@ -1,0 +1,247 @@
+"""Continuous-batching LLM engine: slot-based KV cache, join/leave per step.
+
+The serving-side scheduler the reference lacks natively (it serves torch
+models behind Serve replicas): requests occupy fixed cache *slots* so the
+decode step is one compiled function over static shapes — sequences join
+(prefill writes their KV rows into a free slot) and retire (EOS/length)
+between steps without recompiling, the continuous-batching idea of Orca /
+vLLM re-built TPU-first (static shapes for XLA, per-row positions instead
+of dynamic batch).
+
+Engine = pure-JAX step functions + a host-side slot manager. Serve wires it
+through `LLMDeployment` (serve replicas each host an engine; Serve's p2c
+router spreads requests across replicas).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.inference import _masked_attention, _mlp, _project_qkv
+from ray_tpu.models.transformer import ModelConfig, lm_head_weights
+from ray_tpu.ops.layers import rms_norm, rotary_embedding
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_len"))
+def prefill_kv(params: Dict, tokens: jax.Array, true_len: jax.Array,
+               cfg: ModelConfig, max_len: int):
+    """Prompt pass for ONE right-padded request [1, s_bucket]: returns
+    (logits at true_len-1 [vocab], k [L, kvh, max_len, hd], v likewise).
+
+    Prompts are padded to bucket lengths before this call so XLA compiles
+    once per bucket, not once per prompt length; the causal mask makes
+    positions < true_len independent of the padding."""
+    from ray_tpu.models.inference import prefill
+
+    logits, cache = prefill(params, tokens, cfg, max_len,
+                            logits_index=true_len[None] - 1)
+    return logits[0], cache["k"][:, 0], cache["v"][:, 0]
+
+
+def _bucket_len(n: int, max_len: int) -> int:
+    b = 8
+    while b < n:
+        b *= 2
+    return min(b, max_len - 1)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def decode_slots(params: Dict, k_all: jax.Array, v_all: jax.Array,
+                 lengths: jax.Array, tokens: jax.Array, cfg: ModelConfig):
+    """One decode step over all slots with per-slot positions.
+
+    k_all/v_all: [L, B, kvh, max_len, hd]; lengths [B] (current position per
+    slot); tokens [B] (last sampled token per slot). Returns (logits [B, V],
+    new k_all, new v_all). Inactive slots compute garbage harmlessly.
+    """
+    B = tokens.shape[0]
+    hd = cfg.head_dim
+    max_len = k_all.shape[-2]
+    cos, sin = rotary_embedding(lengths[:, None], hd, cfg.rope_theta)  # [B,1,hd/2]
+    x = params["embed"][tokens[:, None]].astype(cfg.dtype)  # [B,1,d]
+    mask = jnp.arange(max_len)[None, None, :] <= lengths[:, None, None]  # [B,1,L]
+
+    def write_row(cache, new, pos):
+        # cache [kvh, max_len, hd] <- new [kvh, 1, hd] at position pos
+        return jax.lax.dynamic_update_slice(cache, new, (0, pos, 0))
+
+    def attend_mask(q, kc, vc, m):
+        # per-row mask variant of _masked_attention: m [1, max_len]
+        return _masked_attention(q[None], kc[None], vc[None], m)[0]
+
+    def body(x, inputs):
+        lp, k_cache, v_cache = inputs  # caches [B, kvh, max_len, hd]
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(cfg, lp, h, cos, sin)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        k_cache = jax.vmap(write_row)(k_cache, k.astype(cfg.dtype), lengths)
+        v_cache = jax.vmap(write_row)(v_cache, v.astype(cfg.dtype), lengths)
+        attn = jax.vmap(attend_mask)(q, k_cache, v_cache, mask)
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, 1, cfg.n_heads * hd)
+        x = x + (attn @ lp["wo"]).astype(x.dtype)
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(cfg, lp, h2).astype(x.dtype)
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(body, x, (params["layers"], k_all, v_all))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ lm_head_weights(params, cfg)).astype(jnp.float32)
+    return logits, k_new, v_new
+
+
+@dataclasses.dataclass
+class _Request:
+    request_id: int
+    prompt: List[int]
+    max_new_tokens: int
+    generated: List[int] = dataclasses.field(default_factory=list)
+    slot: int = -1
+    done: bool = False
+
+
+class ContinuousBatchingEngine:
+    """Host-side slot manager over the jitted prefill/decode kernels."""
+
+    def __init__(self, params: Dict, cfg: ModelConfig, *, num_slots: int = 4,
+                 max_len: int = 512, eos_token: Optional[int] = None):
+        self.params = params
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.eos_token = eos_token
+        L, kvh, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        self.k = jnp.zeros((L, num_slots, kvh, max_len, hd), cfg.dtype)
+        self.v = jnp.zeros((L, num_slots, kvh, max_len, hd), cfg.dtype)
+        self.lengths = jnp.zeros((num_slots,), jnp.int32)
+        self.tokens = jnp.zeros((num_slots,), jnp.int32)
+        self._free = list(range(num_slots))
+        self._active: Dict[int, _Request] = {}   # slot -> request
+        self._waiting: List[_Request] = []
+        self._finished: Dict[int, _Request] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- requests
+    def submit(self, prompt: List[int], *, max_new_tokens: int = 32) -> int:
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) >= self.max_len - 1:
+            raise ValueError(
+                f"prompt length {len(prompt)} must be < max_len-1 = "
+                f"{self.max_len - 1}")
+        with self._lock:
+            req = _Request(self._next_id, list(prompt), max_new_tokens)
+            self._next_id += 1
+            self._waiting.append(req)
+            return req.request_id
+
+    def _admit(self) -> None:
+        while self._waiting and self._free:
+            req = self._waiting.pop(0)
+            slot = self._free.pop()
+            req.slot = slot
+            n = len(req.prompt)
+            padded = req.prompt + [0] * (_bucket_len(n, self.max_len) - n)
+            logits, k_rows, v_rows = prefill_kv(
+                self.params, jnp.asarray([padded], jnp.int32),
+                jnp.asarray(n, jnp.int32), self.cfg, self.max_len)
+            first = int(jnp.argmax(logits))
+            req.generated.append(first)
+            self.k = self.k.at[:, slot].set(k_rows)
+            self.v = self.v.at[:, slot].set(v_rows)
+            self.lengths = self.lengths.at[slot].set(len(req.prompt))
+            self.tokens = self.tokens.at[slot].set(first)
+            self._active[slot] = req
+            self._maybe_finish(req)
+
+    def _maybe_finish(self, req: _Request) -> None:
+        hit_eos = self.eos_token is not None and req.generated and \
+            req.generated[-1] == self.eos_token
+        out_of_room = len(req.prompt) + len(req.generated) >= self.max_len - 1
+        if len(req.generated) >= req.max_new_tokens or hit_eos or out_of_room:
+            req.done = True
+            if req.slot >= 0:
+                self._active.pop(req.slot, None)
+                self._free.append(req.slot)
+                req.slot = -1
+            self._finished[req.request_id] = req
+
+    # ----------------------------------------------------------------- step
+    def step(self) -> int:
+        """Admit waiting requests, run one decode step; returns number of
+        sequences still active."""
+        with self._lock:
+            self._admit()
+            if not self._active:
+                return 0
+            logits, self.k, self.v = decode_slots(
+                self.params, self.k, self.v, self.lengths, self.tokens,
+                self.cfg)
+            nxt = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+            self.lengths = self.lengths + 1  # every slot advanced (inactive: junk)
+            new_tokens = np.array(self.tokens)  # writable copy
+            for slot, req in list(self._active.items()):
+                tok = int(nxt[slot])
+                req.generated.append(tok)
+                new_tokens[slot] = tok
+                self._maybe_finish(req)
+            self.tokens = jnp.asarray(new_tokens)
+            return len(self._active) + len(self._waiting)
+
+    def run_until_done(self, max_steps: int = 10_000) -> None:
+        for _ in range(max_steps):
+            if self.step() == 0 and not self._waiting:
+                return
+
+    # -------------------------------------------------------------- results
+    def result(self, request_id: int) -> Optional[List[int]]:
+        with self._lock:
+            req = self._finished.get(request_id)
+            if req is None:
+                return None
+            toks = req.prompt + req.generated
+            if self.eos_token is not None and toks and toks[-1] == self.eos_token:
+                toks = toks[:-1]
+            return toks
+
+    def generate(self, prompt: List[int], *, max_new_tokens: int = 32
+                 ) -> List[int]:
+        rid = self.submit(prompt, max_new_tokens=max_new_tokens)
+        while self.result(rid) is None:
+            if self.step() == 0 and self.result(rid) is None and \
+                    not self._waiting:
+                break
+        return self.result(rid) or []
+
+
+def LLMDeployment(params, cfg: ModelConfig, *, num_slots: int = 4,
+                  max_len: int = 512, eos_token: Optional[int] = None):
+    """A serve-ready callable class hosting one engine per replica.
+
+    Usage:
+        from ray_tpu import serve
+        D = serve.deployment(LLMDeployment(params, cfg))
+        handle = serve.run(D.bind())
+        handle.remote({"prompt": [1, 2, 3], "max_new_tokens": 8})
+    """
+
+    class _LLM:
+        def __init__(self):
+            self.engine = ContinuousBatchingEngine(
+                params, cfg, num_slots=num_slots, max_len=max_len,
+                eos_token=eos_token)
+
+        def __call__(self, payload):
+            prompt = list(payload["prompt"])
+            n = int(payload.get("max_new_tokens", 32))
+            return self.engine.generate(prompt, max_new_tokens=n)
+
+    _LLM.__name__ = "LLMDeployment"
+    return _LLM

@@ -1,5 +1,6 @@
 from ray_tpu.parallel.mesh import (
     MeshConfig,
+    make_hybrid_mesh,
     make_mesh,
     make_virtual_mesh,
     AxisRules,

@@ -82,6 +82,35 @@ def make_mesh(config: MeshConfig, devices: Optional[Sequence[Any]] = None) -> Me
     return Mesh(arr, AXIS_NAMES)
 
 
+def make_hybrid_mesh(config: MeshConfig, *, dcn_dp: int = 1,
+                     devices: Optional[Sequence[Any]] = None) -> Mesh:
+    """Multi-slice mesh: `dcn_dp` data-parallel replicas across slices (DCN),
+    `config` parallelism within each slice (ICI).
+
+    Uses `mesh_utils.create_hybrid_device_mesh` so device order guarantees
+    only the outermost dp axis crosses slice boundaries — tp/sp/fsdp
+    collectives stay on ICI (the scaling-book multislice recipe). Falls back
+    to a plain reshape when devices carry no slice topology (CPU tests,
+    single slice): semantics identical, placement guarantee vacuous.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if dcn_dp <= 1:
+        return make_mesh(config, devices)
+    if len(devices) % dcn_dp != 0:
+        raise ValueError(f"{len(devices)} devices not divisible by dcn_dp={dcn_dp}")
+    per_slice = config.resolve(len(devices) // dcn_dp)
+    if getattr(devices[0], "slice_index", None) is not None:
+        from jax.experimental import mesh_utils
+
+        # real multislice topology: let genuine shape mismatches propagate
+        arr = mesh_utils.create_hybrid_device_mesh(
+            per_slice.shape, (dcn_dp, 1, 1, 1, 1), devices=devices)
+    else:  # no slice topology (CPU tests, single slice): plain reshape
+        arr = np.array(devices).reshape(
+            (dcn_dp * per_slice.dp,) + per_slice.shape[1:])
+    return Mesh(arr, AXIS_NAMES)
+
+
 def make_virtual_mesh(n_devices: int, config: Optional[MeshConfig] = None) -> Mesh:
     """CPU-device mesh for tests/dryrun (xla_force_host_platform_device_count)."""
     devices = jax.devices()

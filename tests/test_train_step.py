@@ -130,3 +130,18 @@ def test_train_step_with_ulysses_sequence_parallel():
         state, metrics = step_fn(state, batch)
         losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0], losses
+
+
+def test_hybrid_dcn_mesh_train_step():
+    """2 simulated slices x 4-chip ICI mesh: dp rides the dcn axis."""
+    from ray_tpu.parallel import make_hybrid_mesh
+
+    cfg = ModelConfig.tiny()
+    mesh = make_hybrid_mesh(MeshConfig(dp=1, fsdp=2, tp=2, sp=1), dcn_dp=2)
+    assert mesh.shape == {"dp": 2, "pp": 1, "fsdp": 2, "tp": 2, "sp": 1}
+    step_fn, init_fn, _ = make_train_step(cfg, mesh, default_optimizer(1e-3))
+    state = init_fn(jax.random.PRNGKey(0))
+    batch = _batch(jax.random.PRNGKey(1), cfg, batch=8, seq=64)
+    batch = jax.device_put(batch, {k: batch_sharding(mesh)[k] for k in batch})
+    state, metrics = step_fn(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
