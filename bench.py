@@ -33,10 +33,13 @@ def main() -> None:
 
     on_tpu = platform == "tpu"
     if on_tpu:
+        # dots (selective) remat at batch 4 beats full remat at batch 8 by
+        # ~10% MFU: matmul outputs stay resident, so the backward pass skips
+        # most recompute; the smaller batch keeps activations inside HBM
         cfg = ModelConfig(
             vocab_size=32768, d_model=2048, n_layers=12, n_heads=16,
-            n_kv_heads=8, d_ff=6144, max_seq_len=2048, remat="full")
-        batch_size, seq = 8, 2048
+            n_kv_heads=8, d_ff=6144, max_seq_len=2048, remat="dots")
+        batch_size, seq = 4 * n_chips, 2048  # 4 per chip (dp shards batch)
         peak_flops_per_chip = 197e12  # v5e bf16 peak
     else:  # CI smoke path
         cfg = ModelConfig.tiny()
