@@ -51,6 +51,24 @@ def main(argv=None) -> int:
     p_tl = sub.add_parser("timeline", help="dump chrome trace json")
     p_tl.add_argument("--output", default="timeline.json")
 
+    p_mem = sub.add_parser("memory", help="object store usage per node")
+    p_mem.add_argument("--address", required=True)
+
+    p_stack = sub.add_parser("stack", help="dump local worker stack traces")
+    p_stack.add_argument("--address", required=True)
+
+    p_health = sub.add_parser("healthcheck", help="exit 0 if GCS responds")
+    p_health.add_argument("--address", required=True)
+
+    p_gc = sub.add_parser("global-gc", help="gc.collect() in every worker")
+    p_gc.add_argument("--address", required=True)
+
+    p_chaos = sub.add_parser("kill-random-node",
+                             help="chaos: hard-kill a random non-head node")
+    p_chaos.add_argument("--address", required=True)
+
+    sub.add_parser("microbenchmark", help="core-primitive ops/s suite")
+
     p_serve = sub.add_parser("serve", help="model serving")
     serve_sub = p_serve.add_subparsers(dest="serve_cmd", required=True)
     p_sv_deploy = serve_sub.add_parser("deploy")
@@ -102,12 +120,115 @@ def main(argv=None) -> int:
         print(json.dumps(state.summarize_tasks(), indent=2))
         return 0
 
+    if args.cmd == "microbenchmark":
+        from ray_tpu.microbenchmark import main as micro_main
+
+        return micro_main()
+
     if args.cmd == "timeline":
         from ray_tpu.util import tracing
 
         tracing.dump(args.output)
         print(f"wrote {args.output}")
         return 0
+
+    if args.cmd in ("memory", "stack", "healthcheck", "global-gc",
+                    "kill-random-node"):
+        # raw GCS/raylet RPC — no driver registration needed
+        from ray_tpu.core import rpc as _rpc
+
+        try:
+            gcs = _rpc.connect_with_retry(args.address, timeout=5)
+        except ConnectionError as e:
+            if args.cmd == "healthcheck":
+                print(f"unhealthy: {e}")
+                return 1
+            raise
+        try:
+            try:
+                nodes = gcs.call("get_all_nodes", timeout=10)
+            except Exception as e:
+                if args.cmd == "healthcheck":
+                    print(f"unhealthy: {e}")
+                    return 1
+                raise
+            alive = [n for n in nodes if n["alive"]]
+            if args.cmd == "healthcheck":
+                print(json.dumps({"healthy": True, "alive_nodes": len(alive)}))
+                return 0
+            if args.cmd == "global-gc":
+                gcs.call("global_gc")
+                print("global gc triggered")
+                return 0
+            if args.cmd == "kill-random-node":
+                import random
+
+                victims = alive[1:] or alive  # prefer non-head
+                if not victims:
+                    print("no alive nodes to kill")
+                    return 1
+                v = random.choice(victims)
+                c = _rpc.connect_with_retry(v["address"], timeout=5)
+                try:
+                    accepted = c.call("die", timeout=5)
+                except (_rpc.RpcDisconnected, TimeoutError):
+                    accepted = True  # died before replying — success
+                finally:
+                    c.close()
+                if not accepted:
+                    print(f"node {v['node_id'].hex()[:8]} refused "
+                          f"(driver-embedded raylet)")
+                    return 1
+                print(f"killed node {v['node_id'].hex()[:8]}")
+                return 0
+            if args.cmd == "memory":
+                out = []
+                for n in alive:
+                    c = _rpc.connect_with_retry(n["address"], timeout=5)
+                    st = c.call("object_store_stats")
+                    st["node_id"] = st["node_id"].hex()
+                    out.append(st)
+                    c.close()
+                print(json.dumps(out, indent=2))
+                return 0
+            if args.cmd == "stack":
+                import os as _os
+                import signal as _signal
+                import time as _time
+
+                stack_dir = "/tmp/ray_tpu/stacks"
+                signaled = {}  # pid -> file offset before this dump
+                for n in alive:
+                    c = _rpc.connect_with_retry(n["address"], timeout=5)
+                    for w in c.call("list_workers"):
+                        path = _os.path.join(stack_dir, f"{w['pid']}.txt")
+                        try:
+                            offset = _os.path.getsize(path)
+                        except OSError:
+                            offset = 0
+                        try:
+                            _os.kill(w["pid"], _signal.SIGUSR1)
+                            signaled[w["pid"]] = offset
+                        except (ProcessLookupError, PermissionError):
+                            continue
+                    c.close()
+                _time.sleep(0.5)
+                # print only live workers' dumps, and only this invocation's
+                # (faulthandler appends; earlier dumps are before offset)
+                for pid, offset in sorted(signaled.items()):
+                    path = _os.path.join(stack_dir, f"{pid}.txt")
+                    try:
+                        with open(path) as fh:
+                            fh.seek(offset)
+                            content = fh.read().strip()
+                    except OSError:
+                        continue
+                    if content:
+                        print(f"==== worker pid {pid} ====")
+                        print(content)
+                return 0
+        finally:
+            gcs.close()
 
     if args.cmd == "serve":
         _connect(args.address)

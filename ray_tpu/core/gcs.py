@@ -31,6 +31,7 @@ CH_NODES = "nodes"
 CH_ACTORS = "actors"
 CH_RESOURCES = "resources"
 CH_ERRORS = "errors"
+CH_CONTROL = "control"  # cluster-wide commands (global_gc, ...)
 
 
 class GcsServer:
@@ -113,6 +114,12 @@ class GcsServer:
             self._subs.get(channel, []).remove(conn)
         except ValueError:
             pass
+
+    def rpc_global_gc(self, conn, req_id, payload):
+        """Broadcast a gc request to every raylet -> every worker
+        (reference `ray global_gc`, scripts.py:2161)."""
+        self._publish(CH_CONTROL, {"cmd": "gc"})
+        return True
 
     # ----------------------------------------------------------------- nodes
     def rpc_register_node(self, conn, req_id, payload):

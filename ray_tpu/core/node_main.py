@@ -58,6 +58,7 @@ def main(argv=None) -> None:
         labels=labels,
         object_store_memory=args.object_store_memory,
     )
+    raylet.allow_chaos_kill = True  # standalone daemon: kill-random-node ok
     raylet.start()
     print(f"raylet started on node {raylet.node_id.hex()[:12]} "
           f"({raylet.address})")

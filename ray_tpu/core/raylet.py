@@ -44,6 +44,7 @@ class WorkerHandle:
     current_task: Optional[TaskSpec] = None
     idle_since: float = field(default_factory=time.monotonic)
     env_key: Optional[str] = None         # pip runtime-env pool this worker serves
+    is_driver: bool = False
     # resources held for the actor's lifetime: (bundle_key | None, demand)
     actor_charge: Optional[Tuple[Optional[Tuple], Dict[str, float]]] = None
 
@@ -119,7 +120,7 @@ class Raylet:
         })
         for n in reply["nodes"]:
             self._note_node(n)
-        self._gcs.call("subscribe", {"channels": ["resources", "nodes"]})
+        self._gcs.call("subscribe", {"channels": ["resources", "nodes", "control"]})
         t = threading.Thread(target=self._heartbeat_loop, name="raylet-heartbeat", daemon=True)
         t.start()
         self._threads.append(t)
@@ -186,6 +187,13 @@ class Raylet:
                     c = self._raylet_clients.pop(hexid, None)
                 if c:
                     c.close()
+        elif ch == "control":
+            if msg.get("cmd") == "gc":
+                with self._lock:
+                    workers = list(self._workers.values())
+                for w in workers:
+                    if w.conn.alive:
+                        w.conn.push("global_gc", {})
 
     def _note_node(self, n: dict) -> None:
         hexid = n["node_id"].hex()
@@ -252,6 +260,7 @@ class Raylet:
             self._workers[wid] = handle
             conn.on_close.append(lambda c, wid=wid: self._on_worker_disconnect(wid))
             if payload.get("worker_type") == "driver":
+                handle.is_driver = True
                 return {"node_id": self.node_id.binary(), "gcs_address": self.gcs_address}
             # a fresh worker: give it a pending actor spec (from the same
             # runtime-env pool) or mark idle
@@ -410,6 +419,38 @@ class Raylet:
                     w.conn.push("exit", {})
                 except Exception:
                     pass
+
+    # -------------------------------------------------------- observability
+    def rpc_object_store_stats(self, conn, req_id, payload):
+        """Store usage for `ray_tpu memory` (reference scripts.py:1881)."""
+        return {"node_id": self.node_id.binary(), **self.store.stats()}
+
+    def rpc_list_workers(self, conn, req_id, payload):
+        """Worker pids/state for `ray_tpu stack` + debugging."""
+        with self._lock:
+            return [{
+                "pid": w.pid,
+                "worker_id": w.worker_id,
+                "actor_id": w.actor_id.binary() if w.actor_id else None,
+                "idle": w.current_task is None and w.actor_id is None,
+                "env_key": w.env_key,
+            } for w in self._workers.values() if not w.is_driver]
+
+    # set True by node_main (standalone daemon): chaos kill may hard-exit.
+    # In-process raylets (driver-embedded head, test Cluster) refuse — the
+    # exit would take the driver down with it.
+    allow_chaos_kill = False
+
+    def rpc_die(self, conn, req_id, payload):
+        """Chaos kill for fault-injection tests (reference
+        `ray kill_random_node`, scripts.py:1325): hard-exit the node."""
+        if not self.allow_chaos_kill:
+            logger.warning("chaos kill refused: raylet is driver-embedded")
+            return False
+        logger.warning("raylet dying on chaos request")
+        threading.Thread(target=lambda: (time.sleep(0.1), os._exit(1)),
+                         daemon=True).start()
+        return True
 
     # ------------------------------------------------------------ scheduling
     def rpc_submit_task(self, conn, req_id, payload):

@@ -843,6 +843,10 @@ class CoreWorker:
             self._task_queue.put(payload["spec"])
         elif method == "become_actor":
             self._become_actor(payload["spec"])
+        elif method == "global_gc":
+            import gc
+
+            gc.collect()
         elif method == "exit":
             logger.info("worker exiting on raylet request")
             os._exit(0)

@@ -25,6 +25,17 @@ def main() -> None:
         format="%(asctime)s %(levelname)s worker %(name)s: %(message)s",
     )
 
+    # `ray_tpu stack` support: SIGUSR1 dumps every thread's Python stack to
+    # a per-pid file (reference `ray stack` uses py-spy; this is dep-free)
+    import faulthandler
+    import os
+    import signal
+
+    stack_dir = "/tmp/ray_tpu/stacks"
+    os.makedirs(stack_dir, exist_ok=True)
+    _stack_file = open(os.path.join(stack_dir, f"{os.getpid()}.txt"), "w")
+    faulthandler.register(signal.SIGUSR1, file=_stack_file, all_threads=True)
+
     from ray_tpu.core.worker import CoreWorker, set_current_worker
 
     try:

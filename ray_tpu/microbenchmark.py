@@ -1,0 +1,105 @@
+"""Core-primitive microbenchmarks (reference `python/ray/_private/ray_perf.py:93-282`,
+run by `ray microbenchmark`): ops/s for tasks, actor calls, and object
+put/get. Requires an initialized runtime (`ray_tpu.init()` first or run via
+the CLI, which boots one).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+import ray_tpu
+
+
+def _rate(fn: Callable[[], int], min_seconds: float = 2.0) -> float:
+    """ops/s: run batches of fn until min_seconds elapsed."""
+    fn()  # warm up (worker spawn, compile)
+    done = 0
+    t0 = time.perf_counter()
+    while True:
+        done += fn()
+        dt = time.perf_counter() - t0
+        if dt >= min_seconds:
+            return done / dt
+
+
+@ray_tpu.remote
+def _noop():
+    return None
+
+
+@ray_tpu.remote
+def _noop_arg(x):
+    return x
+
+
+@ray_tpu.remote
+class _BenchActor:
+    def method(self):
+        return None
+
+    def echo(self, x):
+        return x
+
+
+def run_microbenchmark(batch: int = 100) -> List[Dict]:
+    results: List[Dict] = []
+
+    def record(name: str, rate: float, unit: str = "ops/s"):
+        results.append({"benchmark": name, "rate": round(rate, 1), "unit": unit})
+
+    # tasks: batched submit + get
+    record("tasks_sync_batch", _rate(
+        lambda: len(ray_tpu.get([_noop.remote() for _ in range(batch)]))))
+
+    # single task round-trip latency expressed as ops/s
+    record("task_roundtrip", _rate(
+        lambda: (ray_tpu.get(_noop.remote()), 1)[1]))
+
+    arg = b"y" * 1024
+    record("tasks_1kb_arg_batch", _rate(
+        lambda: len(ray_tpu.get([_noop_arg.remote(arg) for _ in range(batch)]))))
+
+    a = _BenchActor.options(num_cpus=0).remote()
+    record("actor_calls_sync_batch", _rate(
+        lambda: len(ray_tpu.get([a.method.remote() for _ in range(batch)]))))
+    record("actor_call_roundtrip", _rate(
+        lambda: (ray_tpu.get(a.method.remote()), 1)[1]))
+    record("actor_echo_1kb_batch", _rate(
+        lambda: len(ray_tpu.get([a.echo.remote(arg) for _ in range(batch)]))))
+
+    small = b"x" * 1024
+    record("put_1kb", _rate(
+        lambda: ([ray_tpu.put(small) for _ in range(batch)], batch)[1]))
+
+    big = np.zeros(10 * 1024 * 1024 // 8)  # 10 MB
+    def put_get_big():
+        ref = ray_tpu.put(big)
+        out = ray_tpu.get(ref)
+        return int(out.nbytes)
+    record("put_get_10mb_bytes", _rate(put_get_big), unit="bytes/s")
+
+    ray_tpu.kill(a)
+    return results
+
+
+def main() -> int:
+    import json
+
+    own_cluster = not ray_tpu.is_initialized()
+    if own_cluster:
+        ray_tpu.init(num_cpus=4)
+    try:
+        for row in run_microbenchmark():
+            print(json.dumps(row))
+    finally:
+        if own_cluster:
+            ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
