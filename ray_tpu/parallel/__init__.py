@@ -8,3 +8,8 @@ from ray_tpu.parallel.mesh import (
     logical_sharding,
     shard_pytree,
 )
+from ray_tpu.parallel.distributed import (
+    initialize_from_session,
+    initialize_group,
+    shutdown_group,
+)

@@ -1,7 +1,7 @@
 from ray_tpu.tune.search import choice, grid_search, loguniform, randint, uniform
 from ray_tpu.tune.schedulers import (
     ASHAScheduler, FIFOScheduler, HyperBandScheduler, MedianStoppingRule,
-    PopulationBasedTraining)
+    PB2, PopulationBasedTraining)
 from ray_tpu.tune.searchers import (
     BayesOptSearcher, ConcurrencyLimiter, RandomSearcher, Searcher,
     TPESearcher)
@@ -12,7 +12,7 @@ __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "report", "get_checkpoint",
     "grid_search", "uniform", "loguniform", "choice", "randint",
     "FIFOScheduler", "ASHAScheduler", "HyperBandScheduler",
-    "MedianStoppingRule", "PopulationBasedTraining",
+    "MedianStoppingRule", "PopulationBasedTraining", "PB2",
     "Searcher", "RandomSearcher", "TPESearcher", "BayesOptSearcher",
     "ConcurrencyLimiter",
 ]

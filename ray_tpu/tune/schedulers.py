@@ -204,3 +204,88 @@ class HyperBandScheduler:
 
     def on_trial_result(self, runner, trial, result) -> str:
         return self._bracket_for(trial).on_trial_result(runner, trial, result)
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (Parker-Holder et al. 2020; reference
+    `python/ray/tune/schedulers/pb2.py`): PBT where *explore* is not a
+    random perturbation but a GP-bandit suggestion. A small RBF-kernel GP is
+    fit on (normalized hyperparams -> recent reward improvement) across the
+    population's history, and the next config maximizes UCB over sampled
+    candidates inside `hyperparam_bounds`.
+    """
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 perturbation_interval: int = 4,
+                 hyperparam_bounds: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25, seed: int = 0,
+                 time_attr: str = "training_iteration",
+                 ucb_kappa: float = 2.0, n_candidates: int = 64):
+        super().__init__(metric=metric, mode=mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction, seed=seed,
+                         time_attr=time_attr)
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in (hyperparam_bounds or {}).items()}
+        self.kappa = ucb_kappa
+        self.n_candidates = n_candidates
+        # (normalized config vector, reward delta) observations
+        self._obs_x: List[List[float]] = []
+        self._obs_y: List[float] = []
+        self._last_scores: Dict[str, float] = {}  # trial_id -> last score
+
+    def _normalize(self, config: Dict[str, Any]) -> List[float]:
+        return [(float(config[k]) - lo) / max(hi - lo, 1e-12)
+                for k, (lo, hi) in self.bounds.items()]
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        import math
+
+        # record the reward delta for the GP before the PBT bookkeeping;
+        # results without the metric (checkpoint-only) are skipped like in
+        # the other schedulers
+        score = self._score(trial) if trial.last_result else None
+        if score is not None and math.isfinite(score) and \
+                all(k in trial.config for k in self.bounds):
+            prev = self._last_scores.get(trial.trial_id)
+            if prev is not None:
+                self._obs_x.append(self._normalize(trial.config))
+                self._obs_y.append(score - prev)
+            self._last_scores[trial.trial_id] = score
+        config_before = trial.config
+        decision = super().on_trial_result(runner, trial, result)
+        if trial.config is not config_before:
+            # exploited: the next score comes from the donor's checkpoint,
+            # not this config — don't credit the jump to the new coords
+            self._last_scores.pop(trial.trial_id, None)
+        return decision
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        import numpy as np
+
+        if len(self._obs_y) < 3:  # cold start: uniform in bounds
+            for k, (lo, hi) in self.bounds.items():
+                config[k] = type(config.get(k, lo))(
+                    lo + self._rng.random() * (hi - lo))
+            return config
+
+        X = np.asarray(self._obs_x[-100:])
+        y = np.asarray(self._obs_y[-100:])
+        y = (y - y.mean()) / (y.std() + 1e-9)
+
+        def kern(a, b):
+            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+            return np.exp(-d2 / (2 * 0.2 ** 2))
+
+        K = kern(X, X) + 1e-4 * np.eye(len(X))
+        K_inv = np.linalg.inv(K)
+        cand = np.asarray([[self._rng.random() for _ in self.bounds]
+                           for _ in range(self.n_candidates)])
+        Ks = kern(cand, X)
+        mu = Ks @ K_inv @ y
+        var = np.clip(1.0 - (Ks * (Ks @ K_inv)).sum(-1), 1e-9, None)
+        best = cand[int(np.argmax(mu + self.kappa * np.sqrt(var)))]
+        for i, (k, (lo, hi)) in enumerate(self.bounds.items()):
+            config[k] = type(config.get(k, lo))(lo + best[i] * (hi - lo))
+        return config

@@ -147,3 +147,39 @@ def test_torch_trainer_ddp_gloo(ray_start_regular):
     assert result.metrics["loss"] < 0.1
     # DDP synced: final weight approached the true coefficient 1.0
     assert abs(result.metrics["weight0"] - 1.0) < 0.2
+
+
+def test_jax_distributed_worker_group(ray_start_regular):
+    """Two worker actors form one jax.distributed world through the KV
+    rendezvous: global device count spans both processes and a psum over a
+    cross-process mesh reduces correctly (SURVEY hard-part #4)."""
+    from ray_tpu.air import session
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.train.trainer import DataParallelTrainer
+
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from ray_tpu.parallel import initialize_from_session
+
+        initialize_from_session(group_name="t1")
+        local = jax.local_device_count()
+        world = session.get_world_size()
+        assert jax.device_count() == local * world
+        mesh = Mesh(jax.devices(), ("dp",))
+        n = jax.device_count()
+        x = jax.device_put(jnp.ones((n,)), NamedSharding(mesh, P("dp")))
+        total = jax.jit(lambda x: jnp.sum(x),
+                        out_shardings=NamedSharding(mesh, P()))(x)
+        session.report({"total": float(total), "devices": n,
+                        "rank": session.get_world_rank()})
+
+    trainer = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2,
+                                           resources_per_worker={"CPU": 1}))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["total"] == result.metrics["devices"]
+    assert result.metrics["devices"] == 16  # 2 procs x 8 forced cpu devices
