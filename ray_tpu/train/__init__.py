@@ -1,3 +1,4 @@
 from ray_tpu.train.step import TrainState, make_train_step, make_init_fn, batch_sharding
 from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
+from ray_tpu.train.checkpointing import abstract_like, restore_sharded, save_sharded

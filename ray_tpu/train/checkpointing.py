@@ -1,0 +1,59 @@
+"""Sharded checkpointing for TPU-scale training state (orbax-backed).
+
+The AIR `Checkpoint` (air/checkpoint.py) is the small-payload control-plane
+object the reference has; this module is the TPU-era data plane for model
+state: orbax writes each shard from the device that owns it (no host
+gather), and restore maps shards onto the *target* mesh's shardings — which
+may differ from the save-time mesh. That mesh-reshape restore is the core
+of elastic recovery (SURVEY hard-part #7: slice loss -> rebuild a smaller
+mesh -> restore -> continue).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def save_sharded(state: Any, path: str) -> str:
+    """Write a (possibly sharded) pytree checkpoint; returns the path."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state, force=True)
+    return path
+
+
+def restore_sharded(path: str, target: Any) -> Any:
+    """Restore into `target`'s structure/shardings.
+
+    `target` is a pytree of arrays OR jax.ShapeDtypeStruct leaves carrying
+    `sharding` — typically built with `abstract_like(state, shardings)` for
+    a mesh that need not match the one the checkpoint was saved from
+    (shards are re-laid-out on read).
+    """
+    import orbax.checkpoint as ocp
+
+    # abstract_like passes ShapeDtypeStruct leaves through unchanged (they
+    # carry .shape/.dtype/.sharding), so mixed/concrete targets all work
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(os.path.abspath(path), abstract_like(target))
+
+
+def abstract_like(state: Any, shardings: Optional[Any] = None) -> Any:
+    """ShapeDtypeStruct skeleton of `state`, with per-leaf shardings (from
+    the matching pytree, or each leaf's current sharding when None)."""
+    if shardings is None:
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=getattr(x, "sharding", None)),
+            state)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        state, shardings)
