@@ -15,3 +15,6 @@ from ray_tpu.rllib.offline import (
     BC, BCConfig, CQL, CQLConfig, MARWIL, MARWILConfig, collect_episodes)
 from ray_tpu.rllib.bandit import BanditLinTS, BanditLinUCB, LinearBanditEnv
 from ray_tpu.rllib.replay_buffers import ReplayBuffer, PrioritizedReplayBuffer
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentEnv, QMix, QMixConfig, TwoStepCooperativeEnv,
+    policy_mapping_rollout)
