@@ -1,0 +1,50 @@
+"""Serve an LLM with continuous batching behind the Serve HTTP ingress.
+
+    python examples/serve_llm.py
+    curl -X POST localhost:<port>/LLMDeployment \
+         -d '{"prompt": [1, 17, 42], "max_new_tokens": 8}'
+"""
+
+import os
+import sys
+
+try:
+    import ray_tpu  # noqa: F401
+except ImportError:  # running from a checkout without install
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time
+
+import jax
+
+
+def main():
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.models import ModelConfig, init_params
+    from ray_tpu.models.serving import LLMDeployment
+
+    ray_tpu.init(num_cpus=4)
+    cfg = ModelConfig.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    D = serve.deployment(LLMDeployment(params, cfg, num_slots=4, max_len=256))
+    handle = serve.run(D.bind())
+    _, port = serve.start_http_proxy()
+    print(f"serving on http://127.0.0.1:{port}/LLMDeployment")
+
+    # demo request through the handle
+    out = ray_tpu.get(handle.remote(
+        {"prompt": [1, 17, 42], "max_new_tokens": 8}), timeout=120)
+    print("generated:", out)
+
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

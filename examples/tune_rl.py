@@ -1,0 +1,52 @@
+"""Hyperparameter search over an RL algorithm: Tune driving PPO trials.
+
+    python examples/tune_rl.py
+"""
+
+import os
+import sys
+
+try:
+    import ray_tpu  # noqa: F401
+except ImportError:  # running from a checkout without install
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+def main():
+    import ray_tpu
+    from ray_tpu import tune
+    from ray_tpu.rllib import PPOConfig
+
+    ray_tpu.init(num_cpus=8)
+
+    def train_ppo(config):
+        algo = (PPOConfig()
+                .rollouts(num_rollout_workers=1, num_envs_per_worker=2)
+                .training(lr=config["lr"], clip_param=config["clip"])
+                .build())
+        try:
+            for _ in range(5):
+                metrics = algo.train()
+                tune.report({"episode_reward_mean":
+                             metrics["episode_reward_mean"]})
+        finally:
+            algo.stop()
+
+    tuner = tune.Tuner(
+        train_ppo,
+        param_space={"lr": tune.loguniform(1e-4, 1e-2),
+                     "clip": tune.uniform(0.1, 0.3)},
+        tune_config=tune.TuneConfig(
+            num_samples=4,
+            scheduler=tune.ASHAScheduler(metric="episode_reward_mean",
+                                         mode="max"),
+            metric="episode_reward_mean", mode="max"),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    print("best config:", best.config)
+    print("best reward:", best.metrics["episode_reward_mean"])
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

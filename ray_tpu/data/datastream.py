@@ -326,12 +326,15 @@ class Datastream:
         return ds.split_at_indices([n - n_test])
 
     def split_at_indices(self, indices: List[int]) -> List["Datastream"]:
-        """Split into len(indices)+1 streams at global row offsets."""
+        """Split into len(indices)+1 streams at global row offsets. Each
+        piece keeps the source's block parallelism so downstream
+        streaming_split/map fan-out isn't collapsed to one block."""
         rows = self.take_all()
         out = []
         prev = 0
+        par = max(1, self.num_blocks())
         for idx in list(indices) + [len(rows)]:
-            out.append(from_items(rows[prev:idx], parallelism=1))
+            out.append(from_items(rows[prev:idx], parallelism=par))
             prev = idx
         return out
 

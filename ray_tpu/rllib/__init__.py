@@ -18,3 +18,4 @@ from ray_tpu.rllib.replay_buffers import ReplayBuffer, PrioritizedReplayBuffer
 from ray_tpu.rllib.multi_agent import (
     MultiAgentEnv, QMix, QMixConfig, TwoStepCooperativeEnv,
     policy_mapping_rollout)
+from ray_tpu.rllib.r2d2 import MemoryCorridorEnv, R2D2, R2D2Config

@@ -1,0 +1,281 @@
+"""R2D2: recurrent replay distributed DQN (Kapturowski et al. 2019).
+
+Mirrors the reference's R2D2 (`rllib/algorithms/r2d2/`): an LSTM-style
+recurrent Q network trained on stored *sequences* with burn-in — the first
+`burn_in` steps of each sampled sequence only rebuild the recurrent state
+(no gradient), the remainder takes double-DQN TD updates. The recurrent
+cell is a GRU (one gate fewer than LSTM, same episodic-memory capability,
+friendlier to the MXU: all gates are two fused matmuls).
+
+The env for learning tests is a memory task (`MemoryCorridorEnv`): the
+first observation carries a cue that disappears immediately and must be
+recalled at the corridor's end — feedforward DQN cannot beat chance on it,
+a recurrent learner can.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm
+
+
+class MemoryCorridorEnv:
+    """Cue at t=0 (one of two), corridor of `length` blank steps, then a
+    binary choice; reward +1 for matching the cue, -1 otherwise."""
+
+    def __init__(self, seed: int = 0, length: int = 4):
+        self.length = length
+        self.observation_dim = 3  # [cue_a, cue_b, blank]
+        self.num_actions = 2
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._cue = 0
+
+    def reset(self) -> np.ndarray:
+        self._t = 0
+        self._cue = int(self._rng.integers(2))
+        obs = np.zeros(3, np.float32)
+        obs[self._cue] = 1.0
+        return obs
+
+    def step(self, action: int):
+        self._t += 1
+        obs = np.zeros(3, np.float32)
+        obs[2] = 1.0
+        if self._t <= self.length:
+            return obs, 0.0, False, {}
+        r = 1.0 if action == self._cue else -1.0
+        return obs, r, True, {}
+
+
+class R2D2Config:
+    def __init__(self):
+        self.env_maker: Callable[[int], Any] = MemoryCorridorEnv
+        self.obs_dim = 3
+        self.num_actions = 2
+        self.hidden = 32
+        self.lr = 2e-3
+        self.gamma = 0.997
+        self.seq_len = 8            # stored sequence length
+        self.burn_in = 2            # steps that only rebuild hidden state
+        self.buffer_capacity = 2000  # sequences
+        self.train_batch_size = 32
+        self.episodes_per_iter = 16
+        self.updates_per_iter = 4
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_iters = 40
+        self.target_update_interval = 5
+        self.max_episode_steps = 16
+        self.seed = 0
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown R2D2 option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "R2D2":
+        if not 0 <= self.burn_in < self.seq_len:
+            raise ValueError(
+                f"burn_in ({self.burn_in}) must be in [0, seq_len"
+                f"={self.seq_len})")
+        return R2D2({"r2d2_config": self})
+
+
+class R2D2(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg: R2D2Config = config.get("r2d2_config") or R2D2Config()
+        self.cfg = cfg
+        self.env = cfg.env_maker(cfg.seed)
+        rng = np.random.default_rng(cfg.seed)
+        self._np_rng = rng
+        h, d, A = cfg.hidden, cfg.obs_dim, cfg.num_actions
+
+        def glorot(m, n):
+            return (rng.standard_normal((m, n)) *
+                    np.sqrt(2.0 / (m + n))).astype(np.float32)
+
+        self.params = jax.tree_util.tree_map(jnp.asarray, {
+            "wxz": glorot(d, h), "whz": glorot(h, h), "bz": np.zeros(h, np.float32),
+            "wxr": glorot(d, h), "whr": glorot(h, h), "br": np.zeros(h, np.float32),
+            "wxn": glorot(d, h), "whn": glorot(h, h), "bn": np.zeros(h, np.float32),
+            "wq": glorot(h, A), "bq": np.zeros(A, np.float32),
+        })
+        self.target = jax.device_get(self.params)
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        # sequence-major replay: each row is one [seq_len] slice
+        self._sequences: List[dict] = []
+        self._reward_hist: List[float] = []
+
+        def gru_cell(p, hprev, x):
+            z = jax.nn.sigmoid(x @ p["wxz"] + hprev @ p["whz"] + p["bz"])
+            r = jax.nn.sigmoid(x @ p["wxr"] + hprev @ p["whr"] + p["br"])
+            n = jnp.tanh(x @ p["wxn"] + (r * hprev) @ p["whn"] + p["bn"])
+            return (1 - z) * n + z * hprev
+
+        def q_seq(p, obs_seq, h0):
+            """obs_seq [B,T,d], h0 [B,h] -> (q [B,T,A], h_T)."""
+            def body(hc, x):
+                hc = gru_cell(p, hc, x)
+                return hc, hc
+
+            hT, hs = jax.lax.scan(body, h0, obs_seq.swapaxes(0, 1))
+            hs = hs.swapaxes(0, 1)                      # [B,T,h]
+            return hs @ p["wq"] + p["bq"], hT
+
+        self._gru_cell = gru_cell
+
+        def loss_fn(p, tp, batch):
+            B = batch["obs"].shape[0]
+            h0 = jnp.zeros((B, h))
+            # burn-in: rebuild recurrent state without gradients
+            bi = cfg.burn_in
+            _, h_start = q_seq(jax.lax.stop_gradient(p),
+                               batch["obs"][:, :bi], h0)
+            h_start = jax.lax.stop_gradient(h_start)
+            _, ht_start = q_seq(tp, batch["obs"][:, :bi], h0)
+            # one extended pass: [obs[bi:], final next_obs]. Since
+            # next_obs[t] == obs[t+1], q_ext[:, 1:] are the next-state
+            # values evaluated with the CORRECT (non-stale) hidden state.
+            ext = jnp.concatenate(
+                [batch["obs"][:, bi:], batch["next_obs"][:, -1:]], axis=1)
+            q_ext, _ = q_seq(p, ext, h_start)           # [B,T'+1,A]
+            q_taken = jnp.take_along_axis(
+                q_ext[:, :-1], batch["actions"][:, bi:, None],
+                axis=-1)[..., 0]
+            # double DQN: online picks the argmax, target evaluates
+            a_star = jnp.argmax(q_ext[:, 1:], axis=-1)
+            q_ext_t, _ = q_seq(tp, ext, ht_start)
+            next_q = jnp.take_along_axis(
+                q_ext_t[:, 1:], a_star[..., None], axis=-1)[..., 0]
+            target = batch["rewards"][:, bi:] + cfg.gamma * \
+                (1 - batch["dones"][:, bi:]) * jax.lax.stop_gradient(next_q)
+            mask = batch["mask"][:, bi:]
+            td = (q_taken - target) * mask
+            return (td ** 2).sum() / jnp.maximum(mask.sum(), 1.0)
+
+        def update(p, opt_state, tp, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(p, tp, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state, p)
+            return optax.apply_updates(p, updates), opt_state, loss
+
+        def act_step(p, hc, x):
+            hc = gru_cell(p, hc, x)
+            return hc, hc @ p["wq"] + p["bq"]
+
+        self._update = jax.jit(update)
+        self._act_step = jax.jit(act_step)
+        self._jax = jax
+        self._jnp = jnp
+
+    # ----------------------------------------------------------- rollouts
+    def _epsilon(self) -> float:
+        cfg = self.cfg
+        frac = min(1.0, self.iteration / max(1, cfg.epsilon_decay_iters))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+
+    def _collect_episode(self, epsilon: float, store: bool = True) -> float:
+        cfg, jnp = self.cfg, self._jnp
+        env = self.env
+        obs = env.reset()
+        hc = jnp.zeros((1, cfg.hidden))
+        rows = {k: [] for k in ("obs", "actions", "rewards", "next_obs",
+                                "dones")}
+        total = 0.0
+        for _ in range(cfg.max_episode_steps):
+            hc, q = self._act_step(self.params, hc, jnp.asarray(obs[None]))
+            if epsilon > 0 and self._np_rng.random() < epsilon:
+                a = int(self._np_rng.integers(cfg.num_actions))
+            else:
+                a = int(np.asarray(q)[0].argmax())
+            nxt, r, done, _ = env.step(a)
+            rows["obs"].append(obs)
+            rows["actions"].append(a)
+            rows["rewards"].append(r)
+            rows["next_obs"].append(nxt)
+            rows["dones"].append(float(done))
+            total += r
+            obs = nxt
+            if done:
+                break
+        if store:
+            self._store_episode(rows)
+        return total
+
+    def _store_episode(self, rows: Dict[str, list]) -> None:
+        """Chop the episode into fixed seq_len windows (zero-padded, with a
+        validity mask) — R2D2's stored-sequence format."""
+        cfg = self.cfg
+        T = len(rows["actions"])
+        for start in range(0, T, cfg.seq_len - cfg.burn_in or 1):
+            end = min(start + cfg.seq_len, T)
+            n = end - start
+            seq = {
+                "obs": np.zeros((cfg.seq_len, cfg.obs_dim), np.float32),
+                "next_obs": np.zeros((cfg.seq_len, cfg.obs_dim), np.float32),
+                "actions": np.zeros(cfg.seq_len, np.int32),
+                "rewards": np.zeros(cfg.seq_len, np.float32),
+                "dones": np.ones(cfg.seq_len, np.float32),
+                "mask": np.zeros(cfg.seq_len, np.float32),
+            }
+            seq["obs"][:n] = rows["obs"][start:end]
+            seq["next_obs"][:n] = rows["next_obs"][start:end]
+            seq["actions"][:n] = rows["actions"][start:end]
+            seq["rewards"][:n] = rows["rewards"][start:end]
+            seq["dones"][:n] = rows["dones"][start:end]
+            seq["mask"][:n] = 1.0
+            self._sequences.append(seq)
+            if start == 0 and end == T:
+                break
+        if len(self._sequences) > cfg.buffer_capacity:
+            self._sequences = self._sequences[-cfg.buffer_capacity:]
+
+    # --------------------------------------------------------------- train
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        eps = self._epsilon()
+        returns = [self._collect_episode(eps)
+                   for _ in range(cfg.episodes_per_iter)]
+        self._reward_hist.extend(returns)
+        self._reward_hist = self._reward_hist[-200:]
+
+        losses = []
+        if len(self._sequences) >= cfg.train_batch_size:
+            for _ in range(cfg.updates_per_iter):
+                idx = self._np_rng.integers(0, len(self._sequences),
+                                            cfg.train_batch_size)
+                rows = [self._sequences[i] for i in idx]
+                batch = {k: self._jnp.asarray(np.stack([r[k] for r in rows]))
+                         for k in rows[0]}
+                self.params, self.opt_state, loss = self._update(
+                    self.params, self.opt_state, self.target, batch)
+                losses.append(float(loss))
+            if self.iteration % cfg.target_update_interval == 0:
+                self.target = self._jax.device_get(self.params)
+        return {
+            "episode_reward_mean": float(np.mean(self._reward_hist)),
+            "epsilon": eps,
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "num_sequences": len(self._sequences),
+        }
+
+    def greedy_return(self, episodes: int = 20) -> float:
+        return float(np.mean([self._collect_episode(0.0, store=False)
+                              for _ in range(episodes)]))
+
+    def get_weights(self):
+        return self._jax.device_get(self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = self._jax.tree_util.tree_map(self._jnp.asarray, weights)
+        self.target = self._jax.device_get(self.params)

@@ -109,8 +109,12 @@ class TuneConfig:
 
 
 class ResultGrid:
-    def __init__(self, results: List[Result]):
+    def __init__(self, results: List[Result],
+                 default_metric: str = "score",
+                 default_mode: str = "max"):
         self._results = results
+        self._default_metric = default_metric
+        self._default_mode = default_mode
 
     def __len__(self):
         return len(self._results)
@@ -119,8 +123,10 @@ class ResultGrid:
         return self._results[i]
 
     def get_best_result(self, metric: Optional[str] = None,
-                        mode: str = "max") -> Result:
-        metric = metric or "score"
+                        mode: Optional[str] = None) -> Result:
+        """Defaults to the TuneConfig's metric/mode (reference ResultGrid)."""
+        metric = metric or self._default_metric
+        mode = mode or self._default_mode
         scored = [r for r in self._results if metric in r.metrics]
         if not scored:
             raise ValueError(f"no trial reported metric '{metric}'")
@@ -303,4 +309,5 @@ class Tuner:
             metrics["config"] = t.config
             results.append(Result(metrics=metrics, checkpoint=t.last_checkpoint,
                                   error=err, metrics_history=t.history))
-        return results and ResultGrid(results) or ResultGrid([])
+        return ResultGrid(results, default_metric=self._cfg.metric,
+                          default_mode=self._cfg.mode)

@@ -86,9 +86,11 @@ def test_token_loader_sequential_epoch(tmp_path):
     tokens = np.arange(320, dtype=np.int32)
     path = tmp_path / "seq.bin"
     tokens.tofile(path)
-    # window 16 -> 20 disjoint windows; batch 4 -> 5 batches/epoch
+    # window 16 -> 20 disjoint windows; batch 4 -> 5 batches/epoch.
+    # n_threads=1 so consumed batches align with cursor order — with more
+    # threads the prefetch ring can legitimately overrun into epoch 1.
     with TokenLoader(str(path), batch=4, seq_len=15, mode="sequential",
-                     seed=3) as ld:
+                     seed=3, n_threads=1) as ld:
         assert ld.batches_per_epoch == 5
         starts = []
         for _ in range(5):

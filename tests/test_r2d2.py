@@ -1,0 +1,47 @@
+"""R2D2: recurrent replay DQN must solve a memory task feedforward can't."""
+
+import numpy as np
+import pytest
+
+
+def test_memory_corridor_env():
+    from ray_tpu.rllib.r2d2 import MemoryCorridorEnv
+
+    env = MemoryCorridorEnv(seed=0, length=3)
+    obs = env.reset()
+    assert obs[:2].sum() == 1.0  # cue visible only at t=0
+    cue = int(obs.argmax())
+    for _ in range(3):
+        obs, r, done, _ = env.step(0)
+        assert obs[2] == 1.0 and r == 0.0 and not done
+    _, r, done, _ = env.step(cue)
+    assert done and r == 1.0
+
+
+@pytest.mark.slow
+def test_r2d2_learns_memory_task():
+    """Greedy policy must recall the t=0 cue across the corridor — chance
+    is 0.0 mean reward; a working recurrent learner approaches +1."""
+    from ray_tpu.rllib.r2d2 import R2D2Config
+
+    algo = R2D2Config().training(seed=1).build()
+    for _ in range(60):
+        algo.train()
+    score = algo.greedy_return(episodes=30)
+    assert score >= 0.8, score
+
+    # Trainable contract
+    ckpt = algo.save()
+    algo.restore(ckpt)
+    assert algo.greedy_return(episodes=5) >= 0.8
+
+
+def test_r2d2_sequence_storage_shapes():
+    from ray_tpu.rllib.r2d2 import R2D2Config
+
+    algo = R2D2Config().training(seed=2, max_episode_steps=6).build()
+    algo._collect_episode(epsilon=1.0)
+    assert algo._sequences
+    seq = algo._sequences[0]
+    assert seq["obs"].shape == (algo.cfg.seq_len, algo.cfg.obs_dim)
+    assert seq["mask"].sum() >= 1
