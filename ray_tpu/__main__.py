@@ -54,6 +54,10 @@ def main(argv=None) -> int:
     p_mem = sub.add_parser("memory", help="object store usage per node")
     p_mem.add_argument("--address", required=True)
 
+    p_logs = sub.add_parser("logs", help="recent worker stdout/stderr")
+    p_logs.add_argument("--address", required=True)
+    p_logs.add_argument("--lines", type=int, default=200)
+
     p_stack = sub.add_parser("stack", help="dump local worker stack traces")
     p_stack.add_argument("--address", required=True)
 
@@ -133,7 +137,7 @@ def main(argv=None) -> int:
         return 0
 
     if args.cmd in ("memory", "stack", "healthcheck", "global-gc",
-                    "kill-random-node"):
+                    "kill-random-node", "logs"):
         # raw GCS/raylet RPC — no driver registration needed
         from ray_tpu.core import rpc as _rpc
 
@@ -180,6 +184,13 @@ def main(argv=None) -> int:
                           f"(driver-embedded raylet)")
                     return 1
                 print(f"killed node {v['node_id'].hex()[:8]}")
+                return 0
+            if args.cmd == "logs":
+                for entry in gcs.call("get_recent_logs",
+                                      {"lines": args.lines}):
+                    for line in entry.get("lines", []):
+                        print(f"(pid={entry.get('pid')}, "
+                              f"{entry.get('stream')}) {line}")
                 return 0
             if args.cmd == "memory":
                 out = []

@@ -54,6 +54,7 @@ def init(
     object_store_memory: Optional[int] = None,
     ignore_reinit_error: bool = False,
     log_level: str = "WARNING",
+    log_to_driver: bool = True,
 ) -> dict:
     """Start (or connect to) a cluster and connect this process as a driver.
 
@@ -116,7 +117,8 @@ def init(
             raylet_address = alive[0]["address"]
 
         _worker = CoreWorker(
-            mode="driver", raylet_address=raylet_address, gcs_address=gcs_address)
+            mode="driver", raylet_address=raylet_address,
+            gcs_address=gcs_address, log_to_driver=log_to_driver)
         set_current_worker(_worker)
         atexit.register(shutdown)
         return {"gcs_address": gcs_address, "raylet_address": raylet_address}

@@ -32,6 +32,7 @@ CH_ACTORS = "actors"
 CH_RESOURCES = "resources"
 CH_ERRORS = "errors"
 CH_CONTROL = "control"  # cluster-wide commands (global_gc, ...)
+CH_LOGS = "logs"        # worker stdout/stderr fan-out to drivers
 
 
 class GcsServer:
@@ -47,6 +48,11 @@ class GcsServer:
 
         # kv: namespace -> key -> value
         self._kv: Dict[str, Dict[bytes, Any]] = {}
+
+        # recent worker log lines for `ray_tpu logs`
+        from collections import deque
+
+        self._recent_logs = deque(maxlen=1000)
 
         # actors
         self._actors: Dict[ActorID, ActorInfo] = {}
@@ -114,6 +120,28 @@ class GcsServer:
             self._subs.get(channel, []).remove(conn)
         except ValueError:
             pass
+
+    def rpc_publish_logs(self, conn, req_id, payload):
+        """Raylet-forwarded worker stdout/stderr -> CH_LOGS subscribers
+        (the reference's log_monitor tail-to-driver, log_monitor.py)."""
+        self._recent_logs.append(payload)
+        self._publish(CH_LOGS, payload)
+        return True
+
+    def rpc_get_recent_logs(self, conn, req_id, payload):
+        """Last `lines` individual log lines, flattened across publish
+        batches (one entry per line, newest last)."""
+        n = payload.get("lines", 200) if payload else 200
+        if n <= 0:
+            return []
+        flat = []
+        for entry in self._recent_logs:
+            for line in entry.get("lines", []):
+                flat.append({"pid": entry.get("pid"),
+                             "stream": entry.get("stream"),
+                             "node_id": entry.get("node_id"),
+                             "lines": [line]})
+        return flat[-n:]
 
     def rpc_global_gc(self, conn, req_id, payload):
         """Broadcast a gc request to every raylet -> every worker

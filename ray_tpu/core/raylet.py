@@ -441,6 +441,16 @@ class Raylet:
     # exit would take the driver down with it.
     allow_chaos_kill = False
 
+    def rpc_worker_log(self, conn, req_id, payload):
+        """Worker stdout/stderr lines -> GCS CH_LOGS fan-out."""
+        payload = dict(payload)
+        payload["node_id"] = self.node_id.binary()
+        try:
+            self._gcs.notify("publish_logs", payload)
+        except Exception:
+            pass
+        return True
+
     def rpc_die(self, conn, req_id, payload):
         """Chaos kill for fault-injection tests (reference
         `ray kill_random_node`, scripts.py:1325): hard-exit the node."""

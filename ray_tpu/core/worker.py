@@ -17,6 +17,8 @@ the node-local object store.
 
 from __future__ import annotations
 
+import asyncio
+import inspect
 import logging
 import os
 import queue
@@ -145,8 +147,10 @@ class CoreWorker:
         job_id: Optional[JobID] = None,
         host: str = "127.0.0.1",
         connect_timeout: Optional[float] = None,
+        log_to_driver: bool = True,
     ):
         self.mode = mode
+        self.log_to_driver = log_to_driver
         self.worker_id = WorkerID.from_random()
         self.job_id = job_id or JobID.from_random()
         self.raylet_address = raylet_address
@@ -222,7 +226,10 @@ class CoreWorker:
                 "job_id": self.job_id.binary(),
                 "driver_address": self._server.address,
             })
-            self.gcs.call("subscribe", {"channels": ["actors"]})
+            channels = ["actors"]
+            if self.log_to_driver:
+                channels.append("logs")
+            self.gcs.call("subscribe", {"channels": channels})
 
     # ------------------------------------------------------------------ util
     @property
@@ -796,8 +803,41 @@ class CoreWorker:
                     self._obj_cv.notify_all()
             self._notify_info_waiters(oid)
 
+    def _log_print_queue(self) -> "queue.Queue":
+        q = getattr(self, "_log_queue", None)
+        if q is None:
+            q = queue.Queue()
+            self._log_queue = q
+
+            def printer():
+                import sys as _sys
+
+                while not self._shutdown.is_set():
+                    try:
+                        msg = q.get(timeout=0.5)
+                    except queue.Empty:
+                        continue
+                    out = (_sys.stderr if msg.get("stream") == "stderr"
+                           else _sys.stdout)
+                    for line in msg.get("lines", []):
+                        print(f"(pid={msg.get('pid')}) {line}", file=out)
+
+            threading.Thread(target=printer, name="log-printer",
+                             daemon=True).start()
+        return q
+
     def _on_gcs_push(self, method: str, payload) -> None:
         if method != "pubsub":
+            return
+        if payload["channel"] == "logs":
+            msg = payload["message"]
+            # only this driver's job (unattributed lines pass through);
+            # printed from a dedicated thread so a blocked stdout can't
+            # stall the rpc reader that also carries actor updates
+            job = msg.get("job_id")
+            if job is not None and job != self.job_id.binary():
+                return
+            self._log_print_queue().put(msg)
             return
         if payload["channel"] == "actors":
             msg = payload["message"]
@@ -929,6 +969,7 @@ class CoreWorker:
         (cf. reference `_raylet.pyx:718 execute_task`)."""
         prev_task_id = getattr(self._tls, "task_id", None)
         self._tls.task_id = spec.task_id
+        self._tls.job_id = spec.job_id  # log attribution (tee -> driver)
         prev_pg = getattr(self._tls, "placement_group_id", None)
         self._tls.placement_group_id = spec.scheduling.placement_group_id
         self._emit_task_event(spec, "RUNNING")
@@ -945,6 +986,21 @@ class CoreWorker:
                     self._apply_runtime_env(spec.runtime_env)
             args, kwargs = self._deserialize_args(spec.args, spec.kwargs_blob)
             value = fn(*args, **kwargs)
+            if inspect.isasyncgen(value):
+                raise TypeError(
+                    "async generator returns are not supported; collect "
+                    "results into a list inside the task")
+            if inspect.iscoroutine(value):
+                # async tasks / actor methods (reference async actors): one
+                # PERSISTENT event loop per exec thread, so loop-bound actor
+                # state (asyncio.Lock/Queue created in one call) stays valid
+                # across calls. With max_concurrency=1 every call shares the
+                # single loop, matching the reference's semantics.
+                loop = getattr(self._tls, "aio_loop", None)
+                if loop is None or loop.is_closed():
+                    loop = asyncio.new_event_loop()
+                    self._tls.aio_loop = loop
+                value = loop.run_until_complete(value)
             if spec.num_returns == 1:
                 values = [value]
             else:

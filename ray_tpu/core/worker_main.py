@@ -36,6 +36,66 @@ def main() -> None:
     _stack_file = open(os.path.join(stack_dir, f"{os.getpid()}.txt"), "w")
     faulthandler.register(signal.SIGUSR1, file=_stack_file, all_threads=True)
 
+    # Tee stdout/stderr to the raylet so drivers see task prints
+    # (reference log_monitor tail-to-driver). Installed BEFORE the worker
+    # connects — tasks can start executing the moment registration lands,
+    # so lines buffer until the raylet client exists. logging handlers keep
+    # their original stream objects, so runtime logs don't recurse.
+    import sys as _sys
+
+    import threading as _threading
+
+    class _Tee:
+        def __init__(self, stream, name):
+            self._stream = stream
+            self._name = name
+            self._buf = ""
+            self._pending = []
+            self._lock = _threading.Lock()
+            self.raylet = None  # set once connected
+
+        def write(self, data):
+            self._stream.write(data)
+            with self._lock:
+                self._buf += data
+                if "\n" not in self._buf:
+                    return
+                *lines, self._buf = self._buf.split("\n")
+                self._pending.extend(ln for ln in lines if ln.strip())
+            self._drain()
+
+        def _current_job(self):
+            from ray_tpu.core.worker import current_worker
+
+            w = current_worker()
+            if w is None:
+                return None
+            jid = getattr(w._tls, "job_id", None)
+            return jid.binary() if jid is not None else None
+
+        def _drain(self):
+            with self._lock:
+                if self.raylet is None or not self._pending:
+                    return
+                lines, self._pending = self._pending, []
+            try:
+                self.raylet.notify("worker_log", {
+                    "pid": os.getpid(), "stream": self._name, "lines": lines,
+                    "job_id": self._current_job()})
+            except Exception:
+                pass
+
+        def flush(self):
+            self._stream.flush()
+
+        def __getattr__(self, name):
+            return getattr(self._stream, name)
+
+    out_tee = _Tee(_sys.stdout, "stdout")
+    err_tee = _Tee(_sys.stderr, "stderr")
+    _sys.stdout = out_tee
+    _sys.stderr = err_tee
+
     from ray_tpu.core.worker import CoreWorker, set_current_worker
 
     try:
@@ -45,6 +105,9 @@ def main() -> None:
     except ConnectionError:
         return  # raylet is gone (e.g. shut down while we were starting)
     set_current_worker(worker)
+    out_tee.raylet = err_tee.raylet = worker.raylet
+    out_tee._drain()
+    err_tee._drain()
 
     # Serve until the raylet connection drops (raylet died or killed us).
     try:

@@ -79,3 +79,73 @@ def test_microbenchmark_runs(ray_start_regular, capsys):
             "put_get_10mb_bytes"} <= names
     for r in rows:
         assert r["rate"] > 0
+
+
+def test_worker_prints_stream_to_driver(gcs_address, capsys):
+    """print() inside a task surfaces in the driver with a pid prefix
+    (reference log_monitor tail-to-driver)."""
+    import time
+
+    @ray_tpu.remote
+    def chatty():
+        print("hello-from-task-42")
+        return 1
+
+    assert ray_tpu.get(chatty.remote(), timeout=60) == 1
+    deadline = time.monotonic() + 15
+    seen = ""
+    while time.monotonic() < deadline:
+        seen += capsys.readouterr().out
+        if "hello-from-task-42" in seen:
+            break
+        time.sleep(0.2)
+    assert "hello-from-task-42" in seen and "(pid=" in seen
+
+    # and the GCS ring buffer serves it to the `logs` CLI
+    rc, out = _cli(capsys, "logs", "--address", gcs_address)
+    assert rc == 0 and "hello-from-task-42" in out
+
+
+def test_async_task_and_actor(ray_start_regular):
+    """async def tasks and actor methods run to completion."""
+    import asyncio
+
+    @ray_tpu.remote
+    async def aio_task(x):
+        await asyncio.sleep(0.01)
+        return x * 2
+
+    assert ray_tpu.get(aio_task.remote(21), timeout=60) == 42
+
+    @ray_tpu.remote
+    class AsyncActor:
+        async def compute(self, a, b):
+            await asyncio.sleep(0.01)
+            return a + b
+
+    actor = AsyncActor.remote()
+    assert ray_tpu.get(actor.compute.remote(1, 2), timeout=60) == 3
+    ray_tpu.kill(actor)
+
+
+def test_async_actor_loop_persists_across_calls(ray_start_regular):
+    """asyncio primitives created in one method work in later methods —
+    the exec thread keeps ONE event loop (reference async actor model)."""
+    import asyncio
+
+    @ray_tpu.remote
+    class Stateful:
+        async def setup(self):
+            self.lock = asyncio.Lock()
+            self.queue = asyncio.Queue()
+            await self.queue.put(1)
+            return True
+
+        async def use(self):
+            async with self.lock:
+                return await self.queue.get()
+
+    a = Stateful.remote()
+    assert ray_tpu.get(a.setup.remote(), timeout=60)
+    assert ray_tpu.get(a.use.remote(), timeout=60) == 1
+    ray_tpu.kill(a)
