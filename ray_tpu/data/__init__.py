@@ -14,6 +14,8 @@ from ray_tpu.data.datastream import (
     read_parquet,
     read_tfrecords,
     read_text,
+    from_pandas,
+    from_arrow,
 )
 
 # reference-compatible module-level names

@@ -325,6 +325,23 @@ class Datastream:
         n_test = int(n * test_size) if isinstance(test_size, float) else test_size
         return ds.split_at_indices([n - n_test])
 
+    def to_pandas(self):
+        """Materialize into one DataFrame (reference Datastream.to_pandas)."""
+        import pandas as pd
+
+        rows = self.take_all()
+        if not rows:
+            return pd.DataFrame()
+        if isinstance(rows[0], dict):
+            return pd.DataFrame(rows)
+        return pd.DataFrame({"value": rows})
+
+    def to_arrow(self):
+        """Materialize into one pyarrow Table."""
+        import pyarrow as pa
+
+        return pa.Table.from_pandas(self.to_pandas(), preserve_index=False)
+
     def split_at_indices(self, indices: List[int]) -> List["Datastream"]:
         """Split into len(indices)+1 streams at global row offsets. Each
         piece keeps the source's block parallelism so downstream
@@ -819,3 +836,26 @@ def _write_block_tfrecords(block: Block, path: str) -> None:
 
     write_records(path, [encode_example(
         {k: v for k, v in row.items()}) for row in _block_rows(block)])
+
+
+def from_pandas(dfs) -> Datastream:
+    """One block per DataFrame (reference ray.data.from_pandas)."""
+    import pandas as pd
+
+    if isinstance(dfs, pd.DataFrame):
+        dfs = [dfs]
+    return Datastream([
+        ray_tpu.put({c: df[c].to_numpy() for c in df.columns}) for df in dfs])
+
+
+def from_arrow(tables) -> Datastream:
+    """One block per pyarrow Table (reference ray.data.from_arrow)."""
+    import pyarrow as pa
+
+    if isinstance(tables, pa.Table):
+        tables = [tables]
+    return Datastream([
+        ray_tpu.put({c: t[c].to_numpy() for c in t.column_names})
+        for t in tables])
+
+

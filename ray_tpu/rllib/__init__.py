@@ -19,3 +19,5 @@ from ray_tpu.rllib.multi_agent import (
     MultiAgentEnv, QMix, QMixConfig, TwoStepCooperativeEnv,
     policy_mapping_rollout)
 from ray_tpu.rllib.r2d2 import MemoryCorridorEnv, R2D2, R2D2Config
+from ray_tpu.rllib.alpha_zero import (
+    AlphaZero, AlphaZeroConfig, MCTS, TicTacToeEnv)

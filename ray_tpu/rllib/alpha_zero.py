@@ -1,0 +1,339 @@
+"""AlphaZero: MCTS self-play + policy/value network (Silver et al. 2017).
+
+Mirrors the reference's AlphaZero (`rllib/algorithms/alpha_zero/`): PUCT
+tree search guided by a policy/value net, self-play games generating
+(state, visit-distribution, outcome) triples, and a jitted supervised
+update (policy cross-entropy + value MSE). The board game is pluggable via
+the `GameEnv` contract; `TicTacToeEnv` is the in-tree example (the
+reference ships open_spiel connectors instead — an external dep this build
+avoids).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.models import init_mlp, mlp_hidden
+
+
+class TicTacToeEnv:
+    """Canonical-player board game: observations are always from the
+    perspective of the player to move (+1 own, -1 opponent)."""
+
+    num_actions = 9
+    observation_dim = 9
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> np.ndarray:
+        self.board = np.zeros(9, np.int8)
+        self.player = 1
+        return self.observation()
+
+    def observation(self) -> np.ndarray:
+        return (self.board * self.player).astype(np.float32)
+
+    def legal_actions(self) -> List[int]:
+        return [i for i in range(9) if self.board[i] == 0]
+
+    _LINES = [(0, 1, 2), (3, 4, 5), (6, 7, 8), (0, 3, 6), (1, 4, 7),
+              (2, 5, 8), (0, 4, 8), (2, 4, 6)]
+
+    def winner(self) -> Optional[int]:
+        """+1/-1 winner, 0 draw, None ongoing."""
+        for a, b, c in self._LINES:
+            s = int(self.board[a]) + int(self.board[b]) + int(self.board[c])
+            if s == 3:
+                return 1
+            if s == -3:
+                return -1
+        if not (self.board == 0).any():
+            return 0
+        return None
+
+    def step(self, action: int) -> Tuple[np.ndarray, Optional[float], bool]:
+        """Returns (obs for the NEXT player, outcome for the MOVER, done)."""
+        assert self.board[action] == 0, "illegal move"
+        self.board[action] = self.player
+        w = self.winner()
+        self.player = -self.player
+        if w is None:
+            return self.observation(), None, False
+        # outcome from the mover's perspective
+        mover = -self.player
+        return self.observation(), float(w * mover), True
+
+    def clone(self) -> "TicTacToeEnv":
+        e = TicTacToeEnv()
+        e.board = self.board.copy()
+        e.player = self.player
+        return e
+
+
+class _Node:
+    __slots__ = ("prior", "visits", "value_sum", "children")
+
+    def __init__(self, prior: float):
+        self.prior = prior
+        self.visits = 0
+        self.value_sum = 0.0
+        self.children: Dict[int, "_Node"] = {}
+
+    @property
+    def q(self) -> float:
+        return self.value_sum / self.visits if self.visits else 0.0
+
+
+class MCTS:
+    """PUCT search over canonical game states."""
+
+    def __init__(self, predict: Callable, n_simulations: int = 50,
+                 c_puct: float = 1.5, dirichlet_alpha: float = 0.6,
+                 noise_frac: float = 0.25, rng: Optional[np.random.Generator] = None):
+        self.predict = predict
+        self.n_sim = n_simulations
+        self.c = c_puct
+        self.alpha = dirichlet_alpha
+        self.noise_frac = noise_frac
+        self.rng = rng or np.random.default_rng(0)
+
+    def policy(self, env: TicTacToeEnv, *, add_noise: bool = True
+               ) -> np.ndarray:
+        root = _Node(0.0)
+        self._expand(root, env, add_noise=add_noise)
+        for _ in range(self.n_sim):
+            self._simulate(root, env.clone())
+        visits = np.zeros(env.num_actions, np.float32)
+        for a, child in root.children.items():
+            visits[a] = child.visits
+        total = visits.sum()
+        return visits / total if total else visits
+
+    def _expand(self, node: _Node, env: TicTacToeEnv, *,
+                add_noise: bool = False) -> float:
+        priors, value = self.predict(env.observation())
+        legal = env.legal_actions()
+        mask = np.zeros(env.num_actions, bool)
+        mask[legal] = True
+        p = np.where(mask, priors, 0.0)
+        p = p / p.sum() if p.sum() > 0 else mask / mask.sum()
+        if add_noise and legal:
+            noise = self.rng.dirichlet([self.alpha] * len(legal))
+            for i, a in enumerate(legal):
+                p[a] = (1 - self.noise_frac) * p[a] + self.noise_frac * noise[i]
+        for a in legal:
+            node.children[a] = _Node(float(p[a]))
+        return float(value)
+
+    def _simulate(self, node: _Node, env: TicTacToeEnv) -> float:
+        """Returns the value from the perspective of the player to move at
+        `node`. Children hold the NEXT player's nodes, so values negate."""
+        if not node.children:  # terminal or unexpanded leaf
+            w = env.winner()
+            if w is not None:
+                return float(w * env.player)
+            return self._expand(node, env)
+        # PUCT select
+        sqrt_total = math.sqrt(max(1, node.visits))
+        best, best_score = None, -1e18
+        for a, child in node.children.items():
+            u = self.c * child.prior * sqrt_total / (1 + child.visits)
+            score = -child.q + u  # child.q is from the opponent's view
+            if score > best_score:
+                best, best_score = a, score
+        child = node.children[best]
+        _, outcome, done = env.step(best)
+        if done:
+            # outcome is from the MOVER's (this node's player's)
+            # perspective; the child holds the opponent's view
+            v_child = -float(outcome)
+        else:
+            v_child = self._simulate(child, env)
+        child.visits += 1
+        child.value_sum += v_child   # child stats are the child player's view
+        node.visits += 1
+        return -v_child              # flip back to this node's player
+
+
+class AlphaZeroConfig:
+    def __init__(self):
+        self.env_maker: Callable[[], Any] = TicTacToeEnv
+        self.obs_dim = TicTacToeEnv.observation_dim
+        self.num_actions = TicTacToeEnv.num_actions
+        self.hidden = 64
+        self.lr = 5e-3
+        self.n_simulations = 40
+        self.c_puct = 1.5
+        self.games_per_iter = 12
+        self.train_batch_size = 64
+        self.updates_per_iter = 8
+        self.buffer_capacity = 4000
+        self.temperature_moves = 4   # sample pi^1 early, argmax after
+        self.value_loss_weight = 1.0
+        self.seed = 0
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown AlphaZero option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "AlphaZero":
+        return AlphaZero({"az_config": self})
+
+
+class AlphaZero(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg: AlphaZeroConfig = config.get("az_config") or AlphaZeroConfig()
+        self.cfg = cfg
+        rng = np.random.default_rng(cfg.seed)
+        self._np_rng = rng
+        h = cfg.hidden
+        self.params = jax.tree_util.tree_map(jnp.asarray, {
+            "trunk": init_mlp(rng, (cfg.obs_dim, h, h)),
+            "policy": init_mlp(rng, (h, cfg.num_actions)),
+            "value": init_mlp(rng, (h, 1)),
+        })
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._buffer: List[Tuple[np.ndarray, np.ndarray, float]] = []
+
+        def net(p, obs):
+            x = mlp_hidden(p["trunk"], obs, 2)
+            logits = x @ p["policy"]["w0"] + p["policy"]["b0"]
+            value = jnp.tanh((x @ p["value"]["w0"] + p["value"]["b0"])[..., 0])
+            return logits, value
+
+        self._net = jax.jit(net)
+
+        def loss_fn(p, batch):
+            logits, value = net(p, batch["obs"])
+            logp = jax.nn.log_softmax(logits)
+            policy_loss = -(batch["pi"] * logp).sum(-1).mean()
+            value_loss = ((value - batch["z"]) ** 2).mean()
+            return policy_loss + cfg.value_loss_weight * value_loss
+
+        def update(p, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(p, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state, p)
+            return optax.apply_updates(p, updates), opt_state, loss
+
+        self._update = jax.jit(update)
+        self._jax = jax
+        self._jnp = jnp
+
+    # ------------------------------------------------------------- predict
+    def _predict(self, obs: np.ndarray) -> Tuple[np.ndarray, float]:
+        logits, value = self._net(self.params, self._jnp.asarray(obs[None]))
+        p = np.asarray(self._jax.nn.softmax(logits[0]))
+        return p, float(value[0])
+
+    def _mcts(self, n_simulations: Optional[int] = None) -> MCTS:
+        return MCTS(self._predict,
+                    n_simulations=n_simulations or self.cfg.n_simulations,
+                    c_puct=self.cfg.c_puct, rng=self._np_rng)
+
+    # ------------------------------------------------------------ self-play
+    def _self_play(self) -> Tuple[int, int]:
+        cfg = self.cfg
+        env = cfg.env_maker()
+        env.reset()
+        mcts = self._mcts()
+        history: List[Tuple[np.ndarray, np.ndarray, int]] = []
+        move = 0
+        while True:
+            pi = mcts.policy(env)
+            history.append((env.observation().copy(), pi, env.player))
+            if move < cfg.temperature_moves:
+                # float64 renormalize: float32 rounding can trip numpy's
+                # sum-to-1 check in choice()
+                p = pi.astype(np.float64)
+                action = int(self._np_rng.choice(len(p), p=p / p.sum()))
+            else:
+                action = int(pi.argmax())
+            _, outcome, done = env.step(action)
+            move += 1
+            if done:
+                w = env.winner()
+                for obs, pi_t, player in history:
+                    z = float(w * player) if w is not None else 0.0
+                    self._buffer.append((obs, pi_t, z))
+                if len(self._buffer) > cfg.buffer_capacity:
+                    self._buffer = self._buffer[-cfg.buffer_capacity:]
+                return move, int(w or 0)
+
+    # --------------------------------------------------------------- train
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        lengths, outcomes = [], []
+        for _ in range(cfg.games_per_iter):
+            length, w = self._self_play()
+            lengths.append(length)
+            outcomes.append(w)
+
+        losses = []
+        if len(self._buffer) >= cfg.train_batch_size:
+            for _ in range(cfg.updates_per_iter):
+                idx = self._np_rng.integers(0, len(self._buffer),
+                                            cfg.train_batch_size)
+                obs = np.stack([self._buffer[i][0] for i in idx])
+                pi = np.stack([self._buffer[i][1] for i in idx])
+                z = np.asarray([self._buffer[i][2] for i in idx], np.float32)
+                batch = {k: self._jnp.asarray(v)
+                         for k, v in (("obs", obs), ("pi", pi), ("z", z))}
+                self.params, self.opt_state, loss = self._update(
+                    self.params, self.opt_state, batch)
+                losses.append(float(loss))
+        return {
+            "mean_game_length": float(np.mean(lengths)),
+            "draw_rate": float(np.mean([o == 0 for o in outcomes])),
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "buffer": len(self._buffer),
+        }
+
+    # ------------------------------------------------------------ evaluate
+    def play_vs_random(self, games: int = 20, seed: int = 123,
+                       n_simulations: Optional[int] = None
+                       ) -> Dict[str, float]:
+        """Greedy MCTS (no noise) vs a uniform-random opponent; the agent
+        alternates playing first/second. Evaluation searches deeper than
+        self-play by default (self-play trades depth for game throughput)."""
+        rng = np.random.default_rng(seed)
+        sims = n_simulations or max(self.cfg.n_simulations, 120)
+        results = {"win": 0, "draw": 0, "loss": 0}
+        for g in range(games):
+            env = self.cfg.env_maker()
+            env.reset()
+            agent_player = 1 if g % 2 == 0 else -1
+            mcts = self._mcts(n_simulations=sims)
+            while env.winner() is None:
+                if env.player == agent_player:
+                    pi = mcts.policy(env, add_noise=False)
+                    action = int(pi.argmax())
+                else:
+                    action = int(rng.choice(env.legal_actions()))
+                env.step(action)
+            w = env.winner()
+            if w == 0:
+                results["draw"] += 1
+            elif w == agent_player:
+                results["win"] += 1
+            else:
+                results["loss"] += 1
+        return {k: v / games for k, v in results.items()}
+
+    def get_weights(self):
+        return self._jax.device_get(self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = self._jax.tree_util.tree_map(self._jnp.asarray, weights)

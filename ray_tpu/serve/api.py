@@ -485,3 +485,63 @@ def start_http_proxy(port: int = 0):
     """Start the HTTP ingress actor; returns (actor_handle, port)."""
     actor = _HTTPProxyActor.options(num_cpus=0, max_concurrency=8).remote(port)
     return actor, ray_tpu.get(actor.get_port.remote())
+
+
+# ------------------------------------------------------------------- rpc
+
+
+@ray_tpu.remote
+class _RPCProxyActor:
+    """Binary RPC ingress on the framework's native framed protocol —
+    the role of the reference's gRPC ingress (`serve.proto:235`) without
+    protobuf: clients send `serve_request {deployment, method, payload}`
+    and get the pickled result back. Suited to service-to-service calls
+    where JSON-over-HTTP overhead matters."""
+
+    def __init__(self, port: int):
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ray_tpu.core.rpc import RpcServer
+
+        proxy = self
+        pool = ThreadPoolExecutor(max_workers=16,
+                                  thread_name_prefix="serve-rpc")
+
+        def handle(conn, req_id, payload):
+            def run():
+                try:
+                    name = payload["deployment"]
+                    method = payload.get("method", "__call__")
+                    h = proxy._handles.setdefault(
+                        (name, method), DeploymentHandle(name, method))
+                    result = ray_tpu.get(
+                        h.remote(*payload.get("args", ()),
+                                 **payload.get("kwargs", {})),
+                        timeout=payload.get("timeout", 60))
+                    conn.reply(req_id, result)
+                except Exception as e:
+                    conn.reply(req_id, f"{e}", is_error=True)
+
+            pool.submit(run)  # keep the rpc loop free for other requests
+            return RpcServer.DEFERRED
+
+        self._handles: Dict[tuple, DeploymentHandle] = {}
+        self._server = RpcServer(host="127.0.0.1", port=port)
+        self._server.register("serve_request", handle)
+        self._server.start()
+        self.port = self._server.port
+
+    def get_port(self) -> int:
+        return self.port
+
+
+def start_rpc_proxy(port: int = 0):
+    """Start the binary RPC ingress; returns (actor_handle, port).
+
+    Client side:
+        from ray_tpu.core.rpc import RpcClient
+        c = RpcClient(f"127.0.0.1:{port}")
+        c.call("serve_request", {"deployment": "Model", "args": (x,)})
+    """
+    actor = _RPCProxyActor.options(num_cpus=0, max_concurrency=8).remote(port)
+    return actor, ray_tpu.get(actor.get_port.remote())

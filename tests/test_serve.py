@@ -195,3 +195,46 @@ def test_serve_config_file_deploy(serve_cluster, tmp_path):
         assert serve.status()["Hello"]["target"] == 2
     finally:
         sys.path.remove(str(tmp_path))
+
+
+def test_rpc_ingress(serve_cluster):
+    """Binary RPC ingress: serve_request routes to a deployment handle."""
+    from ray_tpu.core.rpc import RpcClient
+
+    @serve.deployment
+    class Adder:
+        def __call__(self, a, b):
+            return a + b
+
+    serve.run(Adder.bind())
+    _, port = serve.start_rpc_proxy()
+    c = RpcClient(f"127.0.0.1:{port}")
+    assert c.call("serve_request",
+                  {"deployment": "Adder", "args": (19, 23)}, timeout=60) == 42
+    # errors come back as typed RPC errors (bad method fails fast — a
+    # missing deployment would poll the 30s replica-discovery deadline)
+    from ray_tpu.core.rpc import RpcCallError
+
+    with pytest.raises(RpcCallError):
+        c.call("serve_request",
+               {"deployment": "Adder", "method": "no_such_method",
+                "args": (1, 2)}, timeout=60)
+    c.close()
+
+
+def test_pandas_arrow_interop(serve_cluster):
+    import pandas as pd
+    import pyarrow as pa
+
+    from ray_tpu import data as rt_data
+
+    df = pd.DataFrame({"a": [1, 2, 3], "b": [0.5, 1.5, 2.5]})
+    ds = rt_data.from_pandas(df)
+    assert ds.count() == 3
+    assert ds.sum("a") == 6
+    back = ds.to_pandas()
+    assert list(back.columns) == ["a", "b"] and len(back) == 3
+
+    t = pa.table({"x": [10, 20]})
+    ds2 = rt_data.from_arrow(t)
+    assert ds2.to_arrow().column("x").to_pylist() == [10, 20]
