@@ -13,6 +13,7 @@ hook can be added behind the same table interface.
 
 from __future__ import annotations
 
+import os
 import logging
 import threading
 import time
@@ -36,10 +37,22 @@ CH_LOGS = "logs"        # worker stdout/stderr fan-out to drivers
 
 
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1"):
+    def __init__(self, host: str = "127.0.0.1",
+                 snapshot_path: Optional[str] = None,
+                 snapshot_interval_s: float = 5.0):
+        """`snapshot_path` enables control-plane persistence: the durable
+        tables (internal KV and the job table) checkpoint to disk and
+        reload on the next start — the role Redis plays for the reference's
+        HA GCS (`gcs_table_storage.h`, `redis_client.h`). Runtime state
+        (live nodes/actors/PGs) re-registers via heartbeats and is
+        deliberately not persisted."""
         self._server = rpc.RpcServer(host)
         self._server.register_all(self)
         self._lock = threading.RLock()
+        self._snapshot_path = snapshot_path
+        self._snapshot_interval_s = snapshot_interval_s
+        self._dirty = False
+        self._snapshot_write_lock = threading.Lock()
 
         # node table: node_id(bytes) -> info dict
         self._nodes: Dict[bytes, dict] = {}
@@ -82,13 +95,68 @@ class GcsServer:
 
     # ------------------------------------------------------------------ boot
     def start(self) -> str:
+        self._load_snapshot()
         self._server.start()
         self._health_thread = threading.Thread(
             target=self._health_loop, name="gcs-health", daemon=True
         )
         self._health_thread.start()
+        if self._snapshot_path:
+            threading.Thread(target=self._snapshot_loop, name="gcs-snapshot",
+                             daemon=True).start()
         logger.info("GCS listening on %s", self._server.address)
         return self._server.address
+
+    # ------------------------------------------------------- persistence
+    def _load_snapshot(self) -> None:
+        if not self._snapshot_path or not os.path.exists(self._snapshot_path):
+            return
+        import pickle
+
+        try:
+            with open(self._snapshot_path, "rb") as f:
+                data = pickle.load(f)
+            with self._lock:
+                self._kv = data.get("kv", {})
+                for jid, job in data.get("jobs", {}).items():
+                    job = dict(job)
+                    if job.get("status") == "RUNNING":
+                        # its driver died with the old head; nothing will
+                        # ever mark it finished
+                        job["status"] = "FAILED"
+                        job.setdefault("end_time", time.time())
+                    self._jobs[jid] = job
+            logger.info("GCS restored %d KV namespaces, %d jobs from %s",
+                        len(self._kv), len(data.get("jobs", {})),
+                        self._snapshot_path)
+        except Exception:
+            logger.exception("snapshot restore failed; starting fresh")
+
+    def _write_snapshot(self) -> None:
+        import pickle
+
+        with self._snapshot_write_lock:  # stop() vs loop: one writer at a time
+            with self._lock:
+                data = {"kv": {ns: dict(t) for ns, t in self._kv.items()},
+                        "jobs": dict(self._jobs)}
+                self._dirty = False
+            try:
+                tmp = f"{self._snapshot_path}.tmp{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    pickle.dump(data, f)
+                os.replace(tmp, self._snapshot_path)
+            except Exception:
+                self._dirty = True  # failed write must be retried
+                raise
+
+    def _snapshot_loop(self) -> None:
+        while not self._shutdown.wait(self._snapshot_interval_s):
+            if self._dirty:
+                try:
+                    self._write_snapshot()
+                except Exception:
+                    logger.exception("snapshot write failed")
+        # stop() performs the final flush (single writer, serialized above)
 
     @property
     def address(self) -> str:
@@ -96,6 +164,11 @@ class GcsServer:
 
     def stop(self) -> None:
         self._shutdown.set()
+        if self._snapshot_path and self._dirty:
+            try:
+                self._write_snapshot()
+            except Exception:
+                pass
         for c in self._raylet_clients.values():
             c.close()
         self._server.stop()
@@ -279,6 +352,7 @@ class GcsServer:
             exists = payload["key"] in table
             if payload.get("overwrite", True) or not exists:
                 table[payload["key"]] = payload["value"]
+                self._dirty = True
                 return True
             return False
 
@@ -290,7 +364,9 @@ class GcsServer:
     def rpc_kv_del(self, conn, req_id, payload):
         ns = payload.get("namespace", "")
         with self._lock:
-            return self._kv.get(ns, {}).pop(payload["key"], None) is not None
+            removed = self._kv.get(ns, {}).pop(payload["key"], None) is not None
+            self._dirty = self._dirty or removed
+            return removed
 
     def rpc_kv_keys(self, conn, req_id, payload):
         ns = payload.get("namespace", "")
@@ -306,6 +382,7 @@ class GcsServer:
     # ---------------------------------------------------------------- jobs
     def rpc_register_job(self, conn, req_id, payload):
         with self._lock:
+            self._dirty = True
             self._jobs[payload["job_id"]] = {
                 "job_id": payload["job_id"],
                 "driver_address": payload.get("driver_address", ""),
@@ -320,6 +397,7 @@ class GcsServer:
             if j:
                 j["status"] = payload.get("status", "SUCCEEDED")
                 j["end_time"] = time.time()
+                self._dirty = True
         return True
 
     def rpc_get_jobs(self, conn, req_id, payload):

@@ -26,6 +26,8 @@ def main(argv=None) -> None:
     parser.add_argument("--labels", default="{}",
                         help='node labels JSON, e.g. \'{"tpu_slice": "s0"}\'')
     parser.add_argument("--object-store-memory", type=int, default=None)
+    parser.add_argument("--snapshot-path", default=None,
+                        help="persist GCS KV/job tables here (head only)")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
 
@@ -45,7 +47,7 @@ def main(argv=None) -> None:
     gcs_address = args.address
     gcs = None
     if args.head:
-        gcs = GcsServer()
+        gcs = GcsServer(snapshot_path=args.snapshot_path)
         gcs_address = gcs.start()
         print(f"ray_tpu head started. GCS address: {gcs_address}")
         print(f"Connect with: ray_tpu.init(address=\"{gcs_address}\")")

@@ -144,3 +144,28 @@ def test_rpc_disconnect_fails_pending():
     server.stop()
     with pytest.raises(rpc.RpcDisconnected):
         fut.result(timeout=5)
+
+
+def test_gcs_snapshot_persistence(tmp_path):
+    """KV and job tables survive a GCS restart via the disk snapshot
+    (reference HA GCS rebuilds from Redis; SURVEY §5.3)."""
+    from ray_tpu.core import rpc
+    from ray_tpu.core.gcs import GcsServer
+
+    snap = str(tmp_path / "gcs.snapshot")
+    gcs = GcsServer(snapshot_path=snap, snapshot_interval_s=0.2)
+    addr = gcs.start()
+    c = rpc.connect_with_retry(addr)
+    c.call("kv_put", {"namespace": "app", "key": b"model", "value": b"v17"})
+    c.call("register_job", {"job_id": b"jobA", "driver_address": "x:1"})
+    c.close()
+    gcs.stop()  # final flush happens on stop
+
+    gcs2 = GcsServer(snapshot_path=snap)
+    addr2 = gcs2.start()
+    c2 = rpc.connect_with_retry(addr2)
+    assert c2.call("kv_get", {"namespace": "app", "key": b"model"}) == b"v17"
+    jobs = c2.call("get_jobs")
+    assert any(j["job_id"] == b"jobA" for j in jobs)
+    c2.close()
+    gcs2.stop()
