@@ -18,6 +18,14 @@ from ray_tpu.data.datastream import (
     from_arrow,
 )
 
+from ray_tpu.data.datasources import (
+    read_images,
+    read_mongo,
+    read_sql,
+    read_webdataset,
+    write_webdataset,
+)
+
 # reference-compatible module-level names
 range = range_  # noqa: A001 (shadows builtin deliberately, like ray.data.range)
 

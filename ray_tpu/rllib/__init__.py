@@ -12,7 +12,8 @@ from ray_tpu.rllib.apex import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.sac import SAC, SACConfig
 from ray_tpu.rllib.ddpg import DDPG, DDPGConfig, TD3, TD3Config
 from ray_tpu.rllib.offline import (
-    BC, BCConfig, CQL, CQLConfig, MARWIL, MARWILConfig, collect_episodes)
+    BC, BCConfig, CQL, CQLConfig, CRR, CRRConfig, MARWIL, MARWILConfig,
+    collect_episodes)
 from ray_tpu.rllib.bandit import BanditLinTS, BanditLinUCB, LinearBanditEnv
 from ray_tpu.rllib.replay_buffers import ReplayBuffer, PrioritizedReplayBuffer
 from ray_tpu.rllib.multi_agent import (
@@ -21,3 +22,6 @@ from ray_tpu.rllib.multi_agent import (
 from ray_tpu.rllib.r2d2 import MemoryCorridorEnv, R2D2, R2D2Config
 from ray_tpu.rllib.alpha_zero import (
     AlphaZero, AlphaZeroConfig, MCTS, TicTacToeEnv)
+from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig
+from ray_tpu.rllib.dt import DT, DTConfig
+from ray_tpu.rllib.maddpg import MADDPG, MADDPGConfig, SpreadEnv

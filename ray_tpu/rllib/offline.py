@@ -372,3 +372,138 @@ class CQL(_OfflineBase):
 
         self.params = jax.tree.map(jnp.asarray, weights["params"])
         self.target_params = jax.tree.map(jnp.asarray, weights["target"])
+
+
+class CRRConfig:
+    def __init__(self):
+        self.obs_dim = CartPoleEnv.observation_dim
+        self.num_actions = CartPoleEnv.num_actions
+        self.lr = 1e-3
+        self.gamma = 0.99
+        self.weight_type = "exp"  # "exp" | "binary" (paper's f variants)
+        self.beta = 1.0           # exp weight temperature
+        self.target_update_freq = 8
+        self.train_batch_size = 256
+        self.dataset: Optional[Dict[str, np.ndarray]] = None
+        self.seed = 0
+
+    def offline_data(self, dataset) -> "CRRConfig":
+        self.dataset = dataset
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self):
+        return CRR({"offline_config": self})
+
+
+class CRR(_OfflineBase):
+    """Critic-Regularized Regression (Wang et al. 2020; reference
+    rllib/algorithms/crr): a Q critic trained by expected-SARSA TD under the
+    learned policy, and a policy trained by advantage-weighted BC with
+    weight f(A) = exp(A/beta) or 1[A>0], where
+    A(s,a) = Q(s,a) - E_{a'~pi}Q(s,a')."""
+
+    @staticmethod
+    def _default_config():
+        return CRRConfig()
+
+    def _build_learner(self) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.cfg
+        rng = np.random.default_rng(cfg.seed)
+        hidden = (64, 64)
+        self.params = {
+            "pi": init_mlp(rng, (cfg.obs_dim, *hidden, cfg.num_actions),
+                           final_scale=0.01),
+            "q": init_mlp(rng, (cfg.obs_dim, *hidden, cfg.num_actions),
+                          final_scale=np.sqrt(2.0 / hidden[-1])),
+        }
+        self.target_q = jax.tree.map(np.copy, self.params["q"])
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        gamma, beta, wtype = cfg.gamma, cfg.beta, cfg.weight_type
+
+        def loss_fn(params, target_q, batch):
+            acts = batch["actions"][:, None].astype(jnp.int32)
+            q = mlp_forward(params["q"], batch["obs"], 3)
+            q_taken = jnp.take_along_axis(q, acts, axis=-1)[:, 0]
+            # expected-SARSA backup under the current policy
+            next_logits = mlp_forward(params["pi"], batch["next_obs"], 3)
+            next_pi = jax.nn.softmax(jax.lax.stop_gradient(next_logits))
+            next_q = mlp_forward(target_q, batch["next_obs"], 3)
+            backup = jax.lax.stop_gradient(
+                batch["rewards"] + gamma * (1 - batch["dones"])
+                * (next_pi * next_q).sum(-1))
+            td = ((q_taken - backup) ** 2).mean()
+
+            logits = mlp_forward(params["pi"], batch["obs"], 3)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(logp_all, acts, axis=-1)[:, 0]
+            pi = jax.nn.softmax(jax.lax.stop_gradient(logits))
+            adv = jax.lax.stop_gradient(
+                q_taken - (pi * jax.lax.stop_gradient(q)).sum(-1))
+            weight = (jnp.where(adv > 0, 1.0, 0.0) if wtype == "binary"
+                      else jnp.minimum(jnp.exp(adv / beta), 20.0))
+            bc = -(weight * logp).mean()
+            total = td + bc
+            return total, {"td_loss": td, "crr_bc_loss": bc,
+                           "mean_weight": weight.mean()}
+
+        def update(params, opt_state, target_q, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_q, batch)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        self._update = jax.jit(update)
+        self._step_count = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        aux = {}
+        n = 0
+        for mb in self._minibatches():
+            self.params, self.opt_state, aux = self._update(
+                self.params, self.opt_state, self.target_q, mb)
+            self._step_count += 1
+            if self._step_count % self.cfg.target_update_freq == 0:
+                self.target_q = jax.tree.map(
+                    lambda v: v.copy(), self.params["q"])
+            n += len(mb["obs"])
+        out = {k: float(v) for k, v in jax.device_get(aux).items()}
+        out["num_samples_trained"] = n
+        return out
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        import jax
+
+        p = jax.tree.map(np.asarray, jax.device_get(self.params["pi"]))
+        return np.asarray(mlp_forward(p, obs, 3)).argmax(-1)
+
+    def get_weights(self):
+        import jax
+
+        return {"params": jax.tree.map(np.asarray,
+                                       jax.device_get(self.params)),
+                "target_q": jax.tree.map(np.asarray,
+                                         jax.device_get(self.target_q))}
+
+    def set_weights(self, weights) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, weights["params"])
+        self.target_q = jax.tree.map(jnp.asarray, weights["target_q"])

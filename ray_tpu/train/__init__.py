@@ -2,3 +2,5 @@ from ray_tpu.train.step import TrainState, make_train_step, make_init_fn, batch_
 from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
 from ray_tpu.train.checkpointing import abstract_like, restore_sharded, save_sharded
+from ray_tpu.train.sklearn import SklearnPredictor, SklearnTrainer
+from ray_tpu.train.huggingface import TransformersTrainer

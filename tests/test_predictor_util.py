@@ -64,3 +64,30 @@ def test_joblib_backend(ray_start_regular):
         out = joblib.Parallel()(joblib.delayed(lambda x: x * 10)(i)
                                 for i in range(6))
     assert out == [0, 10, 20, 30, 40, 50]
+
+
+def test_sklearn_trainer_and_predictor(ray_start_regular):
+    from sklearn.linear_model import LogisticRegression
+
+    from ray_tpu import data as rt_data
+    from ray_tpu.train import SklearnPredictor, SklearnTrainer
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(80, 3)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    rows = [{"a": X[i, 0], "b": X[i, 1], "c": X[i, 2], "label": int(y[i])}
+            for i in range(80)]
+    train_ds = rt_data.from_items(rows[:60])
+    valid_ds = rt_data.from_items(rows[60:])
+
+    trainer = SklearnTrainer(
+        estimator=LogisticRegression(), label_column="label",
+        datasets={"train": train_ds, "valid": valid_ds}, cv=3)
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["train/score"] > 0.8
+    assert "valid/score" in result.metrics and "cv/mean_score" in result.metrics
+
+    pred = SklearnPredictor.from_checkpoint(result.checkpoint)
+    out = pred.predict({"a": X[:5, 0], "b": X[:5, 1], "c": X[:5, 2]})
+    assert out["predictions"].shape == (5,)

@@ -224,3 +224,47 @@ def test_elastic_restart_resumes_from_checkpoint(ray_start_regular, tmp_path):
     # checkpointed step 3 rather than restarting from 0
     steps = [m["step"] for m in result.metrics_history]
     assert steps == [3, 4, 5], steps
+
+
+@pytest.mark.slow
+def test_transformers_trainer_ddp(ray_start_regular):
+    """TransformersTrainer (reference huggingface_trainer.py): HF Trainer
+    runs inside the gloo-grouped worker actors on Datastream shards; logs
+    flow through session.report and rank 0 checkpoints the model."""
+    from ray_tpu import data as rt_data
+    from ray_tpu.air import ScalingConfig
+    from ray_tpu.train.huggingface import TransformersTrainer
+
+    def trainer_init(train_dataset, eval_dataset, **config):
+        import torch
+        import transformers
+
+        cfg = transformers.GPT2Config(
+            vocab_size=128, n_positions=32, n_embd=32, n_layer=2, n_head=2)
+        model = transformers.GPT2LMHeadModel(cfg)
+
+        def collate(rows):
+            ids = torch.tensor(np.stack([r["input_ids"] for r in rows]),
+                               dtype=torch.long)
+            return {"input_ids": ids, "labels": ids}
+
+        args = transformers.TrainingArguments(
+            output_dir="/tmp/hf_out_test", per_device_train_batch_size=4,
+            max_steps=4, logging_steps=2, report_to=[], use_cpu=True,
+            save_strategy="no")
+        return transformers.Trainer(model=model, args=args,
+                                    train_dataset=train_dataset,
+                                    data_collator=collate)
+
+    rng = np.random.default_rng(0)
+    ds = rt_data.from_items(
+        [{"input_ids": rng.integers(0, 128, 32).astype(np.int64)}
+         for _ in range(48)])
+    trainer = TransformersTrainer(
+        trainer_init, datasets={"train": ds},
+        scaling_config=ScalingConfig(num_workers=2))
+    result = trainer.fit()
+    assert result.error is None
+    assert np.isfinite(result.metrics["train_loss"])
+    state_dict = result.checkpoint.to_dict()["state_dict"]
+    assert any("wte" in k for k in state_dict)
