@@ -92,7 +92,23 @@ def main(argv=None) -> int:
     p_job_list = job_sub.add_parser("list")
     p_job_list.add_argument("--address", required=True)
 
+    p_metrics = sub.add_parser("metrics", help="observability tooling")
+    metrics_sub = p_metrics.add_subparsers(dest="metrics_cmd", required=True)
+    p_mx = metrics_sub.add_parser(
+        "export-dashboards",
+        help="write Grafana dashboard JSON for provisioning")
+    p_mx.add_argument("--out-dir", default="./grafana_dashboards")
+    p_mx.add_argument("--which", nargs="*", default=None,
+                      choices=["core", "train", "serve"])
+
     args = parser.parse_args(argv)
+
+    if args.cmd == "metrics":
+        from ray_tpu.grafana import export_dashboards
+
+        for path in export_dashboards(args.out_dir, args.which):
+            print(f"wrote {path}")
+        return 0
 
     if args.cmd == "status":
         rt = _connect(args.address)

@@ -19,6 +19,59 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
 
+_cluster_gauges = {}
+
+
+def _update_cluster_gauges() -> None:
+    """Refresh the framework-level gauges the Grafana dashboards query
+    (`ray_tpu/grafana.py`) from control-plane state, per /metrics scrape."""
+    from ray_tpu import state as state_api
+    from ray_tpu.core import api as core_api
+    from ray_tpu.util.metrics import Gauge
+
+    g = _cluster_gauges
+    if not g:
+        g["nodes"] = Gauge("ray_tpu_nodes_alive", "alive nodes")
+        g["actors"] = Gauge("ray_tpu_actors_alive", "alive actors")
+        g["tasks_pending"] = Gauge(
+            "ray_tpu_tasks_pending", "tasks not yet finished")
+        g["tasks_finished"] = Gauge(
+            "ray_tpu_tasks_finished_total", "finished tasks (cumulative)")
+        g["store_used"] = Gauge(
+            "ray_tpu_object_store_used_bytes", "local store used bytes")
+        g["store_capacity"] = Gauge(
+            "ray_tpu_object_store_capacity_bytes", "local store capacity")
+        g["store_spilled"] = Gauge(
+            "ray_tpu_object_store_spilled_objects", "objects spilled to disk")
+    try:
+        nodes = state_api.list_nodes()
+        g["nodes"].set(float(sum(1 for n in nodes if n.get("alive"))))
+        actors = state_api.list_actors()
+        g["actors"].set(float(
+            sum(1 for a in actors if a.get("state") == "ALIVE")))
+        tasks = state_api.list_tasks()
+        finished = sum(1 for t in tasks
+                       if t.get("state") in ("FINISHED", "FAILED"))
+        g["tasks_finished"].set(float(finished))
+        g["tasks_pending"].set(float(len(tasks) - finished))
+    except Exception:
+        pass
+    try:
+        worker = core_api._global_worker()
+        stats = worker.raylet.call("object_store_stats", timeout=5)
+        g["store_used"].set(float(stats.get("used_bytes", 0)))
+        g["store_capacity"].set(float(stats.get("capacity_bytes", 0)))
+        g["store_spilled"].set(float(stats.get("num_spilled", 0)))
+    except Exception:
+        pass
+    try:
+        from ray_tpu.serve import api as serve_api
+
+        serve_api._update_serve_gauges()
+    except Exception:
+        pass
+
+
 def start_dashboard(port: int = 0) -> Tuple[ThreadingHTTPServer, int]:
     """Serve dashboard endpoints from this (driver) process; returns port."""
     from ray_tpu import state as state_api
@@ -45,6 +98,7 @@ def start_dashboard(port: int = 0) -> Tuple[ThreadingHTTPServer, int]:
                         "available": core_api.available_resources(),
                     }), "application/json"
                 elif self.path == "/metrics":
+                    _update_cluster_gauges()
                     body, ctype = metrics_mod.export_prometheus(), "text/plain"
                 elif self.path == "/timeline":
                     body, ctype = json.dumps(

@@ -1,4 +1,5 @@
 from ray_tpu.data.datastream import (
+    ActorPoolStrategy,
     Datastream,
     Dataset,
     DataIterator,

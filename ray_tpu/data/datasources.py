@@ -85,9 +85,12 @@ def read_sql(sql: str, connection_factory: Callable[[], Any], *,
 
     remote_fetch = ray_tpu.remote(fetch)
     if shard_column and parallelism > 1:
+        # normalize negatives ((x % n + n) % n) and NULLs (shard 0) so no
+        # row can fall outside every shard
+        c, n = shard_column, parallelism
+        shard_expr = f"COALESCE((({c} % {n}) + {n}) % {n}, 0)"
         queries = [
-            f"SELECT * FROM ({sql}) AS _rt_shard "
-            f"WHERE ({shard_column} % {parallelism}) = {i}"
+            f"SELECT * FROM ({sql}) AS _rt_shard WHERE {shard_expr} = {i}"
             for i in builtins.range(parallelism)]
     else:
         queries = [sql]

@@ -121,9 +121,58 @@ class Datastream:
     def map(self, fn: Callable[[Any], Any]) -> "Datastream":
         return Datastream(self._block_refs, self._ops + [("map", fn)])
 
-    def map_batches(self, fn: Callable[[Block], Block], *,
-                    batch_format: str = "numpy") -> "Datastream":
+    def map_batches(self, fn, *,
+                    batch_format: str = "numpy",
+                    compute: Optional["ActorPoolStrategy"] = None,
+                    fn_constructor_args: tuple = (),
+                    **_ignored) -> "Datastream":
+        """Per-block transform. `fn` may be a callable (task compute, lazy)
+        or a class (stateful UDF) with `compute=ActorPoolStrategy(...)` —
+        then a pool of actors is created, each constructing the class once
+        and streaming blocks through `__call__` (reference
+        actor_pool_map_operator.py)."""
+        if compute is not None or isinstance(fn, type):
+            if not isinstance(fn, type):
+                raise ValueError(
+                    "compute=ActorPoolStrategy requires a class UDF")
+            compute = compute or ActorPoolStrategy()
+            return self._map_batches_actors(
+                fn, compute, fn_constructor_args)
         return Datastream(self._block_refs, self._ops + [("map_batches", fn)])
+
+    def _map_batches_actors(self, fn_cls: type,
+                            compute: "ActorPoolStrategy",
+                            ctor_args: tuple) -> "Datastream":
+        """Eagerly runs this stage (with all pending lazy ops) through a
+        pool of stateful actors; returns a new lazy Datastream over the
+        result blocks."""
+        n_actors = max(1, min(compute.max_size, len(self._block_refs)))
+
+        @ray_tpu.remote
+        class _MapWorker:
+            def __init__(self, ops, args):
+                self._ops = ops
+                self._udf = fn_cls(*args)
+
+            def apply(self, block) -> Block:
+                block = _apply_ops(block, self._ops)
+                if isinstance(block, list):
+                    return self._udf(_rows_to_block(block))
+                return self._udf(block)
+
+        actors = [_MapWorker.options(**compute.actor_options).remote(
+            self._ops, ctor_args) for _ in builtins.range(n_actors)]
+        refs = [actors[i % n_actors].apply.remote(r)
+                for i, r in enumerate(self._block_refs)]
+        # block until all results are in the store (the driver owns them and
+        # they outlive the pool), but never pull them through the driver
+        ray_tpu.wait(refs, num_returns=len(refs))
+        for a in actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        return Datastream(refs)
 
     def flat_map(self, fn: Callable[[Any], Iterable[Any]]) -> "Datastream":
         return Datastream(self._block_refs, self._ops + [("flat_map", fn)])
@@ -435,6 +484,19 @@ class Datastream:
 
 
 Dataset = Datastream  # the reference renamed Dataset->Datastream in this era
+
+
+class ActorPoolStrategy:
+    """Actor compute for stateful map_batches UDFs (reference
+    python/ray/data/_internal/compute.py ActorPoolStrategy). `actor_options`
+    pass through to `.options()` — e.g. {"resources": {"TPU": 1}} pins each
+    pool member to a chip."""
+
+    def __init__(self, min_size: int = 1, max_size: int = 4,
+                 actor_options: Optional[Dict[str, Any]] = None):
+        self.min_size = min_size
+        self.max_size = max(min_size, max_size)
+        self.actor_options = dict(actor_options or {})
 
 
 def _block_col(block: Block, col: str) -> Optional[np.ndarray]:

@@ -107,25 +107,20 @@ def _dt_forward(params, rtg, obs, actions, timesteps, pad_mask,
 def _split_episodes(dataset: Dict[str, np.ndarray]) -> List[Dict[str, np.ndarray]]:
     """Columnar transitions (offline.collect_episodes format) -> episode
     list with per-step return-to-go."""
-    ends = np.flatnonzero(dataset["dones"] > 0.5)
+    n = len(dataset["dones"])
+    bounds = (np.flatnonzero(dataset["dones"] > 0.5) + 1).tolist()
+    if not bounds or bounds[-1] != n:  # trailing truncated episode
+        bounds.append(n)
     episodes, start = [], 0
-    for end in ends:
-        sl = slice(start, end + 1)
+    for end in bounds:
+        sl = slice(start, end)
         rew = dataset["rewards"][sl]
         episodes.append({
             "obs": dataset["obs"][sl],
             "actions": dataset["actions"][sl],
             "rtg": np.cumsum(rew[::-1])[::-1].astype(np.float32),
         })
-        start = end + 1
-    if start < len(dataset["dones"]):  # trailing truncated episode
-        sl = slice(start, len(dataset["dones"]))
-        rew = dataset["rewards"][sl]
-        episodes.append({
-            "obs": dataset["obs"][sl],
-            "actions": dataset["actions"][sl],
-            "rtg": np.cumsum(rew[::-1])[::-1].astype(np.float32),
-        })
+        start = end
     return episodes
 
 

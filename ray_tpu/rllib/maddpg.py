@@ -96,6 +96,8 @@ class MADDPGConfig:
 
     def training(self, **kw) -> "MADDPGConfig":
         for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown option {k!r}")
             if v is not None:
                 setattr(self, k, v)
         return self

@@ -147,6 +147,15 @@ class ServeController:
             for name, d in self._deployments.items()
         }
 
+    def metrics_snapshot(self):
+        """Per-deployment queue depth (last autoscale poll) + replica
+        counts, for the driver's Prometheus export."""
+        return {
+            name: {"replicas": len(self._replicas.get(name, [])),
+                   "queue_depth": d.get("last_queue_depth", 0)}
+            for name, d in self._deployments.items()
+        }
+
     # ----------------------------------------------------------- reconcile
     def _reconcile_loop(self):
         while not self._shutdown:
@@ -196,6 +205,7 @@ class ServeController:
         except Exception:
             return
         total = sum(qlens)
+        d["last_queue_depth"] = total
         desired = max(
             cfg.min_replicas,
             min(cfg.max_replicas,
@@ -245,6 +255,7 @@ class DeploymentHandle:
         return h
 
     def remote(self, *args, **kwargs):
+        _serve_metrics()["requests"].inc(tags={"deployment": self._name})
         with self._lock:
             replicas = list(self._replicas)
         if not replicas:
@@ -383,6 +394,49 @@ def run(target: Deployment, *, name: str = "default") -> DeploymentHandle:
     return handle
 
 
+_metrics_cache: Dict[str, Any] = {}
+
+
+def _serve_metrics() -> Dict[str, Any]:
+    """Per-process serve metric instances (lazily registered so importing
+    serve doesn't pollute the registry of processes that never serve)."""
+    if not _metrics_cache:
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        _metrics_cache.update(
+            requests=Counter("ray_tpu_serve_requests_total",
+                             "handle calls", tag_keys=("deployment",)),
+            errors=Counter("ray_tpu_serve_errors_total",
+                           "failed requests", tag_keys=("deployment",)),
+            latency=Histogram(
+                "ray_tpu_serve_latency_seconds", "request latency",
+                boundaries=(0.005, 0.02, 0.1, 0.5, 2, 10),
+                tag_keys=("deployment",)),
+            queue_depth=Gauge("ray_tpu_serve_queue_depth",
+                              "total replica queue depth",
+                              tag_keys=("deployment",)),
+            replicas=Gauge("ray_tpu_serve_replicas", "running replicas",
+                           tag_keys=("deployment",)),
+        )
+    return _metrics_cache
+
+
+def _update_serve_gauges() -> None:
+    """Pull the controller's snapshot into this process's gauges (called by
+    the dashboard on /metrics scrape)."""
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return
+    snap = ray_tpu.get(controller.metrics_snapshot.remote(), timeout=5)
+    m = _serve_metrics()
+    for name, info in snap.items():
+        m["queue_depth"].set(float(info["queue_depth"]),
+                             tags={"deployment": name})
+        m["replicas"].set(float(info["replicas"]),
+                          tags={"deployment": name})
+
+
 def status() -> Dict[str, Any]:
     """Deployment -> {target, replicas} (reference serve.status)."""
     try:
@@ -434,6 +488,7 @@ class _HTTPProxyActor:
                 from urllib.parse import urlparse
 
                 name = urlparse(self.path).path.strip("/")
+                t0 = time.monotonic()
                 try:
                     handle = proxy._handles.setdefault(
                         name, DeploymentHandle(name))
@@ -441,8 +496,12 @@ class _HTTPProxyActor:
                     data = json.dumps({"result": out}).encode()
                     self.send_response(200)
                 except Exception as e:
+                    _serve_metrics()["errors"].inc(
+                        tags={"deployment": name})
                     data = json.dumps({"error": str(e)}).encode()
                     self.send_response(500)
+                _serve_metrics()["latency"].observe(
+                    time.monotonic() - t0, tags={"deployment": name})
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()

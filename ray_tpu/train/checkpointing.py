@@ -20,13 +20,25 @@ import jax
 logger = logging.getLogger(__name__)
 
 
+_save_gauge = []
+
+
 def save_sharded(state: Any, path: str) -> str:
     """Write a (possibly sharded) pytree checkpoint; returns the path."""
+    import time
+
     import orbax.checkpoint as ocp
 
+    t0 = time.monotonic()
     path = os.path.abspath(path)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, state, force=True)
+    if not _save_gauge:
+        from ray_tpu.util.metrics import Gauge
+
+        _save_gauge.append(Gauge(
+            "ray_tpu_checkpoint_save_seconds", "last checkpoint save time"))
+    _save_gauge[0].set(time.monotonic() - t0)
     return path
 
 

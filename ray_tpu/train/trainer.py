@@ -74,6 +74,29 @@ class TrainWorker:
             air_session._set_session(None)
 
 
+_train_gauges: Dict[str, Any] = {}
+
+# reported-metric key -> exported Prometheus series (ray_tpu/grafana.py
+# train dashboard panels)
+_TRAIN_GAUGE_KEYS = {
+    "loss": "ray_tpu_train_loss",
+    "tokens_per_sec": "ray_tpu_train_tokens_per_sec",
+    "step_time_s": "ray_tpu_train_step_seconds",
+    "mfu": "ray_tpu_train_mfu",
+}
+
+
+def _update_train_gauges(metrics: Dict[str, Any]) -> None:
+    from ray_tpu.util.metrics import Gauge
+
+    for key, series in _TRAIN_GAUGE_KEYS.items():
+        v = metrics.get(key)
+        if isinstance(v, (int, float)):
+            if series not in _train_gauges:
+                _train_gauges[series] = Gauge(series, f"train {key}")
+            _train_gauges[series].set(float(v))
+
+
 def _takes_arg(fn: Callable) -> bool:
     import inspect
 
@@ -177,6 +200,7 @@ class DataParallelTrainer:
             for entry in queue.get_batch(1000):
                 if "metrics" in entry and entry["rank"] == 0:
                     history.append(entry["metrics"])
+                    _update_train_gauges(entry["metrics"])
                 if "checkpoint" in entry:
                     latest_ckpt = entry["checkpoint"]
                     score = None

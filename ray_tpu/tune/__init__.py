@@ -1,10 +1,10 @@
 from ray_tpu.tune.search import choice, grid_search, loguniform, randint, uniform
 from ray_tpu.tune.schedulers import (
-    ASHAScheduler, FIFOScheduler, HyperBandScheduler, MedianStoppingRule,
-    PB2, PopulationBasedTraining)
+    ASHAScheduler, BOHBScheduler, FIFOScheduler, HyperBandScheduler,
+    MedianStoppingRule, PB2, PopulationBasedTraining)
 from ray_tpu.tune.searchers import (
     BayesOptSearcher, ConcurrencyLimiter, RandomSearcher, Searcher,
-    TPESearcher)
+    TPESearcher, TuneBOHB)
 from ray_tpu.tune.tuner import TuneConfig, Tuner, ResultGrid
 from ray_tpu.tune.session import report, get_checkpoint
 
@@ -14,5 +14,5 @@ __all__ = [
     "FIFOScheduler", "ASHAScheduler", "HyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining", "PB2",
     "Searcher", "RandomSearcher", "TPESearcher", "BayesOptSearcher",
-    "ConcurrencyLimiter",
+    "ConcurrencyLimiter", "TuneBOHB", "BOHBScheduler",
 ]

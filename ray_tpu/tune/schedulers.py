@@ -289,3 +289,12 @@ class PB2(PopulationBasedTraining):
         for i, (k, (lo, hi)) in enumerate(self.bounds.items()):
             config[k] = type(config.get(k, lo))(lo + best[i] * (hi - lo))
         return config
+
+
+class BOHBScheduler(HyperBandScheduler):
+    """HyperBand bracket allocation for BOHB (reference
+    `schedulers/hb_bohb.py HyperBandForBOHB`): identical rung/halting
+    mechanics; the model-based half lives in `searchers.TuneBOHB`, which
+    fits its TPE on the highest budget with enough completed results — the
+    combination reproduces BOHB's behavior under this framework's
+    asynchronous trial runner."""

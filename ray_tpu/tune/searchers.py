@@ -258,3 +258,42 @@ class BayesOptSearcher(_HistorySearcher):
         for i, k in enumerate(self._num_keys):
             cfg[k] = _from_unit(float(x[i]), self.space[k])
         return cfg
+
+
+class TuneBOHB(TPESearcher):
+    """BOHB's model component (Falkner et al. 2018; reference
+    `python/ray/tune/search/bohb/`): a TPE fit only on results from the
+    LARGEST budget that has enough observations, falling back to pooled
+    history before that. Pair with `BOHBScheduler` (HyperBand brackets) for
+    the full algorithm — the scheduler allocates budgets, this model picks
+    configs."""
+
+    def __init__(self, space, metric="score", mode="max", n_startup=8,
+                 budget_attr: str = "training_iteration",
+                 min_points: Optional[int] = None, **kw):
+        self._full_history: List[Tuple[Dict[str, Any], float, float]] = []
+        self._budget_attr = budget_attr
+        self._min_points = min_points or max(len(space) + 1, 4)
+        super().__init__(space, metric, mode, n_startup, **kw)
+
+    def on_trial_complete(self, trial_id, result) -> None:
+        cfg = self._pending.pop(trial_id, None)
+        if cfg is None or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        if math.isfinite(score):
+            budget = float(result.get(self._budget_attr, 1))
+            self._full_history.append((cfg, score, budget))
+        self._refresh()
+
+    def _refresh(self) -> None:
+        by_budget: Dict[float, List[Tuple[Dict[str, Any], float]]] = {}
+        for cfg, score, b in self._full_history:
+            by_budget.setdefault(b, []).append((cfg, score))
+        for b in sorted(by_budget, reverse=True):
+            if len(by_budget[b]) >= self._min_points:
+                self._history = by_budget[b]
+                return
+        self._history = [(c, s) for c, s, _ in self._full_history]

@@ -138,6 +138,18 @@ class ResultGrid:
         return [r.error for r in self._results if r.error]
 
 
+_gauge_cache: Dict[str, Any] = {}
+
+
+def _trials_running_gauge():
+    if "g" not in _gauge_cache:
+        from ray_tpu.util.metrics import Gauge
+
+        _gauge_cache["g"] = Gauge(
+            "ray_tpu_tune_trials_running", "trials currently running")
+    return _gauge_cache["g"]
+
+
 class TrialRunner:
     def __init__(self, fn: Callable, configs: List[Dict[str, Any]],
                  tune_config: TuneConfig):
@@ -210,6 +222,7 @@ class TrialRunner:
             self._maybe_suggest_trials()
             running = [t for t in self.trials if t.state == "RUNNING"]
             pending = [t for t in self.trials if t.state == "PENDING"]
+            _trials_running_gauge().set(float(len(running)))
             if not running and not pending:
                 if (self.searcher is not None
                         and len(self.trials) < self._target

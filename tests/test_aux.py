@@ -131,3 +131,38 @@ def test_cli_start_daemon_and_connect(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_metrics_scrape_exports_dashboard_series(ray_start_regular):
+    """Every core-dashboard panel (ray_tpu/grafana.py) must be backed by a
+    series the /metrics scrape actually exports — panels may not reference
+    phantom metrics."""
+    import re
+
+    from ray_tpu.dashboard import start_dashboard
+    from ray_tpu.grafana import generate_default_dashboard
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get([noop.remote() for _ in range(3)])
+
+    server, port = start_dashboard()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+            text = r.read().decode()
+    finally:
+        server.shutdown()
+
+    for panel in generate_default_dashboard()["panels"]:
+        for target in panel["targets"]:
+            for series in re.findall(r"ray_tpu_[a-z_]+", target["expr"]):
+                assert series in text, (panel["title"], series)
+
+    # live values reflect cluster state
+    m = dict(re.findall(r"^(ray_tpu_[a-z_]+) ([0-9.e+-]+)$", text,
+                        re.MULTILINE))
+    assert float(m["ray_tpu_nodes_alive"]) == 1.0
+    assert float(m["ray_tpu_tasks_finished_total"]) >= 3.0

@@ -149,3 +149,34 @@ def test_async_actor_loop_persists_across_calls(ray_start_regular):
     assert ray_tpu.get(a.setup.remote(), timeout=60)
     assert ray_tpu.get(a.use.remote(), timeout=60) == 1
     ray_tpu.kill(a)
+
+
+def test_grafana_dashboard_factory(tmp_path):
+    """Dashboard JSON factory (reference grafana_dashboard_factory.py):
+    valid Grafana schema, panels target the exported Prometheus names."""
+    import json
+
+    from ray_tpu.grafana import export_dashboards, generate_default_dashboard
+
+    dash = generate_default_dashboard()
+    assert dash["uid"] == "ray-tpu-core"
+    assert all(p["type"] == "timeseries" for p in dash["panels"])
+    exprs = [t["expr"] for p in dash["panels"] for t in p["targets"]]
+    assert any("ray_tpu_object_store_used_bytes" in e for e in exprs)
+
+    paths = export_dashboards(str(tmp_path))
+    assert len(paths) == 3
+    for p in paths:
+        loaded = json.load(open(p))
+        assert loaded["panels"], p
+
+
+def test_cli_metrics_export_dashboards(tmp_path):
+    from ray_tpu.__main__ import main
+
+    out = str(tmp_path / "dash")
+    assert main(["metrics", "export-dashboards", "--out-dir", out,
+                 "--which", "train"]) == 0
+    import os
+
+    assert os.path.exists(os.path.join(out, "ray_tpu_train.json"))

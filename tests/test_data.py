@@ -82,3 +82,40 @@ def test_from_numpy_schema(ray_start_regular):
     ds = rd.from_numpy({"x": np.arange(10, dtype=np.float32)})
     schema = ds.schema()
     assert schema["x"] == np.float32
+
+
+def test_map_batches_actor_pool(ray_start_regular):
+    """Stateful class UDF over an actor pool (reference
+    actor_pool_map_operator.py): constructor runs once per pool member, not
+    per block."""
+    from ray_tpu.data import ActorPoolStrategy
+
+    class AddBias:
+        def __init__(self, bias):
+            self.bias = bias
+            self.calls = 0
+
+        def __call__(self, block):
+            self.calls += 1
+            return {"x": block["x"] + self.bias}
+
+    ds = rd.from_numpy({"x": np.arange(12.0)}, parallelism=4)
+    out = ds.map_batches(AddBias, compute=ActorPoolStrategy(max_size=2),
+                         fn_constructor_args=(100.0,))
+    vals = sorted(r["x"] for r in out.take_all())
+    assert vals == [100.0 + i for i in range(12)]
+    # chains like a normal lazy stream afterwards
+    assert out.map_batches(lambda b: {"x": b["x"] * 0}).sum("x") == 0
+
+
+def test_map_batches_class_requires_strategy_or_defaults(ray_start_regular):
+    from ray_tpu.data import ActorPoolStrategy
+
+    class Ident:
+        def __call__(self, block):
+            return block
+
+    ds = rd.range(8, parallelism=2)
+    assert ds.map_batches(Ident).count() == 8
+    with pytest.raises(ValueError):
+        ds.map_batches(lambda b: b, compute=ActorPoolStrategy())

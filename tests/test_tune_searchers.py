@@ -122,3 +122,58 @@ def test_hyperband_brackets_assign_round_robin():
     assert assigned == [0, 1, 2, 0, 1, 2]
     # staggered grace periods: 1, 3, 9
     assert [b.rungs[0] for b in hb.brackets] == [1, 3, 9]
+
+
+def test_bohb_searcher_prefers_high_budget_evidence():
+    """TuneBOHB (reference search/bohb): the TPE must fit on the largest
+    budget with enough points — noisy low-budget scores that mislead toward
+    x~0.2 are ignored once enough high-budget results (truth: x~0.7) exist."""
+    space = {"x": tune.uniform(0.0, 1.0)}
+    bohb = tune.TuneBOHB(space, metric="score", mode="max",
+                         n_startup=4, min_points=5, seed=0)
+    rng = np.random.default_rng(3)
+    # low-budget phase: score peaks at x=0.2 (misleading proxy); configs
+    # spread over the space as HyperBand's random bracket entries would be
+    for i in range(10):
+        x = float(rng.uniform())
+        bohb._pending[f"lo{i}"] = {"x": x}
+        bohb.on_trial_complete(
+            f"lo{i}", {"score": 1.0 - (x - 0.2) ** 2,
+                       "training_iteration": 1})
+    # high-budget phase: truth peaks at x=0.7
+    for i in range(12):
+        x = float(rng.uniform())
+        bohb._pending[f"hi{i}"] = {"x": x}
+        bohb.on_trial_complete(
+            f"hi{i}", {"score": _quadratic(x),
+                       "training_iteration": 9})
+    picks = [bohb.suggest(f"p{i}")["x"] for i in range(8)]
+    # model-based picks should cluster at the high-budget optimum
+    near_hi = sum(abs(x - 0.7) < 0.25 for x in picks)
+    near_lo = sum(abs(x - 0.2) < 0.15 for x in picks)
+    assert near_hi > near_lo, picks
+
+
+def test_bohb_with_hyperband_scheduler_end_to_end(ray_start_regular):
+    """Full BOHB: TuneBOHB searcher + BOHBScheduler brackets inside the
+    Tuner; converges on the quadratic and keeps the Trainable contract."""
+    def trainable(config):
+        for step in range(1, 6):
+            tune.report({"score": (1.0 - (config["x"] - 0.7) ** 2) * step / 5,
+                         "training_iteration": step})
+
+    space = {"x": tune.uniform(0.0, 1.0)}
+    tuner = tune.Tuner(
+        trainable,
+        param_space=space,
+        tune_config=tune.TuneConfig(
+            num_samples=12,
+            search_alg=tune.TuneBOHB(space, metric="score", mode="max",
+                                   n_startup=4, seed=2),
+            scheduler=tune.BOHBScheduler(metric="score", mode="max",
+                                         max_t=5, reduction_factor=3,
+                                         num_brackets=2),
+        ))
+    grid = tuner.fit()
+    best = grid.get_best_result(metric="score", mode="max")
+    assert best.metrics["score"] > 0.6
