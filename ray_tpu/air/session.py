@@ -42,6 +42,16 @@ def _get_session() -> _Session:
 
 
 def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None) -> None:
+    # piggy-back system metrics recorded worker-side since the last report
+    # (checkpoint save time — the driver exports them as gauges)
+    try:
+        from ray_tpu.train.checkpointing import pop_last_save_seconds
+
+        save_s = pop_last_save_seconds()
+        if save_s is not None and "checkpoint_save_seconds" not in metrics:
+            metrics = {**metrics, "checkpoint_save_seconds": save_s}
+    except ImportError:
+        pass
     _get_session().report_fn(metrics, checkpoint)
 
 

@@ -85,6 +85,7 @@ class GcsServer:
         self._task_events: Dict[bytes, dict] = {}
         self._task_events_order: List[bytes] = []
         self._max_task_events = 10000
+        self._task_counts = {"submitted": 0, "finished": 0, "failed": 0}
 
         # pubsub: channel -> list[ServerConnection]
         self._subs: Dict[str, List[rpc.ServerConnection]] = {}
@@ -417,9 +418,16 @@ class GcsServer:
                 e = {"task_id": key}
                 self._task_events[key] = e
                 self._task_events_order.append(key)
+                self._task_counts["submitted"] += 1
             e.update({k: v for k, v in payload.items() if k != "task_id"})
             e.setdefault("events", []).append(
                 (payload.get("state", "?"), time.time()))
+            # running totals survive the event-window eviction above (the
+            # dashboard's _total series must not saturate at the window)
+            state = payload.get("state")
+            if state in ("FINISHED", "FAILED") and not e.get("_terminal"):
+                e["_terminal"] = True
+                self._task_counts[state.lower()] += 1
         return True
 
     def rpc_list_task_events(self, conn, req_id, payload):
@@ -427,6 +435,13 @@ class GcsServer:
         with self._lock:
             keys = self._task_events_order[-limit:]
             return [dict(self._task_events[k]) for k in keys]
+
+    def rpc_task_counts(self, conn, req_id, payload):
+        """Cumulative task totals (unwindowed, unlike list_task_events)."""
+        with self._lock:
+            c = dict(self._task_counts)
+        c["pending"] = max(0, c["submitted"] - c["finished"] - c["failed"])
+        return c
 
     # ---------------------------------------------------------------- actors
     def rpc_register_actor(self, conn, req_id, payload):

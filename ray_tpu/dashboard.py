@@ -49,11 +49,11 @@ def _update_cluster_gauges() -> None:
         actors = state_api.list_actors()
         g["actors"].set(float(
             sum(1 for a in actors if a.get("state") == "ALIVE")))
-        tasks = state_api.list_tasks()
-        finished = sum(1 for t in tasks
-                       if t.get("state") in ("FINISHED", "FAILED"))
-        g["tasks_finished"].set(float(finished))
-        g["tasks_pending"].set(float(len(tasks) - finished))
+        # cumulative GCS counters, NOT the windowed task-event list — the
+        # _total series must keep increasing past the event window
+        counts = core_api._global_worker().gcs.call("task_counts", timeout=5)
+        g["tasks_finished"].set(float(counts["finished"] + counts["failed"]))
+        g["tasks_pending"].set(float(counts["pending"]))
     except Exception:
         pass
     try:

@@ -110,6 +110,18 @@ def _exec_block(block_or_ref, ops: List[tuple]) -> Block:
     return _apply_ops(block_or_ref, ops)
 
 
+def _apply_batched(fn, batch_size: int, block: Block) -> Block:
+    """Slice a block into <=batch_size row batches, apply fn, re-concat."""
+    if isinstance(block, list):
+        block = _rows_to_block(block)
+    n = _block_len(block)
+    if n <= batch_size:
+        return fn(block)
+    outs = [fn(_slice_block(block, i, min(i + batch_size, n)))
+            for i in builtins.range(0, n, batch_size)]
+    return _concat_blocks(outs)
+
+
 class Datastream:
     """A lazy, distributed dataset. (alias: Dataset)"""
 
@@ -123,13 +135,15 @@ class Datastream:
 
     def map_batches(self, fn, *,
                     batch_format: str = "numpy",
+                    batch_size: Optional[int] = None,
                     compute: Optional["ActorPoolStrategy"] = None,
-                    fn_constructor_args: tuple = (),
-                    **_ignored) -> "Datastream":
-        """Per-block transform. `fn` may be a callable (task compute, lazy)
-        or a class (stateful UDF) with `compute=ActorPoolStrategy(...)` —
-        then a pool of actors is created, each constructing the class once
-        and streaming blocks through `__call__` (reference
+                    fn_constructor_args: tuple = ()) -> "Datastream":
+        """Per-batch transform. Without `batch_size` each block is one
+        batch; with it, blocks are re-sliced so `fn` sees at most
+        `batch_size` rows per call. `fn` may be a callable (task compute,
+        lazy) or a class (stateful UDF) with `compute=ActorPoolStrategy(...)`
+        — then a pool of actors is created, each constructing the class once
+        and streaming batches through `__call__` (reference
         actor_pool_map_operator.py)."""
         if compute is not None or isinstance(fn, type):
             if not isinstance(fn, type):
@@ -137,16 +151,21 @@ class Datastream:
                     "compute=ActorPoolStrategy requires a class UDF")
             compute = compute or ActorPoolStrategy()
             return self._map_batches_actors(
-                fn, compute, fn_constructor_args)
+                fn, compute, fn_constructor_args, batch_size)
+        if batch_size is not None:
+            fn = functools.partial(_apply_batched, fn, batch_size)
         return Datastream(self._block_refs, self._ops + [("map_batches", fn)])
 
     def _map_batches_actors(self, fn_cls: type,
                             compute: "ActorPoolStrategy",
-                            ctor_args: tuple) -> "Datastream":
+                            ctor_args: tuple,
+                            batch_size: Optional[int] = None) -> "Datastream":
         """Eagerly runs this stage (with all pending lazy ops) through a
         pool of stateful actors; returns a new lazy Datastream over the
         result blocks."""
-        n_actors = max(1, min(compute.max_size, len(self._block_refs)))
+        # min_size is the pre-warm floor (expensive ctors), max_size the cap
+        n_actors = max(1, min(compute.max_size,
+                              max(compute.min_size, len(self._block_refs))))
 
         @ray_tpu.remote
         class _MapWorker:
@@ -157,7 +176,9 @@ class Datastream:
             def apply(self, block) -> Block:
                 block = _apply_ops(block, self._ops)
                 if isinstance(block, list):
-                    return self._udf(_rows_to_block(block))
+                    block = _rows_to_block(block)
+                if batch_size is not None:
+                    return _apply_batched(self._udf, batch_size, block)
                 return self._udf(block)
 
         actors = [_MapWorker.options(**compute.actor_options).remote(
@@ -457,6 +478,16 @@ class Datastream:
         if carry is not None and not drop_last:
             yield carry
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           dtypes=None, device: str = "cpu",
+                           drop_last: bool = False) -> Iterator[Dict[str, Any]]:
+        """Batches as dicts of torch tensors (reference
+        `Datastream.iter_torch_batches`). Non-numeric columns pass through
+        unchanged; `dtypes` maps column -> torch dtype."""
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            yield _to_torch_batch(batch, dtypes, device)
+
     def split(self, n: int, *, equal: bool = False) -> List["Datastream"]:
         refs = self._executed_refs()
         if equal:
@@ -497,6 +528,26 @@ class ActorPoolStrategy:
         self.min_size = min_size
         self.max_size = max(min_size, max_size)
         self.actor_options = dict(actor_options or {})
+
+
+def _to_torch_batch(batch: Block, dtypes, device: str) -> Dict[str, Any]:
+    import torch
+
+    if isinstance(batch, list):
+        batch = _rows_to_block(batch)
+        if isinstance(batch, list):  # non-dict rows: single "data" column
+            batch = {"data": np.asarray(batch)}
+    out: Dict[str, Any] = {}
+    for k, v in batch.items():
+        arr = np.asarray(v)
+        if arr.dtype.kind in "biuf":
+            t = torch.as_tensor(arr)
+            if dtypes and k in dtypes:
+                t = t.to(dtypes[k])
+            out[k] = t.to(device) if device != "cpu" else t
+        else:
+            out[k] = v
+    return out
 
 
 def _block_col(block: Block, col: str) -> Optional[np.ndarray]:
@@ -733,6 +784,13 @@ class DataIterator:
                 carry = _slice_block(block, i, n)
         if carry is not None and not drop_last:
             yield carry
+
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           dtypes=None, device: str = "cpu",
+                           drop_last: bool = False) -> Iterator[Dict[str, Any]]:
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            yield _to_torch_batch(batch, dtypes, device)
 
     def iter_rows(self) -> Iterator[Any]:
         for batch in self.iter_batches(batch_size=256):

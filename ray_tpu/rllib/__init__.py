@@ -25,3 +25,6 @@ from ray_tpu.rllib.alpha_zero import (
 from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig
 from ray_tpu.rllib.dt import DT, DTConfig
 from ray_tpu.rllib.maddpg import MADDPG, MADDPGConfig, SpreadEnv
+from ray_tpu.rllib.slateq import (
+    InterestEvolutionEnv, SlateQ, SlateQConfig)
+from ray_tpu.rllib.maml import MAML, MAMLConfig, SinusoidTasks

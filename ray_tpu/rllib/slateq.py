@@ -1,0 +1,277 @@
+"""SlateQ: Q-learning for slate recommendation (Ie et al. 2019).
+
+Reference parity: rllib/algorithms/slateq/ (SURVEY §2.3 algorithm list).
+The environment is a compact interest-evolution recommender (the RecSim
+family the reference trains against): the user has a latent topic-interest
+vector, the agent slates K of N candidate docs, the user clicks via a
+conditional choice model (softmax over interest·doc, with a no-click
+option) and clicked docs pay their engagement quality and drift the
+user's interests.
+
+SlateQ's decomposition: the slate's Q-value is the choice-probability-
+weighted sum of per-item Q(s, d) — learning stays item-level (tractable)
+while acting optimizes over slates (greedy top-K by choice-score-weighted
+Q, the standard LP-relaxation shortcut). TD backup bootstraps the next
+state's greedy slate value. All updates are jitted JAX.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.models import init_mlp, mlp_forward
+from ray_tpu.rllib.replay_buffers import ReplayBuffer
+
+
+class InterestEvolutionEnv:
+    """1-step-per-slate recommender: obs = (user interests, candidate doc
+    features); action = K-doc slate (index tuple)."""
+
+    def __init__(self, seed: int = 0, n_topics: int = 4,
+                 n_candidates: int = 10, slate_size: int = 3,
+                 episode_len: int = 20, no_click_mass: float = 1.0,
+                 drift: float = 0.2):
+        self.rng = np.random.default_rng(seed)
+        self.n_topics = n_topics
+        self.n_candidates = n_candidates
+        self.slate_size = slate_size
+        self.episode_len = episode_len
+        self.no_click_mass = no_click_mass
+        self.drift = drift
+
+    def _sample_docs(self) -> np.ndarray:
+        """[N, T+1]: one-hot-ish topic mix + quality scalar."""
+        topics = self.rng.dirichlet(np.ones(self.n_topics) * 0.3,
+                                    self.n_candidates)
+        quality = self.rng.uniform(0, 1, (self.n_candidates, 1))
+        return np.concatenate([topics, quality], axis=1).astype(np.float32)
+
+    def _obs(self) -> Dict[str, np.ndarray]:
+        return {"user": self.user.copy(), "docs": self.docs.copy()}
+
+    def reset(self) -> Dict[str, np.ndarray]:
+        self.user = self.rng.dirichlet(
+            np.ones(self.n_topics)).astype(np.float32)
+        self.docs = self._sample_docs()
+        self.t = 0
+        return self._obs()
+
+    def choice_probs(self, slate: Tuple[int, ...]) -> np.ndarray:
+        """User's conditional choice over slate items + no-click (last)."""
+        scores = np.array([
+            float(self.user @ self.docs[d, :self.n_topics])
+            for d in slate] + [0.0])
+        scores[-1] = np.log(self.no_click_mass + 1e-9)
+        z = np.exp(scores - scores.max())
+        return z / z.sum()
+
+    def step(self, slate: Tuple[int, ...]):
+        probs = self.choice_probs(slate)
+        pick = self.rng.choice(len(probs), p=probs)
+        reward = 0.0
+        clicked_doc = -1
+        if pick < len(slate):  # clicked item `pick`
+            d = slate[pick]
+            clicked_doc = int(d)
+            reward = float(self.docs[d, -1])  # engagement = quality
+            topic = self.docs[d, :self.n_topics]
+            self.user = (1 - self.drift) * self.user + self.drift * topic
+            self.user = (self.user / self.user.sum()).astype(np.float32)
+        self.t += 1
+        done = self.t >= self.episode_len
+        self.docs = self._sample_docs()
+        return self._obs(), reward, done, {
+            "clicked": pick < len(slate), "doc": clicked_doc}
+
+
+class SlateQConfig:
+    def __init__(self):
+        self.n_topics = 4
+        self.n_candidates = 10
+        self.slate_size = 3
+        self.lr = 1e-3
+        self.gamma = 0.95
+        self.epsilon = 0.15
+        self.buffer_size = 50_000
+        self.batch_size = 128
+        self.warmup_steps = 300
+        self.target_update_freq = 100
+        self.episodes_per_iter = 10
+        self.updates_per_iter = 60
+        self.seed = 0
+        self.env_maker = None  # default InterestEvolutionEnv
+
+    def training(self, **kw) -> "SlateQConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown option {k!r}")
+            if v is not None:
+                setattr(self, k, v)
+        return self
+
+    def build(self) -> "SlateQ":
+        return SlateQ({"slateq_config": self})
+
+
+class SlateQ(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg: SlateQConfig = config.get("slateq_config") or SlateQConfig()
+        self.cfg = cfg
+        self.env = (cfg.env_maker(cfg.seed) if cfg.env_maker
+                    else InterestEvolutionEnv(
+                        cfg.seed, cfg.n_topics, cfg.n_candidates,
+                        cfg.slate_size))
+        rng = np.random.default_rng(cfg.seed)
+        # item Q-network: input = [user(T), doc(T+1)]
+        in_dim = cfg.n_topics + cfg.n_topics + 1
+        self.params = init_mlp(rng, (in_dim, 64, 64, 1),
+                               final_scale=np.sqrt(2.0 / 64))
+        self.target_params = {k: v.copy() for k, v in self.params.items()}
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self.rng = rng
+        self._total_steps = 0
+        self._update_count = 0
+        self._reward_history: List[float] = []
+
+        T, K = cfg.n_topics, cfg.slate_size
+        no_click = np.log(self.env.no_click_mass + 1e-9)
+        gamma = cfg.gamma
+
+        def item_q(params, user, docs):
+            # user [B,T], docs [B,N,T+1] -> [B,N]
+            B, N, _ = docs.shape
+            u = jnp.broadcast_to(user[:, None, :], (B, N, T))
+            x = jnp.concatenate([u, docs], axis=-1)
+            return mlp_forward(params, x, 3)[..., 0]
+
+        def greedy_slate_value(params, user, docs):
+            """max_slate sum_i P(i|slate) Q(i): rank by score-weighted Q
+            (LP-relaxation shortcut), evaluate the chosen top-K slate under
+            the true conditional-choice softmax."""
+            q = item_q(params, user, docs)  # [B,N]
+            scores = jnp.einsum("bt,bnt->bn", user, docs[..., :T])
+            w = jnp.exp(scores)
+            ranked = jnp.argsort(-(w * jnp.maximum(q, 0.0) + 1e-9 * q),
+                                 axis=-1)[:, :K]
+            top_scores = jnp.take_along_axis(scores, ranked, axis=1)
+            top_q = jnp.take_along_axis(q, ranked, axis=1)
+            z = jnp.concatenate(
+                [jnp.exp(top_scores),
+                 jnp.full((user.shape[0], 1), np.exp(no_click))], axis=1)
+            probs = z / z.sum(axis=1, keepdims=True)
+            return (probs[:, :K] * top_q).sum(axis=1)
+
+        self._item_q = jax.jit(item_q)
+        self._greedy_value = jax.jit(greedy_slate_value)
+
+        def loss_fn(params, target_params, batch):
+            # Q(s, clicked_doc) towards r + gamma * V_greedy(s')
+            q_all = item_q(params, batch["user"], batch["docs"])
+            q_taken = jnp.take_along_axis(
+                q_all, batch["doc_idx"][:, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            v_next = greedy_slate_value(
+                target_params, batch["next_user"], batch["next_docs"])
+            backup = jax.lax.stop_gradient(
+                batch["rewards"] + gamma * (1 - batch["dones"]) * v_next)
+            return ((q_taken - backup) ** 2).mean()
+
+        def update(params, opt_state, target_params, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, target_params, batch)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._update = jax.jit(update)
+
+    # ------------------------------------------------------------- acting
+    def _select_slate(self, obs: Dict[str, np.ndarray],
+                      epsilon: float) -> Tuple[int, ...]:
+        cfg = self.cfg
+        if self.rng.random() < epsilon:
+            return tuple(self.rng.choice(
+                cfg.n_candidates, cfg.slate_size, replace=False))
+        q = np.asarray(self._item_q(
+            self.params, obs["user"][None], obs["docs"][None]))[0]
+        scores = obs["docs"][:, :cfg.n_topics] @ obs["user"]
+        rank = np.argsort(-(np.exp(scores) * np.maximum(q, 0.0) + 1e-9 * q))
+        return tuple(int(i) for i in rank[:cfg.slate_size])
+
+    def _run_episode(self, epsilon: float, store: bool = True) -> float:
+        env = self.env
+        obs = env.reset()
+        total = 0.0
+        while True:
+            slate = self._select_slate(obs, epsilon)
+            nxt, reward, done, info = env.step(slate)
+            total += reward
+            if store:
+                # item-level SARSA on CLICKED items only (the paper's
+                # update — no-click steps carry no item-level signal)
+                if info["clicked"]:
+                    self.buffer.add_batch({
+                        "user": obs["user"][None],
+                        "docs": obs["docs"][None],
+                        "doc_idx": np.array([info["doc"]], np.int32),
+                        "rewards": np.array([reward], np.float32),
+                        "next_user": nxt["user"][None],
+                        "next_docs": nxt["docs"][None],
+                        "dones": np.array([float(done)], np.float32),
+                    })
+                self._total_steps += 1
+            obs = nxt
+            if done:
+                return total
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        returns = [self._run_episode(cfg.epsilon)
+                   for _ in range(cfg.episodes_per_iter)]
+        loss = float("nan")
+        if self._total_steps >= cfg.warmup_steps:
+            for _ in range(cfg.updates_per_iter):
+                batch = self.buffer.sample(cfg.batch_size)
+                batch = {k: jnp.asarray(v) for k, v in batch.items()}
+                self.params, self.opt_state, l = self._update(
+                    self.params, self.opt_state, self.target_params, batch)
+                loss = float(l)
+                self._update_count += 1
+                if self._update_count % cfg.target_update_freq == 0:
+                    self.target_params = {
+                        k: np.asarray(v).copy()
+                        for k, v in self.params.items()}
+        self._reward_history.extend(returns)
+        self._reward_history = self._reward_history[-100:]
+        return {"episode_reward_mean": float(np.mean(self._reward_history)),
+                "num_env_steps_sampled": self._total_steps,
+                "td_loss": loss}
+
+    def greedy_return(self, episodes: int = 10) -> float:
+        return float(np.mean([self._run_episode(0.0, store=False)
+                              for _ in range(episodes)]))
+
+    def random_baseline(self, episodes: int = 10) -> float:
+        return float(np.mean([self._run_episode(1.0, store=False)
+                              for _ in range(episodes)]))
+
+    def get_weights(self):
+        return {"params": {k: np.asarray(v)
+                           for k, v in self.params.items()},
+                "target": {k: np.asarray(v)
+                           for k, v in self.target_params.items()}}
+
+    def set_weights(self, weights) -> None:
+        self.params = dict(weights["params"])
+        self.target_params = dict(weights["target"])

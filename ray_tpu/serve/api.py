@@ -33,6 +33,7 @@ import ray_tpu
 logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "_serve_controller"
+PROXY_NAME = "_serve_http_proxy"
 
 
 @dataclass
@@ -394,36 +395,43 @@ def run(target: Deployment, *, name: str = "default") -> DeploymentHandle:
     return handle
 
 
-_metrics_cache: Dict[str, Any] = {}
-
-
 def _serve_metrics() -> Dict[str, Any]:
     """Per-process serve metric instances (lazily registered so importing
     serve doesn't pollute the registry of processes that never serve)."""
-    if not _metrics_cache:
-        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+    from ray_tpu.util.metrics import get_or_create
 
-        _metrics_cache.update(
-            requests=Counter("ray_tpu_serve_requests_total",
-                             "handle calls", tag_keys=("deployment",)),
-            errors=Counter("ray_tpu_serve_errors_total",
-                           "failed requests", tag_keys=("deployment",)),
-            latency=Histogram(
-                "ray_tpu_serve_latency_seconds", "request latency",
-                boundaries=(0.005, 0.02, 0.1, 0.5, 2, 10),
-                tag_keys=("deployment",)),
-            queue_depth=Gauge("ray_tpu_serve_queue_depth",
-                              "total replica queue depth",
-                              tag_keys=("deployment",)),
-            replicas=Gauge("ray_tpu_serve_replicas", "running replicas",
-                           tag_keys=("deployment",)),
-        )
-    return _metrics_cache
+    return {
+        "requests": get_or_create(
+            "counter", "ray_tpu_serve_requests_total", "handle calls",
+            tag_keys=("deployment",)),
+        "errors": get_or_create(
+            "counter", "ray_tpu_serve_errors_total", "failed requests",
+            tag_keys=("deployment",)),
+        "latency": get_or_create(
+            "histogram", "ray_tpu_serve_latency_seconds", "request latency",
+            boundaries=(0.005, 0.02, 0.1, 0.5, 2, 10),
+            tag_keys=("deployment",)),
+        "queue_depth": get_or_create(
+            "gauge", "ray_tpu_serve_queue_depth",
+            "total replica queue depth", tag_keys=("deployment",)),
+        "replicas": get_or_create(
+            "gauge", "ray_tpu_serve_replicas", "running replicas",
+            tag_keys=("deployment",)),
+    }
 
 
 def _update_serve_gauges() -> None:
-    """Pull the controller's snapshot into this process's gauges (called by
-    the dashboard on /metrics scrape)."""
+    """Pull serve series from the processes that own them (called by the
+    dashboard on /metrics scrape): request/error/latency live in the HTTP
+    proxy actor, queue depth + replica counts in the controller."""
+    from ray_tpu.util import metrics as metrics_mod
+
+    try:
+        proxy = ray_tpu.get_actor(PROXY_NAME)
+        metrics_mod.merge_snapshot(
+            ray_tpu.get(proxy.metrics_snapshot.remote(), timeout=5))
+    except Exception:
+        pass  # no HTTP ingress running (handle-only traffic counts locally)
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:
@@ -539,10 +547,17 @@ class _HTTPProxyActor:
     def get_port(self) -> int:
         return self.port
 
+    def metrics_snapshot(self):
+        """This proxy process's serve series, for the driver's exporter."""
+        from ray_tpu.util import metrics as metrics_mod
+
+        return metrics_mod.snapshot("ray_tpu_serve_")
+
 
 def start_http_proxy(port: int = 0):
     """Start the HTTP ingress actor; returns (actor_handle, port)."""
-    actor = _HTTPProxyActor.options(num_cpus=0, max_concurrency=8).remote(port)
+    actor = _HTTPProxyActor.options(
+        num_cpus=0, max_concurrency=8, name=PROXY_NAME).remote(port)
     return actor, ray_tpu.get(actor.get_port.remote())
 
 

@@ -20,7 +20,14 @@ import jax
 logger = logging.getLogger(__name__)
 
 
-_save_gauge = []
+_last_save_seconds: list = []
+
+
+def pop_last_save_seconds() -> Any:
+    """Most recent save_sharded duration, consumed by the next
+    session.report so the driver can export it (save runs in worker
+    processes whose metric registries the dashboard never scrapes)."""
+    return _last_save_seconds.pop() if _last_save_seconds else None
 
 
 def save_sharded(state: Any, path: str) -> str:
@@ -33,12 +40,11 @@ def save_sharded(state: Any, path: str) -> str:
     path = os.path.abspath(path)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, state, force=True)
-    if not _save_gauge:
-        from ray_tpu.util.metrics import Gauge
+    _last_save_seconds[:] = [time.monotonic() - t0]
+    from ray_tpu.util.metrics import get_or_create
 
-        _save_gauge.append(Gauge(
-            "ray_tpu_checkpoint_save_seconds", "last checkpoint save time"))
-    _save_gauge[0].set(time.monotonic() - t0)
+    get_or_create("gauge", "ray_tpu_checkpoint_save_seconds",
+                  "last checkpoint save time").set(_last_save_seconds[0])
     return path
 
 

@@ -74,8 +74,6 @@ class TrainWorker:
             air_session._set_session(None)
 
 
-_train_gauges: Dict[str, Any] = {}
-
 # reported-metric key -> exported Prometheus series (ray_tpu/grafana.py
 # train dashboard panels)
 _TRAIN_GAUGE_KEYS = {
@@ -83,18 +81,17 @@ _TRAIN_GAUGE_KEYS = {
     "tokens_per_sec": "ray_tpu_train_tokens_per_sec",
     "step_time_s": "ray_tpu_train_step_seconds",
     "mfu": "ray_tpu_train_mfu",
+    "checkpoint_save_seconds": "ray_tpu_checkpoint_save_seconds",
 }
 
 
 def _update_train_gauges(metrics: Dict[str, Any]) -> None:
-    from ray_tpu.util.metrics import Gauge
+    from ray_tpu.util.metrics import get_or_create
 
     for key, series in _TRAIN_GAUGE_KEYS.items():
         v = metrics.get(key)
         if isinstance(v, (int, float)):
-            if series not in _train_gauges:
-                _train_gauges[series] = Gauge(series, f"train {key}")
-            _train_gauges[series].set(float(v))
+            get_or_create("gauge", series, f"train {key}").set(float(v))
 
 
 def _takes_arg(fn: Callable) -> bool:

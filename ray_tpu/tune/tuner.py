@@ -138,16 +138,11 @@ class ResultGrid:
         return [r.error for r in self._results if r.error]
 
 
-_gauge_cache: Dict[str, Any] = {}
-
-
 def _trials_running_gauge():
-    if "g" not in _gauge_cache:
-        from ray_tpu.util.metrics import Gauge
+    from ray_tpu.util.metrics import get_or_create
 
-        _gauge_cache["g"] = Gauge(
-            "ray_tpu_tune_trials_running", "trials currently running")
-    return _gauge_cache["g"]
+    return get_or_create("gauge", "ray_tpu_tune_trials_running",
+                         "trials currently running")
 
 
 class TrialRunner:

@@ -9,7 +9,7 @@ by the dashboard (`ray_tpu.dashboard`).
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 _registry_lock = threading.Lock()
 _registry: List["Metric"] = []
@@ -115,3 +115,55 @@ def export_prometheus() -> str:
             for k, v in m._values.items():
                 lines.append(f"{m.name}{m._fmt_labels(k)} {v}")
     return "\n".join(lines) + "\n"
+
+
+def get_or_create(kind: str, name: str, description: str = "",
+                  **kwargs) -> Metric:
+    """Get the registered metric `name`, creating it on first use — the
+    one lazy-singleton helper for framework-internal metrics."""
+    cls = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}[kind]
+    with _registry_lock:
+        for m in _registry:
+            if m.name == name:
+                return m
+    return cls(name, description, **kwargs)
+
+
+def snapshot(prefix: str = "") -> Dict[str, Dict[str, Any]]:
+    """Serializable dump of this process's metrics (optionally filtered by
+    name prefix) for shipping to another process's registry."""
+    with _registry_lock:
+        metrics = [m for m in _registry if m.name.startswith(prefix)]
+    out: Dict[str, Dict[str, Any]] = {}
+    for m in metrics:
+        with m._lock:
+            entry: Dict[str, Any] = {
+                "kind": m.kind, "description": m.description,
+                "tag_keys": m.tag_keys, "values": dict(m._values),
+            }
+            if isinstance(m, Histogram):
+                entry["boundaries"] = list(m.boundaries)
+                entry["counts"] = {k: list(v) for k, v in m._counts.items()}
+                entry["sums"] = dict(m._sums)
+                entry["totals"] = dict(m._totals)
+            out[m.name] = entry
+    return out
+
+
+def merge_snapshot(snap: Dict[str, Dict[str, Any]]) -> None:
+    """Install another process's snapshot into this registry, REPLACING the
+    local series of the same names (the remote process owns those series)."""
+    for name, entry in snap.items():
+        kwargs = {"tag_keys": entry.get("tag_keys", ())}
+        if entry["kind"] == "histogram":
+            kwargs["boundaries"] = entry.get(
+                "boundaries", (0.01, 0.1, 1, 10, 100))
+        m = get_or_create(entry["kind"], name,
+                          entry.get("description", ""), **kwargs)
+        with m._lock:
+            m._values = dict(entry.get("values", {}))
+            if isinstance(m, Histogram):
+                m._counts = {k: list(v)
+                             for k, v in entry.get("counts", {}).items()}
+                m._sums = dict(entry.get("sums", {}))
+                m._totals = dict(entry.get("totals", {}))

@@ -119,3 +119,54 @@ def test_map_batches_class_requires_strategy_or_defaults(ray_start_regular):
     assert ds.map_batches(Ident).count() == 8
     with pytest.raises(ValueError):
         ds.map_batches(lambda b: b, compute=ActorPoolStrategy())
+
+
+def test_iter_torch_batches(ray_start_regular):
+    """Reference Datastream.iter_torch_batches: numeric columns become
+    torch tensors (with optional dtype mapping), both on the stream and on
+    streaming_split iterators."""
+    import torch
+
+    ds = rd.from_numpy({"x": np.arange(10.0), "y": np.arange(10)})
+    batches = list(ds.iter_torch_batches(batch_size=4,
+                                         dtypes={"x": torch.float32}))
+    assert [len(b["x"]) for b in batches] == [4, 4, 2]
+    assert batches[0]["x"].dtype == torch.float32
+    assert torch.is_tensor(batches[0]["y"])
+
+    (it,) = ds.streaming_split(1)
+    got = list(it.iter_torch_batches(batch_size=5))
+    assert sum(len(b["x"]) for b in got) == 10
+    assert torch.is_tensor(got[0]["x"])
+
+
+def test_map_batches_batch_size(ray_start_regular):
+    """batch_size re-slices blocks so the UDF sees bounded batches
+    (reference map_batches batch_size semantics)."""
+    sizes = []
+
+    def record(b):
+        sizes.append(len(b["x"]))
+        return {"x": b["x"] + 1}
+
+    ds = rd.from_numpy({"x": np.arange(10.0)}, parallelism=2)  # blocks of 5
+    out = ds.map_batches(record, batch_size=2)
+    assert out.sum("x") == sum(range(10)) + 10
+    # unknown kwargs now raise instead of being silently swallowed
+    with pytest.raises(TypeError):
+        ds.map_batches(record, bogus_option=1)
+
+
+def test_actor_pool_min_size(ray_start_regular):
+    from ray_tpu.data import ActorPoolStrategy
+
+    class Tag:
+        def __call__(self, block):
+            import os
+            return {"pid": np.full(len(block["x"]), os.getpid())}
+
+    # min_size floor even with a single block
+    ds = rd.from_numpy({"x": np.arange(4.0)}, parallelism=1)
+    out = ds.map_batches(Tag, compute=ActorPoolStrategy(min_size=2,
+                                                        max_size=4))
+    assert out.count() == 4

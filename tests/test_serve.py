@@ -238,3 +238,36 @@ def test_pandas_arrow_interop(serve_cluster):
     t = pa.table({"x": [10, 20]})
     ds2 = rt_data.from_arrow(t)
     assert ds2.to_arrow().column("x").to_pylist() == [10, 20]
+
+
+def test_serve_metrics_exported_from_proxy(serve_cluster):
+    """Proxy-side request/latency series must reach the driver's /metrics
+    scrape (the proxy is a separate actor process; the dashboard pulls its
+    snapshot) alongside controller-sourced replica gauges."""
+    import urllib.request as _rq
+
+    @serve.deployment
+    def pingpong(payload):
+        return {"pong": payload.get("n", 0)}
+
+    serve.run(pingpong.bind())
+    _, port = serve.start_http_proxy()
+    for i in range(3):
+        req = _rq.Request(f"http://127.0.0.1:{port}/pingpong",
+                          data=json.dumps({"n": i}).encode(),
+                          headers={"Content-Type": "application/json"})
+        with _rq.urlopen(req, timeout=30) as resp:
+            assert json.loads(resp.read())["result"]["pong"] == i
+
+    from ray_tpu.dashboard import start_dashboard
+
+    server, dport = start_dashboard()
+    try:
+        with _rq.urlopen(f"http://127.0.0.1:{dport}/metrics",
+                         timeout=30) as r:
+            text = r.read().decode()
+    finally:
+        server.shutdown()
+    assert 'ray_tpu_serve_requests_total{deployment="pingpong"} 3' in text
+    assert "ray_tpu_serve_latency_seconds_bucket" in text
+    assert 'ray_tpu_serve_replicas{deployment="pingpong"}' in text
