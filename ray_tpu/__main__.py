@@ -50,6 +50,8 @@ def main(argv=None) -> int:
 
     p_tl = sub.add_parser("timeline", help="dump chrome trace json")
     p_tl.add_argument("--output", default="timeline.json")
+    p_tl.add_argument("--address", default=None,
+                      help="GCS address: include cluster-wide worker spans")
 
     p_mem = sub.add_parser("memory", help="object store usage per node")
     p_mem.add_argument("--address", required=True)
@@ -148,7 +150,16 @@ def main(argv=None) -> int:
     if args.cmd == "timeline":
         from ray_tpu.util import tracing
 
-        tracing.dump(args.output)
+        extra = []
+        if args.address:
+            from ray_tpu.core import rpc as _rpc
+
+            gcs = _rpc.connect_with_retry(args.address, timeout=5)
+            try:
+                extra = gcs.call("get_profile_events", timeout=10)
+            finally:
+                gcs.close()
+        tracing.dump(args.output, extra_events=extra)
         print(f"wrote {args.output}")
         return 0
 

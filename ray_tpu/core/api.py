@@ -8,6 +8,7 @@ Mirrors the reference's `python/ray/_private/worker.py` public surface
 from __future__ import annotations
 
 import atexit
+import os
 import functools
 import logging
 import threading
@@ -344,7 +345,19 @@ def get_runtime_context() -> RuntimeContext:
 
 
 def timeline() -> List[dict]:
-    """Chrome-trace events collected so far (see ray_tpu.util.tracing)."""
+    """Cluster-wide chrome-trace events: this process's spans plus the
+    worker spans aggregated in the GCS (reference `ray.timeline()`,
+    _private/state.py:851)."""
     from ray_tpu.util.tracing import get_events
 
-    return get_events()
+    events = get_events()
+    try:
+        w = _global_worker()
+        w.flush_profile_events()
+        remote = w.gcs.call("get_profile_events", timeout=10)
+        # dedupe by origin worker id (pids collide across hosts)
+        local_src = w.worker_id.binary().hex()
+        events = events + [e for e in remote if e.get("_src") != local_src]
+    except Exception:
+        pass
+    return events

@@ -86,6 +86,7 @@ class GcsServer:
         self._task_events_order: List[bytes] = []
         self._max_task_events = 10000
         self._task_counts = {"submitted": 0, "finished": 0, "failed": 0}
+        self._profile_events: List[dict] = []
 
         # pubsub: channel -> list[ServerConnection]
         self._subs: Dict[str, List[rpc.ServerConnection]] = {}
@@ -435,6 +436,19 @@ class GcsServer:
         with self._lock:
             keys = self._task_events_order[-limit:]
             return [dict(self._task_events[k]) for k in keys]
+
+    def rpc_profile_events(self, conn, req_id, payload):
+        """Chrome-trace spans shipped by workers (reference ProfileEvent
+        buffer); capped ring so the GCS can't grow unboundedly."""
+        with self._lock:
+            self._profile_events.extend(payload.get("events", []))
+            if len(self._profile_events) > 100_000:
+                self._profile_events = self._profile_events[-100_000:]
+        return True
+
+    def rpc_get_profile_events(self, conn, req_id, payload):
+        with self._lock:
+            return list(self._profile_events)
 
     def rpc_task_counts(self, conn, req_id, payload):
         """Cumulative task totals (unwindowed, unlike list_task_events)."""

@@ -195,6 +195,10 @@ class CoreWorker:
         # execution
         self._registered = threading.Event()
         self._task_queue: "queue.Queue[TaskSpec]" = queue.Queue()
+        self._executing_count = 0
+        self._exec_count_lock = threading.Lock()
+        self._profile_flush_lock = threading.Lock()
+        self._profile_events_sent = 0
         self._exec_threads: List[threading.Thread] = []
         self._num_exec_threads = 1
         self._shutdown = threading.Event()
@@ -303,6 +307,25 @@ class CoreWorker:
         self._emit_task_event(spec, "SUBMITTED")
         self.raylet.notify("submit_task", {"spec": spec})
         return refs
+
+    def flush_profile_events(self, min_events: int = 1) -> None:
+        """Ship this process's tracing spans to the GCS so `timeline()` on
+        any driver aggregates cluster-wide events (reference ProfileEvent ->
+        TaskEventBuffer -> GCS)."""
+        from ray_tpu.util import tracing
+
+        src = self.worker_id.binary().hex()
+        with self._profile_flush_lock:
+            events = tracing.get_events()
+            fresh = events[self._profile_events_sent:]
+            if len(fresh) < min_events:
+                return
+            try:
+                self.gcs.notify("profile_events", {
+                    "events": [{**e, "_src": src} for e in fresh]})
+                self._profile_events_sent += len(fresh)
+            except Exception:
+                pass
 
     def _emit_task_event(self, spec: TaskSpec, state: str) -> None:
         """Best-effort task lifecycle record to the control plane
@@ -611,6 +634,14 @@ class CoreWorker:
         if pend is not None:
             self._unpin_after_task(pend[0])
         return True
+
+    def rpc_actor_stats(self, conn, req_id, payload):
+        """Out-of-band load probe: executing + queued task counts, answered
+        from the RPC thread so it can NOT be delayed by the exec queue it
+        measures (Serve autoscaling reads this; cf. reference replicas
+        pushing queue metrics to the controller out-of-band)."""
+        return {"executing": self._executing_count,
+                "queued": self._task_queue.qsize()}
 
     def rpc_task_worker_died(self, conn, req_id, payload):
         """Raylet push: the worker running our task died. Retry or fail."""
@@ -973,6 +1004,8 @@ class CoreWorker:
         prev_pg = getattr(self._tls, "placement_group_id", None)
         self._tls.placement_group_id = spec.scheduling.placement_group_id
         self._emit_task_event(spec, "RUNNING")
+        with self._exec_count_lock:
+            self._executing_count += 1
         failed = False
         results = []
         try:
@@ -985,7 +1018,12 @@ class CoreWorker:
                 if spec.runtime_env:
                     self._apply_runtime_env(spec.runtime_env)
             args, kwargs = self._deserialize_args(spec.args, spec.kwargs_blob)
-            value = fn(*args, **kwargs)
+            from ray_tpu.util import tracing
+
+            with tracing.span(f"task::{spec.method_name}",
+                              "task_execution",
+                              task_id=spec.task_id.binary().hex()):
+                value = fn(*args, **kwargs)
             if inspect.isasyncgen(value):
                 raise TypeError(
                     "async generator returns are not supported; collect "
@@ -1000,7 +1038,10 @@ class CoreWorker:
                 if loop is None or loop.is_closed():
                     loop = asyncio.new_event_loop()
                     self._tls.aio_loop = loop
-                value = loop.run_until_complete(value)
+                with tracing.span(f"task::{spec.method_name}::await",
+                                  "task_execution",
+                                  task_id=spec.task_id.binary().hex()):
+                    value = loop.run_until_complete(value)
             if spec.num_returns == 1:
                 values = [value]
             else:
@@ -1030,7 +1071,10 @@ class CoreWorker:
             else:
                 self._tls.task_id = prev_task_id
             self._tls.placement_group_id = prev_pg
+            with self._exec_count_lock:
+                self._executing_count -= 1
         self._emit_task_event(spec, "FAILED" if failed else "FINISHED")
+        self.flush_profile_events(min_events=1)
         try:
             if spec.owner_address == self.address:
                 self.rpc_report_task_result(None, 0, {"task_id": spec.task_id, "results": results})

@@ -28,3 +28,4 @@ from ray_tpu.rllib.maddpg import MADDPG, MADDPGConfig, SpreadEnv
 from ray_tpu.rllib.slateq import (
     InterestEvolutionEnv, SlateQ, SlateQConfig)
 from ray_tpu.rllib.maml import MAML, MAMLConfig, SinusoidTasks
+from ray_tpu.rllib.dreamer import Dreamer, DreamerConfig, PointGoalEnv

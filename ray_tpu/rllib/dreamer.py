@@ -1,0 +1,431 @@
+"""Dreamer: world-model RL with latent imagination (Hafner et al. 2020).
+
+Reference parity: rllib/algorithms/dreamer/ (SURVEY §2.3 algorithm list).
+Three jointly-trained pieces, all jitted JAX:
+
+  1. RSSM world model — deterministic GRU path h_t plus stochastic latent
+     z_t; prior p(z|h) learns dynamics, posterior q(z|h, obs) filters real
+     observations; decoder and reward head reconstruct the environment.
+     Loss = reconstruction + reward MSE + KL(q || p) with free nats.
+  2. Actor pi(a|h,z) trained purely in IMAGINATION: latent rollouts of
+     horizon H from posterior states, maximizing lambda-returns — the
+     gradient flows through the learned (differentiable) dynamics, the
+     trick that separates Dreamer from model-free RL.
+  3. Critic v(h,z) regressed on stopped lambda-returns.
+
+The in-tree env is a continuous point-goal task (obs = [pos, vel, goal],
+reward = -|pos - goal|) where the world model is learnable fast enough for
+CI; PendulumEnv drops in for a longer run.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.models import init_mlp, mlp_forward
+
+
+class PointGoalEnv:
+    """1-D point mass: accelerate toward a per-episode goal."""
+
+    observation_dim = 3
+    action_dim = 1
+
+    def __init__(self, seed: int = 0, episode_len: int = 30):
+        self.rng = np.random.default_rng(seed)
+        self.episode_len = episode_len
+
+    def reset(self) -> np.ndarray:
+        self.pos = float(self.rng.uniform(-1, 1))
+        self.vel = 0.0
+        self.goal = float(self.rng.uniform(-1, 1))
+        self.t = 0
+        return self._obs()
+
+    def _obs(self) -> np.ndarray:
+        return np.array([self.pos, self.vel, self.goal], np.float32)
+
+    def step(self, action):
+        a = float(np.clip(np.asarray(action).ravel()[0], -1, 1))
+        self.vel = 0.8 * self.vel + 0.2 * a
+        self.pos = float(np.clip(self.pos + 0.3 * self.vel, -2, 2))
+        self.t += 1
+        reward = -abs(self.pos - self.goal)
+        done = self.t >= self.episode_len
+        return self._obs(), reward, done, {}
+
+
+class DreamerConfig:
+    def __init__(self):
+        self.env_maker: Callable[[int], Any] = lambda s: PointGoalEnv(s)
+        self.obs_dim = PointGoalEnv.observation_dim
+        self.action_dim = PointGoalEnv.action_dim
+        self.deter_dim = 32
+        self.stoch_dim = 8
+        self.hidden = 64
+        self.seq_len = 15
+        self.batch_size = 32
+        self.horizon = 10
+        self.gamma = 0.95
+        self.lambda_ = 0.95
+        self.free_nats = 0.5
+        self.kl_scale = 1.0
+        self.model_lr = 1e-3
+        self.actor_lr = 1e-4
+        self.critic_lr = 3e-4
+        self.expl_noise = 0.3
+        self.episodes_per_iter = 5
+        self.updates_per_iter = 40
+        self.buffer_episodes = 500
+        self.warmup_episodes = 10
+        self.seed = 0
+
+    def training(self, **kw) -> "DreamerConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown option {k!r}")
+            if v is not None:
+                setattr(self, k, v)
+        return self
+
+    def build(self) -> "Dreamer":
+        return Dreamer({"dreamer_config": self})
+
+
+def _init_dense(rng, shape, scale=None):
+    scale = scale or np.sqrt(2.0 / shape[0])
+    return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+
+class Dreamer(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg: DreamerConfig = config.get("dreamer_config") or DreamerConfig()
+        self.cfg = cfg
+        self.env = cfg.env_maker(cfg.seed)
+        rng = np.random.default_rng(cfg.seed)
+        D, S, H, A = cfg.deter_dim, cfg.stoch_dim, cfg.hidden, cfg.action_dim
+        O = cfg.obs_dim
+        feat = D + S
+
+        wm = {
+            # pre-GRU embed of [z, a] (the paper's dense layer before the
+            # recurrent cell) and the GRU cell itself
+            "embed": init_mlp(rng, (S + A, H)),
+            "gru_xz": _init_dense(rng, (H, 3 * D)),
+            "gru_h": _init_dense(rng, (D, 3 * D)),
+            "gru_b": np.zeros(3 * D, np.float32),
+            # prior p(z|h): h -> 2S
+            "prior": init_mlp(rng, (D, H, 2 * S)),
+            # posterior q(z|h, obs_embed): obs encoder + head
+            "obs_enc": init_mlp(rng, (O, H)),
+            "post": init_mlp(rng, (D + H, H, 2 * S)),
+            # decoder [h,z] -> obs ; reward head [h,z] -> 1
+            "dec": init_mlp(rng, (feat, H, O)),
+            "rew": init_mlp(rng, (feat, H, 1)),
+        }
+        actor = init_mlp(rng, (feat, H, H, A), final_scale=0.01)
+        critic = init_mlp(rng, (feat, H, H, 1), final_scale=0.01)
+        self.params = {"wm": wm, "actor": actor, "critic": critic}
+        # clip 100 as in the paper — the first KL gradients are enormous
+        self.opt_model = optax.chain(
+            optax.clip_by_global_norm(100.0), optax.adam(cfg.model_lr))
+        self.opt_actor = optax.chain(
+            optax.clip_by_global_norm(100.0), optax.adam(cfg.actor_lr))
+        self.opt_critic = optax.chain(
+            optax.clip_by_global_norm(100.0), optax.adam(cfg.critic_lr))
+        self.os_model = self.opt_model.init(wm)
+        self.os_actor = self.opt_actor.init(actor)
+        self.os_critic = self.opt_critic.init(critic)
+        self.rng = rng
+        self.episodes: List[Dict[str, np.ndarray]] = []
+        self._total_steps = 0
+        self._reward_history: List[float] = []
+        self._jax_key = jax.random.PRNGKey(cfg.seed)
+
+        def gru(wm_p, h, zA):
+            zA = jnp.tanh(mlp_forward(wm_p["embed"], zA, 1))
+            x_parts = jnp.split(zA @ wm_p["gru_xz"] + wm_p["gru_b"], 3, -1)
+            h_parts = jnp.split(h @ wm_p["gru_h"], 3, -1)
+            r = jax.nn.sigmoid(x_parts[0] + h_parts[0])
+            u = jax.nn.sigmoid(x_parts[1] + h_parts[1])
+            cand = jnp.tanh(x_parts[2] + r * h_parts[2])
+            return u * cand + (1 - u) * h
+
+        def gaussian(stats):
+            mean, std = jnp.split(stats, 2, axis=-1)
+            return mean, jax.nn.softplus(std) + 0.1
+
+        def sample(key, mean, std):
+            return mean + std * jax.random.normal(key, mean.shape)
+
+        def obs_step(wm_p, key, h, z, a, obs):
+            """One filtering step: advance deter state, compute prior and
+            posterior, sample posterior z."""
+            h = gru(wm_p, h, jnp.concatenate([z, a], -1))
+            prior_stats = mlp_forward(wm_p["prior"], h, 2)
+            emb = jnp.tanh(mlp_forward(wm_p["obs_enc"], obs, 1))
+            post_stats = mlp_forward(
+                wm_p["post"], jnp.concatenate([h, emb], -1), 2)
+            pm, ps = gaussian(post_stats)
+            z_new = sample(key, pm, ps)
+            return h, z_new, gaussian(prior_stats), (pm, ps)
+
+        def kl(q, p):
+            qm, qs = q
+            pm, ps = p
+            return (jnp.log(ps / qs) + (qs ** 2 + (qm - pm) ** 2)
+                    / (2 * ps ** 2) - 0.5).sum(-1)
+
+        def kl_balanced(post, prior, alpha=0.8):
+            """DreamerV2 KL balancing: push the PRIOR toward the posterior
+            (weight alpha, posterior stopped) much harder than the posterior
+            toward the prior — without this the prior never learns the
+            dynamics and imagination is action-blind."""
+            sg = jax.lax.stop_gradient
+            lhs = kl((sg(post[0]), sg(post[1])), prior)
+            rhs = kl(post, (sg(prior[0]), sg(prior[1])))
+            return alpha * lhs + (1 - alpha) * rhs
+
+        gamma, lam, horizon = cfg.gamma, cfg.lambda_, cfg.horizon
+        free_nats, kl_scale = cfg.free_nats, cfg.kl_scale
+
+        def model_loss(wm_p, key, batch):
+            """batch: obs [B,T,O], actions [B,T,A], rewards [B,T]."""
+            B, T, _ = batch["obs"].shape
+            keys = jax.random.split(key, T)
+
+            def scan_fn(carry, t):
+                h, z, loss_kl = carry
+                h, z, prior, post = obs_step(
+                    wm_p, keys[t], h, z, batch["actions"][:, t],
+                    batch["obs"][:, t])
+                loss_kl = loss_kl + jnp.maximum(
+                    kl_balanced(post, prior), free_nats).mean()
+                return (h, z, loss_kl), (h, z)
+
+            h0 = jnp.zeros((B, D))
+            z0 = jnp.zeros((B, S))
+            (h, z, loss_kl), (hs, zs) = jax.lax.scan(
+                scan_fn, (h0, z0, 0.0), jnp.arange(T))
+            feats = jnp.concatenate(
+                [hs.transpose(1, 0, 2), zs.transpose(1, 0, 2)], -1)  # [B,T,F]
+            recon = mlp_forward(wm_p["dec"], feats, 2)
+            rew = mlp_forward(wm_p["rew"], feats, 2)[..., 0]
+            loss_recon = ((recon - batch["obs"]) ** 2).sum(-1).mean()
+            loss_rew = ((rew - batch["rewards"]) ** 2).mean()
+            total = loss_recon + loss_rew + kl_scale * loss_kl / T
+            aux = {"recon": loss_recon, "reward_mse": loss_rew,
+                   "kl": loss_kl / T,
+                   "feats": jax.lax.stop_gradient(
+                       feats.reshape(B * T, feat))}
+            return total, aux
+
+        def policy(actor_p, f):
+            return jnp.tanh(mlp_forward(actor_p, f, 3))
+
+        def imagine(wm_p, actor_p, key, start_feats):
+            """Roll latent dynamics H steps under the actor; returns
+            feats [H+1, N, F] and predicted rewards [H+1, N]."""
+            N = start_feats.shape[0]
+            h = start_feats[:, :D]
+            z = start_feats[:, D:]
+            keys = jax.random.split(key, horizon)
+
+            def step(carry, k):
+                h, z = carry
+                f = jnp.concatenate([h, z], -1)
+                a = policy(actor_p, f)
+                h2 = gru(wm_p, h, jnp.concatenate([z, a], -1))
+                pm, ps = gaussian(mlp_forward(wm_p["prior"], h2, 2))
+                z2 = sample(k, pm, ps)
+                return (h2, z2), jnp.concatenate([h2, z2], -1)
+
+            (_, _), feats = jax.lax.scan(step, (h, z), keys)
+            feats = jnp.concatenate([start_feats[None], feats], 0)
+            rewards = mlp_forward(wm_p["rew"], feats, 2)[..., 0]
+            return feats, rewards
+
+        def lambda_returns(rewards, values):
+            """TD(lambda) over the imagined horizon ([H+1, N] arrays)."""
+            Hn = rewards.shape[0] - 1
+
+            def step(nxt, t):
+                ret = rewards[t + 1] + gamma * (
+                    (1 - lam) * values[t + 1] + lam * nxt)
+                return ret, ret
+
+            _, rets = jax.lax.scan(
+                step, values[-1], jnp.arange(Hn - 1, -1, -1))
+            return rets[::-1]  # [H, N] aligned with feats[0..H-1]
+
+        def actor_loss(actor_p, wm_p, critic_p, key, start_feats):
+            feats, rewards = imagine(wm_p, actor_p, key, start_feats)
+            values = mlp_forward(critic_p, feats, 3)[..., 0]
+            rets = lambda_returns(rewards, values)
+            return -rets.mean()
+
+        def critic_loss(critic_p, targets_feats, targets):
+            v = mlp_forward(critic_p, targets_feats, 3)[..., 0]
+            return ((v - targets) ** 2).mean()
+
+        def update(params, opts, key, batch):
+            wm_p, actor_p, critic_p = (
+                params["wm"], params["actor"], params["critic"])
+            k1, k2, k3 = jax.random.split(key, 3)
+            (mloss, aux), mgrads = jax.value_and_grad(
+                model_loss, has_aux=True)(wm_p, k1, batch)
+            mupd, os_m = self.opt_model.update(mgrads, opts[0], wm_p)
+            wm_p = optax.apply_updates(wm_p, mupd)
+
+            start = aux["feats"]
+            aloss, agrads = jax.value_and_grad(actor_loss)(
+                actor_p, wm_p, critic_p, k2, start)
+            aupd, os_a = self.opt_actor.update(agrads, opts[1], actor_p)
+            actor_p = optax.apply_updates(actor_p, aupd)
+
+            feats, rewards = imagine(wm_p, actor_p, k3, start)
+            values = mlp_forward(critic_p, feats, 3)[..., 0]
+            rets = jax.lax.stop_gradient(lambda_returns(rewards, values))
+            tfeats = jax.lax.stop_gradient(feats[:-1].reshape(-1, feat))
+            closs, cgrads = jax.value_and_grad(critic_loss)(
+                critic_p, tfeats, rets.reshape(-1))
+            cupd, os_c = self.opt_critic.update(cgrads, opts[2], critic_p)
+            critic_p = optax.apply_updates(critic_p, cupd)
+
+            new_params = {"wm": wm_p, "actor": actor_p, "critic": critic_p}
+            stats = {"model_loss": mloss, "actor_loss": aloss,
+                     "critic_loss": closs, "recon": aux["recon"],
+                     "reward_mse": aux["reward_mse"], "kl": aux["kl"]}
+            return new_params, (os_m, os_a, os_c), stats
+
+        self._update = jax.jit(update)
+
+        def filter_step(wm_p, key, h, z, a, obs):
+            h, z, _, _ = obs_step(wm_p, key, h, z, a, obs)
+            return h, z
+
+        self._filter_step = jax.jit(filter_step)
+        self._policy = jax.jit(policy)
+        self._feat_dim = feat
+        self._dims = (D, S, A)
+
+    # ------------------------------------------------------------- acting
+    def _act(self, h, z, obs, noise: float):
+        import jax
+        import jax.numpy as jnp
+
+        D, S, A = self._dims
+        self._jax_key, k = jax.random.split(self._jax_key)
+        f = np.concatenate([np.asarray(h)[0], np.asarray(z)[0]])
+        a = np.asarray(self._policy(self.params["actor"], f[None]))[0]
+        if noise > 0:
+            a = np.clip(a + noise * self.rng.standard_normal(A), -1, 1)
+        return a
+
+    def _run_episode(self, noise: float, store: bool = True) -> float:
+        import jax.numpy as jnp
+
+        D, S, A = self._dims
+        env = self.env
+        obs = env.reset()
+        h = jnp.zeros((1, D))
+        z = jnp.zeros((1, S))
+        a = np.zeros(A, np.float32)
+        traj = {"obs": [], "actions": [], "rewards": []}
+        total = 0.0
+        import jax
+
+        while True:
+            # filter the real observation into the latent state
+            self._jax_key, k = jax.random.split(self._jax_key)
+            h, z = self._filter_step(
+                self.params["wm"], k, h, z,
+                jnp.asarray(a, jnp.float32)[None], jnp.asarray(obs)[None])
+            a = self._act(h, z, obs, noise)
+            nxt, reward, done, _ = env.step(a)
+            traj["obs"].append(obs)
+            traj["actions"].append(a)
+            traj["rewards"].append(reward)
+            total += reward
+            self._total_steps += 1 if store else 0
+            obs = nxt
+            if done:
+                break
+        if store:
+            self.episodes.append({
+                "obs": np.asarray(traj["obs"], np.float32),
+                "actions": np.asarray(traj["actions"], np.float32),
+                "rewards": np.asarray(traj["rewards"], np.float32),
+            })
+            self.episodes = self.episodes[-self.cfg.buffer_episodes:]
+        return total
+
+    def _sample_batch(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        B, L = cfg.batch_size, cfg.seq_len
+        obs = np.zeros((B, L, cfg.obs_dim), np.float32)
+        act = np.zeros((B, L, cfg.action_dim), np.float32)
+        rew = np.zeros((B, L), np.float32)
+        for b in range(B):
+            ep = self.episodes[self.rng.integers(len(self.episodes))]
+            T = len(ep["rewards"])
+            # align with the filtering recurrence: at index t the model
+            # consumes (a_{t-1}, obs_t) and the reward head predicts the
+            # reward received on ARRIVING at obs_t (= rewards[t-1])
+            prev_a = np.concatenate(
+                [np.zeros((1, cfg.action_dim), np.float32),
+                 ep["actions"][:-1]])
+            arr_r = np.concatenate([[0.0], ep["rewards"][:-1]]).astype(
+                np.float32)
+            if T <= L:
+                obs[b, :T] = ep["obs"]
+                act[b, :T] = prev_a
+                rew[b, :T] = arr_r
+            else:
+                s = self.rng.integers(0, T - L + 1)
+                obs[b] = ep["obs"][s:s + L]
+                act[b] = prev_a[s:s + L]
+                rew[b] = arr_r[s:s + L]
+        return {"obs": jnp.asarray(obs), "actions": jnp.asarray(act),
+                "rewards": jnp.asarray(rew)}
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        cfg = self.cfg
+        returns = [self._run_episode(cfg.expl_noise)
+                   for _ in range(cfg.episodes_per_iter)]
+        stats: Dict[str, Any] = {}
+        if len(self.episodes) >= cfg.warmup_episodes:
+            opts = (self.os_model, self.os_actor, self.os_critic)
+            for _ in range(cfg.updates_per_iter):
+                self._jax_key, k = jax.random.split(self._jax_key)
+                self.params, opts, stats = self._update(
+                    self.params, opts, k, self._sample_batch())
+            self.os_model, self.os_actor, self.os_critic = opts
+            stats = {k2: float(v) for k2, v in jax.device_get(stats).items()}
+        self._reward_history.extend(returns)
+        self._reward_history = self._reward_history[-50:]
+        return {"episode_reward_mean": float(np.mean(self._reward_history)),
+                "num_env_steps_sampled": self._total_steps, **stats}
+
+    def greedy_return(self, episodes: int = 10) -> float:
+        return float(np.mean([self._run_episode(0.0, store=False)
+                              for _ in range(episodes)]))
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, jax.device_get(self.params))
+
+    def set_weights(self, weights) -> None:
+        self.params = weights

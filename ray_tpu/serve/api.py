@@ -66,9 +66,6 @@ class _ReplicaActor:
         finally:
             self._inflight -= 1
 
-    def queue_len(self) -> int:
-        return self._inflight
-
     def health(self) -> bool:
         return True
 
@@ -81,6 +78,7 @@ class ServeController:
         self._deployments: Dict[str, dict] = {}
         self._replicas: Dict[str, List[Any]] = {}
         self._versions: Dict[str, int] = {}
+        self._probes: Dict[str, dict] = {}  # deployment -> {replica: ref}
         self._shutdown = False
         self._thread = threading.Thread(target=self._reconcile_loop, daemon=True)
         self._thread.start()
@@ -114,7 +112,9 @@ class ServeController:
 
     def delete_deployment(self, name: str):
         d = self._deployments.pop(name, None)
+        self._probes.pop(name, None)
         for r in self._replicas.pop(name, []):
+            self._evict_stats_client(r)
             try:
                 ray_tpu.kill(r)
             except Exception:
@@ -159,14 +159,59 @@ class ServeController:
 
     # ----------------------------------------------------------- reconcile
     def _reconcile_loop(self):
+        last_health = 0.0
         while not self._shutdown:
             time.sleep(0.25)
             try:
+                now = time.monotonic()
+                probe = now - last_health >= 1.0
+                if probe:
+                    last_health = now
                 for name in list(self._deployments):
+                    if probe:
+                        self._health_check(name)
                     self._autoscale(name)
                     self._reconcile_one(name)
             except Exception:
                 logger.exception("reconcile failed")
+
+    def _health_check(self, name: str):
+        """Drop replicas whose health probe ERRORS (actor process gone);
+        the reconcile pass right after replaces them (reference
+        DeploymentState check_and_update_replicas). Probes are
+        asynchronous — a busy replica (probe queued behind requests) never
+        blocks the reconcile loop and never counts as dead."""
+        replicas = self._replicas.get(name, [])
+        if not replicas:
+            self._probes.pop(name, None)
+            return
+        probes = self._probes.setdefault(name, {})
+        for r in replicas:
+            if r not in probes:
+                probes[r] = r.health.remote()
+        dead = []
+        for r in list(probes):
+            if r not in replicas:  # replica already scaled away
+                probes.pop(r)
+                continue
+            ready, _ = ray_tpu.wait([probes[r]], num_returns=1, timeout=0)
+            if not ready:
+                continue  # still queued/running — busy is not dead
+            ref = probes.pop(r)
+            try:
+                ray_tpu.get(ref)
+            except Exception:
+                logger.warning("replica of %s failed health check; "
+                               "replacing", name)
+                dead.append(r)
+                self._evict_stats_client(r)
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+        if dead:
+            self._replicas[name] = [r for r in replicas if r not in dead]
+            self._versions[name] = self._versions.get(name, 0) + 1
 
     def _reconcile_one(self, name: str):
         d = self._deployments.get(name)
@@ -183,6 +228,7 @@ class ServeController:
             changed = True
         while len(replicas) > d["target"]:
             r = replicas.pop()
+            self._evict_stats_client(r)
             try:
                 ray_tpu.kill(r)
             except Exception:
@@ -190,6 +236,40 @@ class ServeController:
             changed = True
         if changed:
             self._versions[name] = self._versions.get(name, 0) + 1
+
+    def _evict_stats_client(self, replica) -> None:
+        cache = getattr(self, "_stats_clients", None)
+        if not cache:
+            return
+        client = cache.pop(replica.actor_id, None)
+        if client is not None:
+            try:
+                client.close()
+            except Exception:
+                pass
+
+    def _worker_stats(self, replica) -> dict:
+        """actor_stats RPC to the worker hosting `replica` (address cached;
+        invalidated on connection errors so replaced replicas re-resolve)."""
+        from ray_tpu.core import rpc as _rpc
+        from ray_tpu.core.api import _global_worker
+
+        cache = getattr(self, "_stats_clients", None)
+        if cache is None:
+            cache = self._stats_clients = {}
+        key = replica.actor_id
+        client = cache.get(key)
+        if client is None:
+            info = _global_worker().get_actor_info(actor_id=key)
+            if not info or not info.get("address"):
+                raise RuntimeError("no address for replica")
+            client = _rpc.connect_with_retry(info["address"], timeout=3)
+            cache[key] = client
+        try:
+            return client.call("actor_stats", timeout=3)
+        except Exception:
+            self._evict_stats_client(replica)
+            raise
 
     def _autoscale(self, name: str):
         """Queue-length-driven scaling (reference autoscaling_policy.py:127)."""
@@ -200,11 +280,18 @@ class ServeController:
         replicas = self._replicas.get(name, [])
         if not replicas:
             return
-        try:
-            qlens = ray_tpu.get(
-                [r.queue_len.remote() for r in replicas], timeout=5)
-        except Exception:
-            return
+        # out-of-band load probe against each replica's WORKER (answered
+        # from its RPC thread): an actor-method probe would queue behind
+        # the very requests being measured and always read a drained queue
+        qlens = []
+        for r in replicas:
+            try:
+                stats = self._worker_stats(r)
+                qlens.append(stats["executing"] + stats["queued"])
+            except Exception:
+                # partial stats must not drive scaling: a wrongly-low total
+                # would trigger a scale-down of an overloaded deployment
+                return
         total = sum(qlens)
         d["last_queue_depth"] = total
         desired = max(

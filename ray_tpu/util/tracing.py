@@ -13,11 +13,27 @@ import os
 import threading
 import time
 from contextlib import contextmanager
-from typing import List
+from typing import List, Optional
 
 _events: List[dict] = []
 _lock = threading.Lock()
 _t0 = time.perf_counter()
+# observers called with each completed span dict — the OpenTelemetry
+# bridge (util/otel.py) and the worker's GCS profile-event shipper hook in
+# here (reference: opt-in OTel spans + TaskEventBuffer profile events)
+_span_hooks: List = []
+
+
+def add_span_hook(fn) -> None:
+    with _lock:
+        if fn not in _span_hooks:
+            _span_hooks.append(fn)
+
+
+def remove_span_hook(fn) -> None:
+    with _lock:
+        if fn in _span_hooks:
+            _span_hooks.remove(fn)
 
 
 def _now_us() -> float:
@@ -31,13 +47,20 @@ def span(name: str, category: str = "task", **args):
         yield
     finally:
         end = _now_us()
+        event = {
+            "name": name, "cat": category, "ph": "X",
+            "ts": start, "dur": end - start,
+            "pid": os.getpid(), "tid": threading.get_ident() % 100000,
+            "args": args,
+        }
         with _lock:
-            _events.append({
-                "name": name, "cat": category, "ph": "X",
-                "ts": start, "dur": end - start,
-                "pid": os.getpid(), "tid": threading.get_ident() % 100000,
-                "args": args,
-            })
+            _events.append(event)
+            hooks = list(_span_hooks)
+        for h in hooks:
+            try:
+                h(event)
+            except Exception:
+                pass
 
 
 def instant(name: str, category: str = "event", **args) -> None:
@@ -54,9 +77,10 @@ def get_events() -> List[dict]:
         return list(_events)
 
 
-def dump(path: str) -> None:
+def dump(path: str, extra_events: Optional[List[dict]] = None) -> None:
+    events = get_events() + list(extra_events or [])
     with open(path, "w") as f:
-        json.dump({"traceEvents": get_events()}, f)
+        json.dump({"traceEvents": events}, f)
 
 
 def clear() -> None:

@@ -271,3 +271,45 @@ def test_serve_metrics_exported_from_proxy(serve_cluster):
     assert 'ray_tpu_serve_requests_total{deployment="pingpong"} 3' in text
     assert "ray_tpu_serve_latency_seconds_bucket" in text
     assert 'ray_tpu_serve_replicas{deployment="pingpong"}' in text
+
+
+def test_replica_health_check_restart(serve_cluster):
+    """A killed replica must be detected by the controller's health probe
+    and replaced, and requests must keep succeeding (reference
+    deployment_state.py check_and_update_replicas)."""
+    @serve.deployment(num_replicas=2)
+    class Pid:
+        def __call__(self, payload):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(Pid.bind())
+    pids = {ray_tpu.get(handle.remote(None)) for _ in range(10)}
+    assert len(pids) == 2
+
+    # kill one replica out from under the controller
+    controller = ray_tpu.get_actor(serve.api.CONTROLLER_NAME)
+    replicas = ray_tpu.get(
+        controller.get_replicas.remote("Pid"))["replicas"]
+    ray_tpu.kill(replicas[0])
+
+    # controller replaces it; a fresh handle sees 2 replicas again and
+    # requests succeed again (each get may transiently hit the dead
+    # replica until the health probe replaces it)
+    deadline = time.time() + 60
+    seen = set()
+    while time.time() < deadline:
+        info = serve.status().get("Pid", {})
+        h = serve.get_deployment_handle("Pid")
+        seen = set()
+        for _ in range(6):
+            try:
+                seen.add(ray_tpu.get(h.remote(None), timeout=5))
+            except Exception:
+                pass
+        if info.get("replicas") == 2 and len(seen) == 2:
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError((serve.status(), seen))
