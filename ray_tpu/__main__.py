@@ -94,6 +94,12 @@ def main(argv=None) -> int:
     p_job_list = job_sub.add_parser("list")
     p_job_list.add_argument("--address", required=True)
 
+    p_debug = sub.add_parser("debug",
+                             help="attach to a remote rpdb breakpoint")
+    p_debug.add_argument("--address", required=True)
+    p_debug.add_argument("--index", type=int, default=0,
+                         help="which breakpoint (from the listed order)")
+
     p_metrics = sub.add_parser("metrics", help="observability tooling")
     metrics_sub = p_metrics.add_subparsers(dest="metrics_cmd", required=True)
     p_mx = metrics_sub.add_parser(
@@ -104,6 +110,27 @@ def main(argv=None) -> int:
                       choices=["core", "train", "serve"])
 
     args = parser.parse_args(argv)
+
+    if args.cmd == "debug":
+        from ray_tpu.core import rpc as _rpc
+        from ray_tpu.util import rpdb
+
+        gcs = _rpc.connect_with_retry(args.address, timeout=5)
+        try:
+            bps = rpdb.list_breakpoints(gcs)
+        finally:
+            gcs.close()
+        if not bps:
+            print("no active breakpoints")
+            return 1
+        for i, bp in enumerate(bps):
+            print(f"[{i}] pid={bp.get('pid')} {bp['host']}:{bp['port']} "
+                  f"task={bp.get('task_id')} actor={bp.get('actor_id')}")
+        bp = bps[min(args.index, len(bps) - 1)]
+        print(f"attaching to {bp['host']}:{bp['port']} "
+              f"(Ctrl-D to detach)...")
+        rpdb.attach(bp["host"], bp["port"])
+        return 0
 
     if args.cmd == "metrics":
         from ray_tpu.grafana import export_dashboards

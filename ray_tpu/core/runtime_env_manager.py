@@ -36,11 +36,17 @@ def env_key(runtime_env: Optional[dict]) -> Optional[str]:
     if not runtime_env:
         return None
     pip = runtime_env.get("pip")
-    if not pip:
+    mods = runtime_env.get("py_modules")
+    if not pip and not mods:
         return None
     if isinstance(pip, dict):  # {"packages": [...]} form
         pip = pip.get("packages", [])
-    spec = {"pip": sorted(str(p) for p in pip)}
+    # py_modules mutate sys.path for the worker's lifetime, so workers are
+    # pooled per package set (like pip envs) rather than shared
+    spec = {"pip": sorted(str(p) for p in pip or []),
+            "py_modules": sorted(
+                str(m.get("uri", m) if isinstance(m, dict) else m)
+                for m in mods or [])}
     return hashlib.sha1(json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
 
 
@@ -64,9 +70,14 @@ class RuntimeEnvManager:
         """Blocking: return the env's python executable, creating the venv
         on first use. Raises RuntimeError on (possibly cached) failure."""
         import fcntl
+        import sys
 
         key = env_key(runtime_env)
         assert key is not None
+        if not runtime_env.get("pip"):
+            # py_modules-only env: dedicated worker pool (sys.path isolation)
+            # but no venv — the host interpreter serves it
+            return sys.executable
         with self._key_lock(key):
             if key in self._failed:
                 raise RuntimeError(self._failed[key])

@@ -284,6 +284,10 @@ class CoreWorker:
     ) -> List[ObjectRef]:
         from ray_tpu.core.task_spec import SchedulingStrategy
 
+        if runtime_env and runtime_env.get("py_modules"):
+            from ray_tpu.runtime_env import upload_py_modules
+
+            runtime_env = upload_py_modules(runtime_env, self.gcs)
         task_id = self._task_counter.next_task_id()
         spec = TaskSpec(
             task_id=task_id,
@@ -743,6 +747,10 @@ class CoreWorker:
 
     # --------------------------------------------------------------- actors
     def create_actor(self, spec: ActorCreationSpec, class_name: str) -> None:
+        if spec.runtime_env and spec.runtime_env.get("py_modules"):
+            from ray_tpu.runtime_env import upload_py_modules
+
+            spec.runtime_env = upload_py_modules(spec.runtime_env, self.gcs)
         r = self.gcs.call("register_actor", {
             "spec": spec, "owner_address": self.address, "class_name": class_name})
         if isinstance(r, dict) and r.get("error"):
@@ -976,10 +984,20 @@ class CoreWorker:
                 "error": f"{e}\n{traceback.format_exc()}"})
 
     def _apply_runtime_env(self, env: dict) -> None:
+        import sys as _sys
+
         for k, v in env.get("env_vars", {}).items():
             os.environ[k] = str(v)
         if env.get("working_dir"):
             os.chdir(env["working_dir"])
+        if env.get("py_modules"):
+            from ray_tpu.runtime_env import ensure_py_modules
+
+            cache = os.path.expanduser("~/.cache/ray_tpu/py_modules")
+            os.makedirs(cache, exist_ok=True)
+            for path in ensure_py_modules(env, self.gcs, cache):
+                if path not in _sys.path:
+                    _sys.path.insert(0, path)
 
     def _start_exec_threads(self, n: int) -> None:
         while len(self._exec_threads) < n:

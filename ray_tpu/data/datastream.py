@@ -80,6 +80,19 @@ def _slice_block(block: Block, start: int, end: int) -> Block:
 # ------------------------------------------------------------------ ops
 
 
+def _apply_ops_timed(block: Block, ops: List[tuple]):
+    """_apply_ops + per-op wall time, for Datastream.stats()
+    (reference Dataset.stats() per-operator execution summary)."""
+    import time
+
+    timings = []
+    for op in ops:
+        t0 = time.perf_counter()
+        block = _apply_ops(block, [op])
+        timings.append((op[0], time.perf_counter() - t0))
+    return block, timings
+
+
 def _apply_ops(block: Block, ops: List[tuple]) -> Block:
     """Run the fused op chain on one block (executes inside a task)."""
     for op in ops:
@@ -487,6 +500,34 @@ class Datastream:
         for batch in self.iter_batches(batch_size=batch_size,
                                        drop_last=drop_last):
             yield _to_torch_batch(batch, dtypes, device)
+
+    def stats(self) -> str:
+        """Execute the pending op chain with per-operator timing and return
+        a summary (reference `Dataset.stats()`): per op kind — total wall
+        time across blocks, min/max per block, rows out."""
+        timed = ray_tpu.remote(_apply_ops_timed)
+        outs = ray_tpu.get([timed.remote(r, self._ops)
+                            for r in self._block_refs])
+        per_op: Dict[str, List[float]] = {}
+        total_rows = 0
+        for block, timings in outs:
+            total_rows += _block_len(block)
+            for kind, seconds in timings:
+                per_op.setdefault(kind, []).append(seconds)
+        lines = [f"Datastream stats: {len(self._block_refs)} blocks, "
+                 f"{total_rows} rows out"]
+        for i, (kind, _fn, *rest) in enumerate(
+                [(op[0], None) for op in self._ops]):
+            times = per_op.get(kind, [])
+            if not times:
+                continue
+            lines.append(
+                f"  op {i} {kind}: total {sum(times)*1e3:.1f}ms, "
+                f"min {min(times)*1e3:.2f}ms, max {max(times)*1e3:.2f}ms, "
+                f"avg {np.mean(times)*1e3:.2f}ms over {len(times)} blocks")
+        if not self._ops:
+            lines.append("  (no pending ops — fully materialized)")
+        return "\n".join(lines)
 
     def split(self, n: int, *, equal: bool = False) -> List["Datastream"]:
         refs = self._executed_refs()

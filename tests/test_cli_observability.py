@@ -4,6 +4,7 @@ healthcheck, global-gc, microbenchmark (reference scripts.py surface)."""
 import json
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -180,3 +181,50 @@ def test_cli_metrics_export_dashboards(tmp_path):
     import os
 
     assert os.path.exists(os.path.join(out, "ray_tpu_train.json"))
+
+
+def test_remote_pdb_breakpoint(ray_start_regular):
+    """rpdb (reference `ray debug` + util/rpdb.py): a task blocks at
+    set_trace, the breakpoint is discoverable through the GCS KV, a socket
+    client can evaluate expressions and continue the task."""
+    import socket
+
+    import ray_tpu
+    from ray_tpu.core.api import _global_worker
+    from ray_tpu.util import rpdb
+
+    @ray_tpu.remote
+    def buggy():
+        x = 41
+        from ray_tpu.util.rpdb import set_trace
+
+        set_trace()
+        return x + 1
+
+    ref = buggy.remote()
+    gcs = _global_worker().gcs
+    deadline = time.time() + 30
+    bps = []
+    while time.time() < deadline and not bps:
+        bps = rpdb.list_breakpoints(gcs)
+        time.sleep(0.2)
+    assert bps, "breakpoint never registered"
+
+    conn = socket.create_connection((bps[0]["host"], bps[0]["port"]),
+                                    timeout=10)
+    f = conn.makefile("rw")
+    f.write("p x\n")
+    f.flush()
+    out = ""
+    conn.settimeout(10)
+    while "41" not in out:
+        out += conn.recv(4096).decode()
+    f.write("c\n")
+    f.flush()
+    assert ray_tpu.get(ref, timeout=30) == 42
+    conn.close()
+    # breakpoint deregisters after the session
+    deadline = time.time() + 10
+    while time.time() < deadline and rpdb.list_breakpoints(gcs):
+        time.sleep(0.2)
+    assert not rpdb.list_breakpoints(gcs)

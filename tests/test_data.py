@@ -170,3 +170,18 @@ def test_actor_pool_min_size(ray_start_regular):
     out = ds.map_batches(Tag, compute=ActorPoolStrategy(min_size=2,
                                                         max_size=4))
     assert out.count() == 4
+
+
+def test_datastream_stats(ray_start_regular):
+    """stats() reports per-operator execution timing (reference
+    Dataset.stats())."""
+    ds = (rd.range(100, parallelism=4)
+          .map(lambda r: {"id": r["id"] * 2})
+          .filter(lambda r: r["id"] % 4 == 0))
+    report = ds.stats()
+    assert "4 blocks" in report and "50 rows out" in report
+    assert "map:" in report and "filter:" in report
+    assert "avg" in report
+
+    empty = rd.range(4).materialize()
+    assert "fully materialized" in empty.stats()

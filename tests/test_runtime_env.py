@@ -88,3 +88,37 @@ def test_pip_runtime_env_failure_propagates(ray_start_regular):
         "pip": ["--no-index", "/nonexistent/definitely-not-a-package"]}).remote()
     with pytest.raises(RuntimeEnvSetupError):
         ray_tpu.get(r, timeout=300)
+
+
+def test_py_modules_shipping(ray_start_regular, tmp_path):
+    """py_modules (reference packaging.py): a local module zips into a
+    content-addressed KV package, workers extract and import it."""
+    pkg = tmp_path / "shipme"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("MAGIC = 'shipped-427'\n")
+    (pkg / "helper.py").write_text("def triple(x):\n    return 3 * x\n")
+
+    @ray_tpu.remote
+    def use_module():
+        import shipme
+        from shipme.helper import triple
+
+        return shipme.MAGIC, triple(9)
+
+    magic, got = ray_tpu.get(use_module.options(
+        runtime_env={"py_modules": [str(pkg)]}).remote())
+    assert magic == "shipped-427" and got == 27
+
+    # actors get it too
+    @ray_tpu.remote
+    class Uses:
+        def __init__(self):
+            import shipme
+
+            self.magic = shipme.MAGIC
+
+        def get(self):
+            return self.magic
+
+    a = Uses.options(runtime_env={"py_modules": [str(pkg)]}).remote()
+    assert ray_tpu.get(a.get.remote()) == "shipped-427"
