@@ -16,36 +16,19 @@ import sys
 import time
 
 
-def main() -> None:
+def _bench_config(cfg, batch_size, seq, peak_flops_per_chip, iters):
+    """Measure one model config's train step; returns (tok/s/chip, mfu, dt,
+    compile_s, loss, n_params)."""
     import jax
     import jax.numpy as jnp
 
-    devices = jax.devices()
-    n_chips = len(devices)
-    platform = devices[0].platform
-
-    import dataclasses
-
-    from ray_tpu.models import ModelConfig, count_params
+    from ray_tpu.models import count_params
     from ray_tpu.parallel import MeshConfig, make_mesh
     from ray_tpu.train import make_train_step, batch_sharding
     from ray_tpu.train.step import default_optimizer
 
-    on_tpu = platform == "tpu"
-    if on_tpu:
-        # dots (selective) remat at batch 4 beats full remat at batch 8 by
-        # ~10% MFU: matmul outputs stay resident, so the backward pass skips
-        # most recompute; the smaller batch keeps activations inside HBM
-        cfg = ModelConfig(
-            vocab_size=32768, d_model=2048, n_layers=12, n_heads=16,
-            n_kv_heads=8, d_ff=6144, max_seq_len=2048, remat="dots")
-        batch_size, seq = 4 * n_chips, 2048  # 4 per chip (dp shards batch)
-        peak_flops_per_chip = 197e12  # v5e bf16 peak
-    else:  # CI smoke path
-        cfg = ModelConfig.tiny()
-        batch_size, seq = 4, 128
-        peak_flops_per_chip = 1e12
-
+    devices = jax.devices()
+    n_chips = len(devices)
     mesh = make_mesh(MeshConfig(dp=-1, fsdp=1, tp=1, sp=1), devices)
     step_fn, init_fn, _ = make_train_step(cfg, mesh, default_optimizer())
     state = init_fn(jax.random.PRNGKey(0))
@@ -62,7 +45,6 @@ def main() -> None:
         # scalar device_get is the only reliable barrier.
         return float(jax.device_get(m["loss"]))
 
-    # compile + warmup
     t0 = time.perf_counter()
     state, metrics = step_fn(state, batch)
     sync(metrics)
@@ -70,8 +52,6 @@ def main() -> None:
 
     # Fixed dispatch/sync latency is ~70ms through the tunnel: time a chain
     # of 1 step and a chain of 1+iters steps and difference them.
-    iters = 10 if on_tpu else 3
-
     def run_chain(n):
         nonlocal state
         t0 = time.perf_counter()
@@ -85,21 +65,48 @@ def main() -> None:
     t_short = run_chain(1)
     t_long = run_chain(1 + iters)
     dt = (t_long - t_short) / iters
-    metrics = {"loss": jnp.asarray(0.0)}
     state, metrics = step_fn(state, batch)
+    loss = sync(metrics)
 
-    tokens_per_step = batch_size * seq
-    tokens_per_sec = tokens_per_step / dt
-    tokens_per_sec_per_chip = tokens_per_sec / n_chips
-
-    # fwd+bwd FLOPs/token: 6*P matmul + causal attention term
+    tokens_per_sec = batch_size * seq / dt
     attn_flops = 6 * cfg.n_layers * cfg.d_model * seq  # 12*L*d*s * 0.5 causal
     flops_per_token = 6 * n_params + attn_flops
     mfu = tokens_per_sec * flops_per_token / (peak_flops_per_chip * n_chips)
+    return tokens_per_sec / n_chips, mfu, dt, compile_s, loss, n_params
 
-    print(json.dumps({
+
+def main() -> None:
+    import dataclasses
+
+    import jax
+
+    from ray_tpu.models import ModelConfig
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    platform = devices[0].platform
+    on_tpu = platform == "tpu"
+    if on_tpu:
+        # dots (selective) remat at batch 4 beats full remat at batch 8 by
+        # ~10% MFU: matmul outputs stay resident, so the backward pass skips
+        # most recompute; the smaller batch keeps activations inside HBM
+        cfg = ModelConfig(
+            vocab_size=32768, d_model=2048, n_layers=12, n_heads=16,
+            n_kv_heads=8, d_ff=6144, max_seq_len=2048, remat="dots")
+        batch_size, seq = 4 * n_chips, 2048  # 4 per chip (dp shards batch)
+        peak_flops_per_chip = 197e12  # v5e bf16 peak
+    else:  # CI smoke path
+        cfg = ModelConfig.tiny()
+        batch_size, seq = 4, 128
+        peak_flops_per_chip = 1e12
+
+    iters = 10 if on_tpu else 3
+    tok_s_chip, mfu, dt, compile_s, loss, n_params = _bench_config(
+        cfg, batch_size, seq, peak_flops_per_chip, iters)
+
+    result = {
         "metric": "train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec_per_chip, 1),
+        "value": round(tok_s_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 3),
         "mfu": round(mfu, 4),
@@ -110,8 +117,28 @@ def main() -> None:
         "seq": seq,
         "step_time_s": round(dt, 4),
         "compile_s": round(compile_s, 1),
-        "loss": round(float(jax.device_get(metrics["loss"])), 3),
-    }))
+        "loss": round(loss, 3),
+    }
+
+    if on_tpu:
+        # Secondary: the ~1.2B ModelConfig.b1 (largest bench config that fits
+        # one chip, full remat + chunked loss) — reported as b1_* fields of
+        # the same single JSON line the driver parses.
+        b1 = dataclasses.replace(
+            ModelConfig.b1(), max_seq_len=2048, remat="full", loss_chunk=512)
+        try:
+            b1_tok, b1_mfu, b1_dt, _, _, b1_params = _bench_config(
+                b1, 4 * n_chips, 2048, peak_flops_per_chip, iters)
+            result.update({
+                "b1_tokens_per_sec_per_chip": round(b1_tok, 1),
+                "b1_mfu": round(b1_mfu, 4),
+                "b1_n_params": b1_params,
+                "b1_step_time_s": round(b1_dt, 4),
+            })
+        except Exception as e:  # never lose the primary line to the add-on
+            result["b1_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -57,6 +57,12 @@ class Config:
     max_task_retries_default: int = 0
     actor_max_restarts_default: int = 0
     get_check_interval_s: float = 0.05
+    # Lineage-based object recovery (cf. reference
+    # object_recovery_manager.h:41, task_manager.h:90): how many times a lost
+    # task output may be recomputed by re-executing its creating task, and how
+    # many creating specs the owner retains (FIFO-evicted beyond this).
+    lineage_reconstruction_max_retries: int = 3
+    lineage_table_max_entries: int = 10000
 
     # --- rpc ---
     rpc_connect_timeout_s: float = 30.0

@@ -419,7 +419,12 @@ class GcsServer:
                 e = {"task_id": key}
                 self._task_events[key] = e
                 self._task_events_order.append(key)
-                self._task_counts["submitted"] += 1
+                # Only the initial SUBMITTED event counts; a terminal event
+                # recreating an evicted entry (>10k tasks in flight) must not
+                # inflate the running submitted total, or the derived pending
+                # count (submitted - finished - failed) drifts upward forever.
+                if payload.get("state") == "SUBMITTED":
+                    self._task_counts["submitted"] += 1
             e.update({k: v for k, v in payload.items() if k != "task_id"})
             e.setdefault("events", []).append(
                 (payload.get("state", "?"), time.time()))

@@ -80,7 +80,6 @@ class _ObjectState:
     local_refs: int = 0
     borrowers: int = 0
     submitted_task_deps: int = 0    # in-flight tasks depending on this object
-    spec: Optional[TaskSpec] = None  # lineage: the task that creates this
     waiters: List[Tuple] = field(default_factory=list)  # (conn, req_id) info waiters
 
 
@@ -118,21 +117,27 @@ class ReferenceCounter:
             pass
 
     def remove_local(self, ref: ObjectRef) -> None:
+        # The full decrement/pop happens under the lock; only the (idempotent)
+        # owner notification runs outside it, so concurrent removers can never
+        # interleave on the same entry (reference holds its mutex across the
+        # whole RemoveLocalReference body, reference_count.h:109).
+        notify_owner = None
         with self._lock:
             e = self._borrowed.get(ref.id)
-        if e is not None:
-            e["count"] -= 1
-            if e["count"] <= 0:
-                with self._lock:
+            if e is not None:
+                e["count"] -= 1
+                if e["count"] <= 0:
                     self._borrowed.pop(ref.id, None)
-                if e.get("registered"):
-                    try:
-                        self._worker.peer(e["owner"]).notify(
-                            "remove_borrower", {"object_id": ref.id})
-                    except Exception:
-                        pass
-        else:
+                    if e.get("registered"):
+                        notify_owner = e["owner"]
+        if e is None:
             self._worker._remove_owned_local_ref(ref.id)
+        elif notify_owner is not None:
+            try:
+                self._worker.peer(notify_owner).notify(
+                    "remove_borrower", {"object_id": ref.id})
+            except Exception:
+                logger.debug("remove_borrower notify to %s failed", notify_owner)
 
 
 # ---------------------------------------------------------------------------
@@ -165,6 +170,13 @@ class CoreWorker:
         self._obj_lock = threading.RLock()
         self._obj_cv = threading.Condition(self._obj_lock)
 
+        # Lineage table (cf. reference object_recovery_manager.h:41): the
+        # creating TaskSpec of every owned task output, retained even after
+        # the object's data is freed so a lost primary can be recomputed.
+        # Insertion-ordered; FIFO-evicted at lineage_table_max_entries.
+        self._lineage: Dict[ObjectID, TaskSpec] = {}
+        self._lineage_attempts: Dict[TaskID, int] = {}
+
         self._task_counter = _TaskIDCounter(self.worker_id)
         self._put_counter = 0
         self._put_lock = threading.Lock()
@@ -176,8 +188,12 @@ class CoreWorker:
         self._peers: Dict[str, rpc.RpcClient] = {}
         self._peers_lock = threading.Lock()
 
-        # pending task specs for retory: task_id -> (spec, retries_left)
+        # pending task specs for retry: task_id -> [spec, retries_left].
+        # Touched by user threads (submit), the RPC reader (results, death
+        # notifications) and the GCS push thread (actor death fan-out), so all
+        # compound read-modify-write goes through _pending_lock.
         self._pending_tasks: Dict[TaskID, list] = {}
+        self._pending_lock = threading.Lock()
 
         # actor state (when this worker hosts an actor)
         self.actor_id: Optional[ActorID] = None
@@ -200,6 +216,7 @@ class CoreWorker:
         self._profile_flush_lock = threading.Lock()
         self._profile_events_sent = 0
         self._exec_threads: List[threading.Thread] = []
+        self._exec_threads_lock = threading.Lock()
         self._num_exec_threads = 1
         self._shutdown = threading.Event()
 
@@ -307,7 +324,8 @@ class CoreWorker:
             runtime_env=runtime_env,
         )
         refs = self._register_returns(spec)
-        self._pending_tasks[task_id] = [spec, max_retries]
+        with self._pending_lock:
+            self._pending_tasks[task_id] = [spec, max_retries]
         self._emit_task_event(spec, "SUBMITTED")
         self.raylet.notify("submit_task", {"spec": spec})
         return refs
@@ -349,6 +367,7 @@ class CoreWorker:
 
     def _register_returns(self, spec: TaskSpec) -> List[ObjectRef]:
         refs = []
+        cfg = get_config()
         with self._obj_lock:
             for oid in spec.return_object_ids():
                 st = self._objects.get(oid)
@@ -357,8 +376,16 @@ class CoreWorker:
                     self._objects[oid] = st
                 st.state = "pending"
                 st.local_refs += 1
-                st.spec = spec
                 refs.append(ObjectRef(oid, owner_address=self.address))
+                if spec.task_type == TaskType.NORMAL:
+                    self._lineage[oid] = spec
+            while len(self._lineage) > cfg.lineage_table_max_entries:
+                # Evict a whole task's returns together and drop its retry
+                # counter so _lineage_attempts can't grow unboundedly.
+                old = self._lineage.pop(next(iter(self._lineage)))
+                for roid in old.return_object_ids():
+                    self._lineage.pop(roid, None)
+                self._lineage_attempts.pop(old.task_id, None)
         return refs
 
     def _serialize_args(self, args: tuple) -> List[Tuple]:
@@ -459,31 +486,63 @@ class CoreWorker:
         return fut
 
     def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
-        info = self._resolve(ref, deadline)
-        kind = info["kind"]
-        if kind == "inline":
-            value = serialization.loads(info["data"])
-        elif kind == "plasma":
-            value = self._fetch_plasma(ref, info, deadline)
-        elif kind == "error":
-            err = serialization.loads(info["data"])
-            if isinstance(err, TaskError) and err.cause is not None:
-                # Re-raise the user's original exception type with the remote
-                # traceback attached (cf. reference as_instanceof_cause).
-                raise err.cause from err
-            raise err
-        else:
+        recoveries = 0
+        failed_sources: set = set()
+        while True:
+            info = self._resolve(ref, deadline)
+            kind = info["kind"]
+            if kind == "inline":
+                return serialization.loads(info["data"])
+            if kind == "plasma":
+                source = info.get("raylet")
+                if source in failed_sources:
+                    # Re-resolved to a location that already failed: the copy
+                    # really is gone. Lineage recovery (reference
+                    # object_recovery_manager.h:96): recompute by re-executing
+                    # the creating task, then resolve the fresh location.
+                    if (recoveries < get_config().lineage_reconstruction_max_retries
+                            and self._recover_object(ref)):
+                        recoveries += 1
+                        failed_sources.clear()
+                        continue
+                    raise ObjectLostError(
+                        f"object {ref.id} lost from {source} and could not "
+                        f"be reconstructed")
+                try:
+                    return self._fetch_plasma(ref, info, deadline)
+                except ObjectLostError:
+                    # First failure of this source: re-resolve before spending
+                    # a reconstruction — a concurrent getter's recovery may
+                    # already have produced a copy at a new location.
+                    failed_sources.add(source)
+                    continue
+            if kind == "error":
+                err = serialization.loads(info["data"])
+                if isinstance(err, TaskError) and err.cause is not None:
+                    # Re-raise the user's original exception type with the
+                    # remote traceback attached (cf. reference
+                    # as_instanceof_cause).
+                    raise err.cause from err
+                raise err
             raise ObjectLostError(f"object {ref.id} in unexpected state {kind}")
-        return value
 
     def _resolve(self, ref: ObjectRef, deadline: Optional[float]) -> dict:
         """Find where the object's bytes are (blocking until produced)."""
         if ref.owner_address in ("", self.address):
             with self._obj_cv:
                 st = self._objects.get(ref.id)
-                if st is None:
-                    raise ObjectLostError(
-                        f"object {ref.id} is not owned by this process and has no owner address")
+            if st is None:
+                # Data already freed, but if the lineage survives we can
+                # recompute (needed when a reconstructed task's own args were
+                # freed after its first run). Outside the cv: _try_reconstruct
+                # does network sends and must not run under _obj_lock.
+                if ref.id in self._lineage and self._try_reconstruct(ref.id):
+                    with self._obj_cv:
+                        st = self._objects.get(ref.id)
+            if st is None:
+                raise ObjectLostError(
+                    f"object {ref.id} is not owned by this process and has no owner address")
+            with self._obj_cv:
                 while st.state == "pending":
                     remaining = None if deadline is None else deadline - time.monotonic()
                     if remaining is not None and remaining <= 0:
@@ -514,8 +573,19 @@ class CoreWorker:
         last_err: Exception | None = None
         for _ in range(3):
             timeout = None if deadline is None else max(deadline - time.monotonic(), 0.01)
-            loc = self.raylet.call(
-                "pull_object", {"object_id": ref.id, "source": source}, timeout=timeout)
+            try:
+                loc = self.raylet.call(
+                    "pull_object", {"object_id": ref.id, "source": source},
+                    timeout=timeout)
+            except TimeoutError:
+                raise GetTimeoutError(
+                    f"get() timed out pulling {ref.id}") from None
+            except Exception as e:
+                # Source raylet dead or pull failed — surface as lost so
+                # _get_one can attempt lineage recovery.
+                raise ObjectLostError(
+                    f"object {ref.id} could not be pulled from {source}: {e}"
+                ) from None
             name, size = loc
             try:
                 buf = attach_object(name, size)
@@ -530,6 +600,73 @@ class CoreWorker:
                 buf.close()
             return serialization.loads(data)
         raise ObjectLostError(f"object {ref.id} vanished during fetch: {last_err}")
+
+    # ------------------------------------------------------ lineage recovery
+    def _recover_object(self, ref: ObjectRef) -> bool:
+        """Arrange for a lost object to be recomputed. Returns True if a
+        reconstruction was started (or is already in flight) and the caller
+        should re-resolve; False if the object is unrecoverable."""
+        if ref.owner_address in ("", self.address):
+            return self._try_reconstruct(ref.id)
+        try:
+            return bool(self.peer(ref.owner_address).call(
+                "reconstruct_object", {"object_id": ref.id}, timeout=30))
+        except Exception:
+            return False
+
+    def rpc_reconstruct_object(self, conn, req_id, payload):
+        """A borrower's pull failed: recompute the object we own
+        (reference ObjectRecoveryManager::ReconstructObject)."""
+        return self._try_reconstruct(payload["object_id"])
+
+    def _try_reconstruct(self, oid: ObjectID) -> bool:
+        """Owner-side: re-execute the creating task of a lost object
+        (lineage re-execution, reference object_recovery_manager.h:96).
+        Bounded per creating task by lineage_reconstruction_max_retries.
+        Callers must NOT hold _obj_lock: the trailing notifies do network I/O.
+        """
+        cfg = get_config()
+        with self._obj_lock:
+            spec = self._lineage.get(oid)
+            if spec is None:
+                return False
+            # The in-flight check and the pending-table insertion are one
+            # critical section: without it two concurrent getters both see
+            # not-in-flight and double-submit (double execution + one
+            # balancing unpin for two pins).
+            with self._pending_lock:
+                if spec.task_id in self._pending_tasks:
+                    submit = False
+                else:
+                    attempts = self._lineage_attempts.get(spec.task_id, 0)
+                    if attempts >= cfg.lineage_reconstruction_max_retries:
+                        return False
+                    self._lineage_attempts[spec.task_id] = attempts + 1
+                    self._pending_tasks[spec.task_id] = [spec, 0]
+                    submit = True
+            # All returns of the task are recomputed together; reset their
+            # states so concurrent getters block until the re-run reports.
+            for roid in spec.return_object_ids():
+                st = self._objects.get(roid)
+                if st is None:
+                    st = _ObjectState()
+                    self._objects[roid] = st
+                if st.state == "plasma" or submit:
+                    st.state = "pending"
+            if submit:
+                # Re-pin argument objects we own for the duration of the
+                # re-run (balanced by _unpin_after_task on result report);
+                # pinned before the release so the report can't unpin first.
+                for a in spec.args:
+                    if a[0] == "ref" and a[2] == self.address:
+                        self._pin_for_submission(
+                            ObjectRef(a[1], owner_address=a[2]))
+        if submit:
+            logger.info("reconstructing %s by re-executing task %s",
+                        oid, spec.method_name)
+            self._emit_task_event(spec, "SUBMITTED")
+            self.raylet.notify("submit_task", {"spec": spec})
+        return True
 
     # ------------------------------------------------------------------ wait
     def wait(self, refs: List[ObjectRef], num_returns: int, timeout: Optional[float],
@@ -602,19 +739,26 @@ class CoreWorker:
     def rpc_report_task_result(self, conn, req_id, payload):
         """Executor pushed results for a task we own."""
         task_id: TaskID = payload["task_id"]
-        pend = self._pending_tasks.get(task_id)
         # Application-level retry (cf. reference retry_exceptions): resubmit
-        # instead of recording the error while budget remains.
-        if (pend is not None and pend[0].retry_exceptions and pend[1] > 0
-                and any(e[0] == "error" for e in payload["results"])):
-            pend[1] -= 1
+        # instead of recording the error while budget remains. The retry
+        # decision (read budget, decrement, or pop) is atomic so a concurrent
+        # worker-death notification can't double-spend the budget.
+        with self._pending_lock:
+            pend = self._pending_tasks.get(task_id)
+            retry = (pend is not None and pend[0].retry_exceptions and pend[1] > 0
+                     and any(e[0] == "error" for e in payload["results"]))
+            if retry:
+                pend[1] -= 1
+                retries_left = pend[1]
+            else:
+                self._pending_tasks.pop(task_id, None)
+        if retry:
             delay = get_config().task_retry_delay_ms / 1000.0
             spec = pend[0]
-            logger.warning("task %s raised; retrying (%d left)", spec.method_name, pend[1])
+            logger.warning("task %s raised; retrying (%d left)", spec.method_name, retries_left)
             threading.Timer(delay, lambda: self.raylet.notify(
                 "submit_task", {"spec": spec})).start()
             return True
-        self._pending_tasks.pop(task_id, None)
         for entry in payload["results"]:
             kind, oid = entry[0], entry[1]
             with self._obj_lock:
@@ -650,19 +794,24 @@ class CoreWorker:
     def rpc_task_worker_died(self, conn, req_id, payload):
         """Raylet push: the worker running our task died. Retry or fail."""
         task_id: TaskID = payload["task_id"]
-        pend = self._pending_tasks.get(task_id)
-        if pend is None:
-            return True
-        spec, retries_left = pend
-        if retries_left > 0:
-            pend[1] -= 1
+        with self._pending_lock:
+            pend = self._pending_tasks.get(task_id)
+            if pend is None:
+                return True
+            spec = pend[0]
+            retry = pend[1] > 0
+            if retry:
+                pend[1] -= 1
+                retries_left = pend[1]
+            else:
+                self._pending_tasks.pop(task_id, None)
+        if retry:
             logger.warning("task %s worker died; retrying (%d left)",
-                           spec.method_name, pend[1])
+                           spec.method_name, retries_left)
             delay = get_config().task_retry_delay_ms / 1000.0
             threading.Timer(delay, lambda: self.raylet.notify(
                 "submit_task", {"spec": spec})).start()
             return True
-        self._pending_tasks.pop(task_id, None)
         err_blob = serialization.dumps(
             WorkerCrashedError(f"worker died while running {spec.method_name}"))
         for oid in spec.return_object_ids():
@@ -680,7 +829,8 @@ class CoreWorker:
         """Raylet push: task cannot run (e.g. runtime-env creation failed).
         Deterministic — fail the returns without retrying."""
         task_id: TaskID = payload["task_id"]
-        pend = self._pending_tasks.pop(task_id, None)
+        with self._pending_lock:
+            pend = self._pending_tasks.pop(task_id, None)
         if pend is None:
             return True
         spec = pend[0]
@@ -784,7 +934,8 @@ class CoreWorker:
             caller_id=self.worker_id,
         )
         refs = self._register_returns(spec)
-        self._pending_tasks[task_id] = [spec, 0]
+        with self._pending_lock:
+            self._pending_tasks[task_id] = [spec, 0]
         self._emit_task_event(spec, "SUBMITTED")
         self._send_actor_task(actor_id, spec, attempts=0)
         return refs
@@ -831,7 +982,8 @@ class CoreWorker:
         return None
 
     def _fail_task(self, spec: TaskSpec, err: Exception) -> None:
-        self._pending_tasks.pop(spec.task_id, None)
+        with self._pending_lock:
+            self._pending_tasks.pop(spec.task_id, None)
         blob = serialization.dumps(err)
         for oid in spec.return_object_ids():
             with self._obj_lock:
@@ -900,9 +1052,12 @@ class CoreWorker:
     def _fail_inflight_actor_tasks(self, actor_id: ActorID, reason: str) -> None:
         """The actor process died: calls sent to it will never report back.
         Fail their pending return objects so ray.get() unblocks."""
-        for task_id, (spec, _r) in list(self._pending_tasks.items()):
-            if spec.task_type == TaskType.ACTOR_TASK and spec.actor_id == actor_id:
-                self._fail_task(spec, ActorDiedError(reason))
+        with self._pending_lock:
+            doomed = [spec for spec, _r in self._pending_tasks.values()
+                      if spec.task_type == TaskType.ACTOR_TASK
+                      and spec.actor_id == actor_id]
+        for spec in doomed:
+            self._fail_task(spec, ActorDiedError(reason))
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         self.gcs.call("kill_actor", {"actor_id": actor_id, "no_restart": no_restart})
@@ -1000,10 +1155,17 @@ class CoreWorker:
                     _sys.path.insert(0, path)
 
     def _start_exec_threads(self, n: int) -> None:
-        while len(self._exec_threads) < n:
-            t = threading.Thread(target=self._exec_loop, name="task-exec", daemon=True)
-            t.start()
-            self._exec_threads.append(t)
+        # Must be mutually exclusive: for an actor worker this is reached from
+        # BOTH __init__ (mode=="worker") and the _init_actor thread; without
+        # the lock each can observe len() < n and over-spawn, after which a
+        # max_concurrency=1 actor executes queued calls concurrently and the
+        # per-caller FIFO guarantee (reference
+        # transport/actor_scheduling_queue.h) is violated.
+        with self._exec_threads_lock:
+            while len(self._exec_threads) < n:
+                t = threading.Thread(target=self._exec_loop, name="task-exec", daemon=True)
+                t.start()
+                self._exec_threads.append(t)
 
     def _exec_loop(self) -> None:
         while not self._shutdown.is_set():

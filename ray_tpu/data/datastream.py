@@ -86,10 +86,12 @@ def _apply_ops_timed(block: Block, ops: List[tuple]):
     import time
 
     timings = []
-    for op in ops:
+    for idx, op in enumerate(ops):
         t0 = time.perf_counter()
         block = _apply_ops(block, [op])
-        timings.append((op[0], time.perf_counter() - t0))
+        # Keyed by (position, kind): a chain with two map ops gets distinct
+        # per-operator lines instead of one merged bucket.
+        timings.append((idx, op[0], time.perf_counter() - t0))
     return block, timings
 
 
@@ -508,17 +510,17 @@ class Datastream:
         timed = ray_tpu.remote(_apply_ops_timed)
         outs = ray_tpu.get([timed.remote(r, self._ops)
                             for r in self._block_refs])
-        per_op: Dict[str, List[float]] = {}
+        per_op: Dict[int, List[float]] = {}
         total_rows = 0
         for block, timings in outs:
             total_rows += _block_len(block)
-            for kind, seconds in timings:
-                per_op.setdefault(kind, []).append(seconds)
+            for idx, _kind, seconds in timings:
+                per_op.setdefault(idx, []).append(seconds)
         lines = [f"Datastream stats: {len(self._block_refs)} blocks, "
                  f"{total_rows} rows out"]
-        for i, (kind, _fn, *rest) in enumerate(
-                [(op[0], None) for op in self._ops]):
-            times = per_op.get(kind, [])
+        for i, op in enumerate(self._ops):
+            kind = op[0]
+            times = per_op.get(i, [])
             if not times:
                 continue
             lines.append(

@@ -1,10 +1,11 @@
-"""Attention: TPU flash kernel on TPU, reference einsum elsewhere.
+"""Attention: in-repo Pallas flash kernel on TPU, reference einsum elsewhere.
 
-The TPU path uses the Pallas flash-attention kernel that ships with JAX
-(`jax.experimental.pallas.ops.tpu.flash_attention`) — tiled onto the MXU
-with online softmax, O(seq) memory. The reference path is a plain einsum
-attention used on CPU (tests / virtual meshes) and as the ground truth the
-kernels are checked against.
+The TPU path uses this repo's Pallas flash-attention kernels
+(`ray_tpu.ops.pallas.flash_attention`) for BOTH forward and backward —
+tiled onto the MXU with online softmax and a fused FlashAttention-2
+recompute backward, O(seq) memory in each direction. The reference path is
+a plain einsum attention used on CPU (tests / virtual meshes) and as the
+ground truth the kernels are checked against.
 
 GQA (fewer KV heads than Q heads) is handled by repeating KV heads before
 the kernel; XLA turns the repeat into a broadcast so no HBM copy occurs.
@@ -61,18 +62,13 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     v = _repeat_kv(v, n_rep)
     scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
     if _on_tpu() and q.shape[-1] >= 128 and q.shape[-2] >= 128:
-        from jax.experimental.pallas.ops.tpu.flash_attention import (
-            BlockSizes, flash_attention)
+        from ray_tpu.ops.pallas.flash_attention import flash_attention_pallas
 
-        sq, sk = q.shape[-2], k.shape[-2]
-        bq = min(512, sq)
-        bk = min(512, sk)
-        block_sizes = BlockSizes(
-            block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
-            block_q_major_dkv=bq, block_k_major_dkv=bk,
-            block_k_dkv=bk, block_q_dkv=bq,
-            block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
-        )
-        return flash_attention(
-            q, k, v, causal=causal, sm_scale=scale, block_sizes=block_sizes)
+        b, h, sq, d = q.shape
+        sk = k.shape[-2]
+        out = flash_attention_pallas(
+            q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
+            v.reshape(b * h, sk, d), scale, causal,
+            min(512, sq), min(512, sk))
+        return out.reshape(b, h, sq, d)
     return causal_attention_reference(q, k, v, sm_scale=scale, causal=causal)

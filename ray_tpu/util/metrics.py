@@ -11,7 +11,10 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-_registry_lock = threading.Lock()
+# RLock: get_or_create holds it across lookup+construction (the Metric ctor
+# re-enters it to self-register), so two threads can never race to register
+# the same name and split increments across duplicate instances.
+_registry_lock = threading.RLock()
 _registry: List["Metric"] = []
 
 
@@ -126,7 +129,7 @@ def get_or_create(kind: str, name: str, description: str = "",
         for m in _registry:
             if m.name == name:
                 return m
-    return cls(name, description, **kwargs)
+        return cls(name, description, **kwargs)
 
 
 def snapshot(prefix: str = "") -> Dict[str, Dict[str, Any]]:

@@ -1,0 +1,139 @@
+"""Stress tests for the per-caller actor FIFO guarantee.
+
+The reference guarantees per-caller in-order execution of actor tasks via
+sequence numbers on the submit side (`transport/sequential_actor_submit_queue.h`)
+and an ordered scheduling queue on the execute side
+(`transport/actor_scheduling_queue.h`).  Round 1 had a confirmed race: the
+executor-thread spawn was unsynchronized, so a freshly created actor could run
+TWO exec threads and execute queued calls concurrently.  These tests hammer the
+creation window and the multi-caller path.
+"""
+
+import threading
+
+import pytest
+
+import ray_tpu
+
+
+def _log_actor():
+    @ray_tpu.remote
+    class Log:
+        def __init__(self):
+            self.items = []
+
+        def append(self, x):
+            self.items.append(x)
+
+        def get(self):
+            return self.items
+
+    return Log
+
+
+def test_actor_ordering_many_actors(ray_start_regular):
+    """The double-spawn race fires (if present) at actor creation; amplify by
+    creating several actors and immediately flooding each with ordered calls."""
+    Log = _log_actor()
+    actors = [Log.remote() for _ in range(4)]
+    for i in range(100):
+        for a in actors:
+            a.append.remote(i)
+    for a in actors:
+        assert ray_tpu.get(a.get.remote()) == list(range(100))
+
+
+def test_actor_ordering_multi_caller_threads(ray_start_regular):
+    """3 driver threads × 200 calls: each thread's subsequence must appear in
+    submission order (threads share one caller id; the submit-side sequence
+    counter serializes them)."""
+    Log = _log_actor()
+    log = Log.remote()
+    n_threads, n_calls = 3, 200
+
+    def caller(tid):
+        for i in range(n_calls):
+            log.append.remote((tid, i))
+
+    threads = [threading.Thread(target=caller, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    items = ray_tpu.get(log.get.remote())
+    assert len(items) == n_threads * n_calls
+    for tid in range(n_threads):
+        seq = [i for (t, i) in items if t == tid]
+        assert seq == list(range(n_calls)), f"caller {tid} out of order"
+
+
+def test_actor_ordering_multi_caller_actors(ray_start_regular):
+    """3 distinct caller *processes* (worker actors) each push 150 ordered
+    calls into one log actor; per-caller FIFO must hold even though callers
+    race each other."""
+    Log = _log_actor()
+    log = Log.remote()
+
+    @ray_tpu.remote
+    class Caller:
+        def __init__(self, tid, log):
+            self.tid = tid
+            self.log = log
+
+        def run(self, n):
+            for i in range(n):
+                self.log.append.remote((self.tid, i))
+            # Barrier call through the same ordered queue: when it returns,
+            # every append this caller submitted has been executed.
+            return ray_tpu.get(self.log.get.remote()) is not None
+
+    callers = [Caller.remote(t, log) for t in range(3)]
+    assert all(ray_tpu.get([c.run.remote(150) for c in callers]))
+    items = ray_tpu.get(log.get.remote())
+    assert len(items) == 3 * 150
+    for tid in range(3):
+        seq = [i for (t, i) in items if t == tid]
+        assert seq == list(range(150)), f"caller {tid} out of order"
+
+
+def test_actor_ordering_after_restart(ray_start_regular):
+    """A restarting actor resets per-caller sequence numbers; post-restart
+    calls must still execute in order on the new incarnation."""
+
+    @ray_tpu.remote(max_restarts=1)
+    class Log:
+        def __init__(self):
+            self.items = []
+
+        def append(self, x):
+            self.items.append(x)
+
+        def get(self):
+            return self.items
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    log = Log.remote()
+    for i in range(20):
+        log.append.remote(i)
+    assert ray_tpu.get(log.get.remote()) == list(range(20))
+    try:
+        ray_tpu.get(log.die.remote())
+    except Exception:
+        pass
+    # Retry until the new incarnation serves calls, then verify ordering.
+    import time
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get(log.get.remote(), timeout=5)
+            break
+        except Exception:
+            time.sleep(0.2)
+    for i in range(50):
+        log.append.remote(i)
+    assert ray_tpu.get(log.get.remote()) == list(range(50))
