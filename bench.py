@@ -138,7 +138,45 @@ def main() -> None:
         except Exception as e:  # never lose the primary line to the add-on
             result["b1_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    try:
+        # CI smoke path gets a small object: constrained /dev/shm (e.g. 64 MiB
+        # default Docker) must not fail the bench line.
+        result.update(_bench_transfer(512 if on_tpu else 16))
+    except Exception as e:
+        result["transfer_error"] = f"{type(e).__name__}: {e}"[:200]
+
     print(json.dumps(result))
+
+
+def _bench_transfer(size_mib: int = 512) -> dict:
+    """Cross-raylet chunked object transfer throughput (reference
+    release/benchmarks object-transfer envelope): an in-process 2-raylet
+    cluster moves a size_mib object through the pipelined chunk pull path."""
+    import numpy as np
+
+    from ray_tpu.core.cluster import Cluster
+    from ray_tpu.core.ids import ObjectID
+
+    cluster = Cluster()
+    a = cluster.add_node(num_cpus=1, object_store_memory=2 * (size_mib << 20))
+    b = cluster.add_node(num_cpus=1, object_store_memory=2 * (size_mib << 20))
+    try:
+        oid = ObjectID.from_random()
+        a.store.put_bytes(oid, np.ones(size_mib << 20, dtype=np.uint8).data)
+        import ray_tpu.core.rpc as rpc
+
+        cli = rpc.connect_with_retry(b.address, timeout=10)
+        try:
+            t0 = time.perf_counter()
+            cli.call("pull_object", {"object_id": oid, "source": a.address},
+                     timeout=300)
+            dt = time.perf_counter() - t0
+        finally:
+            cli.close()
+        return {"transfer_mib": size_mib,
+                "transfer_gbps": round(size_mib / 1024 / dt * 8, 2)}
+    finally:
+        cluster.shutdown()
 
 
 if __name__ == "__main__":

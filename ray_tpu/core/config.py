@@ -64,6 +64,15 @@ class Config:
     lineage_reconstruction_max_retries: int = 3
     lineage_table_max_entries: int = 10000
 
+    # --- object transfer (cf. reference object_manager.h:117 64MiB chunks,
+    # pull_manager.h:52 admission control, push_manager.h:29) ---
+    object_transfer_chunk_size_bytes: int = 16 * 1024 * 1024
+    object_transfer_inflight_chunks: int = 4
+    object_transfer_chunk_timeout_s: float = 60.0
+    # total bytes of concurrently-admitted chunked pulls per raylet; pulls
+    # beyond it queue rather than overcommitting store memory
+    pull_admission_max_bytes: int = 2 * 1024 * 1024 * 1024
+
     # --- rpc ---
     rpc_connect_timeout_s: float = 30.0
     rpc_call_timeout_s: float = 0.0  # 0 = no timeout

@@ -55,6 +55,40 @@ class _QueuedTask:
     spillback_count: int = 0
 
 
+class _PullBudget:
+    """Byte-budget admission control for chunked pulls (reference
+    PullManager's active-bundle quota, pull_manager.h:52): callers block
+    until their object's bytes fit under the cap, so a burst of huge pulls
+    can't overcommit store memory. Requests larger than the cap are clamped
+    (a single object must always be admittable)."""
+
+    def __init__(self, max_bytes: int):
+        self._max = max(1, max_bytes)
+        self._used = 0
+        self._cv = threading.Condition()
+        self._queue: deque = deque()  # FIFO tickets: no starvation of big pulls
+
+    def acquire(self, n: int) -> None:
+        n = min(n, self._max)
+        ticket = object()
+        with self._cv:
+            self._queue.append(ticket)
+            # Only the queue head may admit: without the ticket order a large
+            # pull starves forever behind a stream of small ones re-grabbing
+            # freed bytes.
+            while self._queue[0] is not ticket or self._used + n > self._max:
+                self._cv.wait(timeout=1.0)
+            self._queue.popleft()
+            self._used += n
+            self._cv.notify_all()  # wake the next head
+
+    def release(self, n: int) -> None:
+        n = min(n, self._max)
+        with self._cv:
+            self._used -= n
+            self._cv.notify_all()
+
+
 class Raylet:
     def __init__(
         self,
@@ -103,6 +137,9 @@ class Raylet:
 
         # object pulls in flight: object_id -> list[(conn, req_id)] waiting
         self._pending_pulls: Dict[ObjectID, List[Tuple]] = {}
+        # admission control for chunked pulls (reference pull_manager.h:52):
+        # bounds the total bytes of concurrently-materializing inbound objects
+        self._pull_budget = _PullBudget(cfg.pull_admission_max_bytes)
 
         self._gcs: Optional[rpc.RpcClient] = None
         self._shutdown = threading.Event()
@@ -784,9 +821,31 @@ class Raylet:
         return self.store.stats()
 
     def rpc_fetch_object(self, conn, req_id, payload):
-        """Peer raylet requests the object bytes (single-shot transfer)."""
+        """Peer raylet requests the object bytes (single-shot transfer;
+        small-object fast path — big objects go through the chunk RPCs)."""
         data = self.store.read_bytes(payload["object_id"])
         return data  # None if not here
+
+    def rpc_fetch_object_meta(self, conn, req_id, payload):
+        """Size probe before a chunked pull (cf. reference object directory)."""
+        loc = self.store.lookup(payload["object_id"])
+        if loc is None:
+            return None
+        return {"size": loc[1]}
+
+    def rpc_fetch_object_chunk(self, conn, req_id, payload):
+        """Serve one bounded slice of a sealed object, read straight out of
+        the shm segment — the sender never materializes the whole object
+        (reference ObjectBufferPool chunk reads, object_manager.proto:61)."""
+        buf = self.store.get_buffer(payload["object_id"])
+        if buf is None:
+            return None
+        try:
+            off = payload["offset"]
+            ln = payload["length"]
+            return bytes(buf.view[off:off + ln])
+        finally:
+            buf.close()
 
     def rpc_pull_object(self, conn, req_id, payload):
         """Worker asks: make object local, reply (name,size) when done.
@@ -814,20 +873,82 @@ class Raylet:
         try:
             if source and source != self._server.address:
                 peer = self._peer(source)
-                data = peer.call("fetch_object", {"object_id": object_id},
-                                 timeout=120)
-                if data is not None:
-                    try:
-                        self.store.put_bytes(object_id, data)
-                    except FileExistsError:
-                        pass
-                else:
+                cfg = get_config()
+                chunk = cfg.object_transfer_chunk_size_bytes
+                meta = peer.call("fetch_object_meta", {"object_id": object_id},
+                                 timeout=30)
+                if meta is None:
                     err = f"object {object_id} not found at {source}"
+                elif meta["size"] <= chunk:
+                    data = peer.call("fetch_object", {"object_id": object_id},
+                                     timeout=cfg.object_transfer_chunk_timeout_s)
+                    if data is not None:
+                        try:
+                            self.store.put_bytes(object_id, data)
+                        except FileExistsError:
+                            pass
+                    else:
+                        err = f"object {object_id} not found at {source}"
+                else:
+                    err = self._pull_chunked(peer, object_id, meta["size"])
             else:
                 err = f"no source for object {object_id}"
         except Exception as e:
             err = f"pull failed: {e}"
         self._resolve_pulls(object_id, err)
+
+    def _pull_chunked(self, peer: rpc.RpcClient, object_id: ObjectID,
+                      size: int) -> Optional[str]:
+        """Stream a big object in pipelined chunks directly into a
+        pre-created shm segment, sealing after the last chunk (reference
+        ObjectManager 64 MiB chunk pulls) — peak extra memory is
+        inflight_chunks * chunk_size, not 2x the object.
+
+        Returns an error string, or None on success."""
+        cfg = get_config()
+        chunk = cfg.object_transfer_chunk_size_bytes
+        self._pull_budget.acquire(size)
+        try:
+            try:
+                shm = self.store.create(object_id, size)
+            except FileExistsError:
+                # A local producer (e.g. lineage re-execution) or another pull
+                # beat us to the entry — but it may be UNSEALED; report success
+                # only once it seals, else waiters get a spurious lost-object.
+                deadline = time.monotonic() + cfg.object_transfer_chunk_timeout_s
+                while time.monotonic() < deadline:
+                    if self.store.contains(object_id):
+                        return None
+                    time.sleep(0.05)
+                return f"local copy of {object_id} never sealed"
+            ok = False
+            try:
+                inflight: deque = deque()
+                offset = 0
+                while offset < size or inflight:
+                    while (offset < size
+                           and len(inflight) < cfg.object_transfer_inflight_chunks):
+                        ln = min(chunk, size - offset)
+                        inflight.append((offset, ln, peer.call_future(
+                            "fetch_object_chunk",
+                            {"object_id": object_id, "offset": offset,
+                             "length": ln})))
+                        offset += ln
+                    off, ln, fut = inflight.popleft()
+                    data = fut.result(timeout=cfg.object_transfer_chunk_timeout_s)
+                    if data is None or len(data) != ln:
+                        return (f"chunk at {off} of {object_id} unavailable "
+                                f"at {peer.address}")
+                    shm.buf[off:off + ln] = data
+                ok = True
+            finally:
+                shm.close()
+                if not ok:
+                    self.store.delete(object_id)  # discard partial segment
+            self.store.seal(object_id)
+            return None
+        finally:
+            self._pull_budget.release(size)
 
     def _resolve_pulls(self, object_id: ObjectID, err: Optional[str] = None) -> None:
         with self._lock:

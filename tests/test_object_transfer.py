@@ -1,0 +1,113 @@
+"""Chunked streaming object transfer (reference `object_manager.h:117`
+64 MiB chunk push/pull, `pull_manager.h:52` admission control): big objects
+stream between raylets in pipelined chunks written directly into a
+pre-created shm segment — peak transient memory is inflight_chunks *
+chunk_size, not 2x the object."""
+
+import os
+import tracemalloc
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.cluster import Cluster
+from ray_tpu.core.config import get_config
+
+
+@pytest.fixture
+def transfer_cluster():
+    """Two nodes with a small chunk size so mid-size objects exercise the
+    chunked path (raylets are in-process, so config edits reach them)."""
+    cfg = get_config()
+    saved = (cfg.object_transfer_chunk_size_bytes,
+             cfg.object_transfer_inflight_chunks)
+    cfg.object_transfer_chunk_size_bytes = 1 << 20  # 1 MiB
+    cfg.object_transfer_inflight_chunks = 3
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2, resources={"a": 1})
+    cluster.add_node(num_cpus=2, resources={"b": 1})
+    cluster.connect()
+    yield cluster
+    cluster.shutdown()
+    (cfg.object_transfer_chunk_size_bytes,
+     cfg.object_transfer_inflight_chunks) = saved
+
+
+def test_chunked_transfer_roundtrip(transfer_cluster):
+    """40 MiB object produced on node a, consumed on node b: 40 pipelined
+    1 MiB chunks must reassemble exactly."""
+
+    @ray_tpu.remote(resources={"a": 1})
+    def produce():
+        rng = np.random.default_rng(7)
+        return rng.integers(0, 255, size=40 << 20, dtype=np.uint8)
+
+    @ray_tpu.remote(resources={"b": 1})
+    def digest(arr):
+        import hashlib
+
+        return hashlib.sha256(arr.tobytes()).hexdigest(), int(arr.sum())
+
+    ref = produce.remote()
+    got_hash, got_sum = ray_tpu.get(digest.remote(ref), timeout=180)
+    expected = np.random.default_rng(7).integers(0, 255, size=40 << 20,
+                                                 dtype=np.uint8)
+    import hashlib
+
+    assert got_hash == hashlib.sha256(expected.tobytes()).hexdigest()
+    assert got_sum == int(expected.sum())
+
+
+def test_chunked_transfer_ragged_tail(transfer_cluster):
+    """Object size not a multiple of the chunk size: last partial chunk."""
+
+    @ray_tpu.remote(resources={"a": 1})
+    def produce():
+        return np.arange((5 << 20) // 8 + 13, dtype=np.float64)
+
+    @ray_tpu.remote(resources={"b": 1})
+    def tail(arr):
+        return float(arr[-1]), arr.shape[0]
+
+    last, n = ray_tpu.get(tail.remote(produce.remote()), timeout=120)
+    assert n == (5 << 20) // 8 + 13
+    assert last == float(n - 1)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(os.environ.get("RAY_TPU_BIG_TRANSFER", "0") != "1",
+                    reason="4 GiB transfer: set RAY_TPU_BIG_TRANSFER=1")
+def test_4gib_transfer_no_memory_spike():
+    """VERDICT done-criterion: a 4 GiB cross-node get without a 2x memory
+    spike. The raylets live in this process, so tracemalloc sees the pull
+    path's transient heap: it must stay far below the object size (the old
+    single-frame pull double-buffered the whole 4 GiB through the RPC
+    layer)."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2, resources={"a": 1},
+                     object_store_memory=6 << 30)
+    cluster.add_node(num_cpus=2, resources={"b": 1},
+                     object_store_memory=6 << 30)
+    cluster.connect()
+    try:
+        @ray_tpu.remote(resources={"a": 1})
+        def produce():
+            return np.ones(4 << 27, dtype=np.float64)  # 4 GiB
+
+        @ray_tpu.remote(resources={"b": 1})
+        def consume(arr):
+            return float(arr[0]), float(arr[-1]), arr.nbytes
+
+        ref = produce.remote()
+        ray_tpu.wait([ref], num_returns=1, timeout=300)
+        tracemalloc.start()
+        first, last, nbytes = ray_tpu.get(consume.remote(ref), timeout=600)
+        _cur, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        assert (first, last) == (1.0, 1.0)
+        assert nbytes == 4 << 30
+        # chunk pipeline bound: inflight(4) * chunk(16 MiB) + slack << 1 GiB
+        assert peak < 1 << 30, f"pull path heap peak {peak/2**20:.0f} MiB"
+    finally:
+        cluster.shutdown()
