@@ -139,9 +139,11 @@ def main() -> None:
             result["b1_error"] = f"{type(e).__name__}: {e}"[:200]
 
     try:
-        # CI smoke path gets a small object: constrained /dev/shm (e.g. 64 MiB
-        # default Docker) must not fail the bench line.
-        result.update(_bench_transfer(512 if on_tpu else 16))
+        # CI smoke path gets a smaller object — but still > the 16 MiB chunk
+        # size, so the measured path IS the pipelined chunk pull (a size at
+        # or under the chunk threshold would silently bench the single-shot
+        # fast path instead).
+        result.update(_bench_transfer(512 if on_tpu else 24))
     except Exception as e:
         result["transfer_error"] = f"{type(e).__name__}: {e}"[:200]
 

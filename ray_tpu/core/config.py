@@ -63,6 +63,15 @@ class Config:
     # many creating specs the owner retains (FIFO-evicted beyond this).
     lineage_reconstruction_max_retries: int = 3
     lineage_table_max_entries: int = 10000
+    # Grace before freeing a plasma object whose ref was serialized outward:
+    # absorbs the window where a receiver's add_borrower notify is in flight
+    # while the owner's last local ref dies (lineage recovery is the backstop
+    # if the race is still lost).
+    object_free_grace_period_ms: int = 500
+
+    # --- data streaming executor (cf. reference streaming_executor.py:45:
+    # operator-level backpressure; here: bounded in-flight block tasks) ---
+    data_max_inflight_blocks: int = 8
 
     # --- object transfer (cf. reference object_manager.h:117 64MiB chunks,
     # pull_manager.h:52 admission control, push_manager.h:29) ---

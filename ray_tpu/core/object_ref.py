@@ -14,12 +14,29 @@ from ray_tpu.core.ids import ObjectID
 
 
 class ObjectRef:
-    __slots__ = ("id", "owner_address", "_call_site")
+    # _counted: this instance holds one unit of the distributed refcount and
+    # releases it on GC (reference RemoveLocalReference). Only instances
+    # created through a counting path (task returns, put, deserialization)
+    # set it; ad-hoc internal ObjectRef(...) constructions never release.
+    __slots__ = ("id", "owner_address", "_call_site", "_counted")
 
     def __init__(self, object_id: ObjectID, owner_address: Optional[str] = None, call_site: str = ""):
         self.id = object_id
         self.owner_address = owner_address
         self._call_site = call_site
+        self._counted = False
+
+    def __del__(self):
+        if not getattr(self, "_counted", False):
+            return
+        try:
+            from ray_tpu.core import worker as _worker_mod
+
+            w = _worker_mod.current_worker()
+            if w is not None and not w._shutdown.is_set():
+                w.reference_counter.remove_local(self)
+        except Exception:
+            pass  # interpreter teardown
 
     def binary(self) -> bytes:
         return self.id.binary()
@@ -54,10 +71,16 @@ class ObjectRef:
 
 def _rebuild_ref(object_id, owner_address, call_site):
     ref = ObjectRef(object_id, owner_address, call_site)
-    # When deserialized inside a running worker, register as borrowed.
+    # Register the materialized instance with the ownership layer: borrowed
+    # (+notify owner) off-owner, a plain local ref on the owner. Either way
+    # this instance now holds one refcount unit and releases it on GC.
     from ray_tpu.core import worker as _worker_mod
 
     w = _worker_mod.current_worker()
     if w is not None:
-        w.reference_counter.add_borrowed(ref)
+        if owner_address and owner_address == w.address:
+            w.add_local_ref(object_id)
+        else:
+            w.reference_counter.add_borrowed(ref)
+        ref._counted = True
     return ref

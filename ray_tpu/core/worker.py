@@ -25,6 +25,7 @@ import queue
 import threading
 import time
 import traceback
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -80,6 +81,8 @@ class _ObjectState:
     local_refs: int = 0
     borrowers: int = 0
     submitted_task_deps: int = 0    # in-flight tasks depending on this object
+    shipped: bool = False           # a ref to this object was serialized out
+    free_after: Optional[float] = None  # deferred-free deadline (monotonic)
     waiters: List[Tuple] = field(default_factory=list)  # (conn, req_id) info waiters
 
 
@@ -133,11 +136,11 @@ class ReferenceCounter:
         if e is None:
             self._worker._remove_owned_local_ref(ref.id)
         elif notify_owner is not None:
-            try:
-                self._worker.peer(notify_owner).notify(
-                    "remove_borrower", {"object_id": ref.id})
-            except Exception:
-                logger.debug("remove_borrower notify to %s failed", notify_owner)
+            # Off-thread: remove_local runs from ObjectRef.__del__, and
+            # peer() can block up to rpc_connect_timeout_s reconnecting to a
+            # dead owner — never stall whatever thread triggered the GC.
+            self._worker._notify_owner_async(
+                notify_owner, "remove_borrower", {"object_id": ref.id})
 
 
 # ---------------------------------------------------------------------------
@@ -176,6 +179,13 @@ class CoreWorker:
         # Insertion-ordered; FIFO-evicted at lineage_table_max_entries.
         self._lineage: Dict[ObjectID, TaskSpec] = {}
         self._lineage_attempts: Dict[TaskID, int] = {}
+
+        # grace-deferred plasma frees (see _maybe_free)
+        self._deferred_frees: deque = deque()
+        self._free_sweeper: Optional[threading.Thread] = None
+        # background owner notifications (ref releases from __del__)
+        self._owner_notify_q: "queue.Queue[Tuple[str, str, dict]]" = queue.Queue()
+        self._owner_notify_thread: Optional[threading.Thread] = None
 
         self._task_counter = _TaskIDCounter(self.worker_id)
         self._put_counter = 0
@@ -376,7 +386,9 @@ class CoreWorker:
                     self._objects[oid] = st
                 st.state = "pending"
                 st.local_refs += 1
-                refs.append(ObjectRef(oid, owner_address=self.address))
+                r = ObjectRef(oid, owner_address=self.address)
+                r._counted = True
+                refs.append(r)
                 if spec.task_type == TaskType.NORMAL:
                     self._lineage[oid] = spec
             while len(self._lineage) > cfg.lineage_table_max_entries:
@@ -399,10 +411,15 @@ class CoreWorker:
                 self._pin_for_submission(a)
             else:
                 s = serialization.serialize(a)
+                self._mark_shipped(s.contained_refs)
                 if s.total_bytes <= cfg.max_direct_call_object_size:
                     out.append(("value", s.to_bytes()))
                 else:
                     ref = self.put(a)
+                    # Pin: the promoted ref's only Python instance dies right
+                    # here, so without the task-dep pin the object would be
+                    # freed before the executor fetches it.
+                    self._pin_for_submission(ref)
                     out.append(("ref", ref.id, ref.owner_address))
         return out
 
@@ -413,6 +430,17 @@ class CoreWorker:
             st = self._objects.get(ref.id)
             if st is not None:
                 st.submitted_task_deps += 1
+                st.shipped = True  # the executor materializes a borrow
+
+    def _mark_shipped(self, refs) -> None:
+        """Mark owned objects whose refs were serialized into an outgoing
+        payload: their frees get the borrow-in-flight grace period."""
+        for r in refs or ():
+            if r.owner_address == self.address:
+                with self._obj_lock:
+                    st = self._objects.get(r.id)
+                    if st is not None:
+                        st.shipped = True
 
     def _unpin_after_task(self, spec: TaskSpec) -> None:
         for a in spec.args:
@@ -452,8 +480,13 @@ class CoreWorker:
                 st.location = self.raylet_address
                 st.size = s.total_bytes
                 self._obj_cv.notify_all()
+        # Refs nested in the stored value: shipping them into the store means
+        # borrows can materialize later from any reader.
+        self._mark_shipped(s.contained_refs)
         self._notify_info_waiters(oid)
-        return ObjectRef(oid, owner_address=self.address)
+        ref = ObjectRef(oid, owner_address=self.address)
+        ref._counted = True
+        return ref
 
     def _put_to_store(self, oid: ObjectID, s: SerializedObject) -> None:
         """Write a serialized object into the node store (zero-copy write)."""
@@ -779,6 +812,13 @@ class CoreWorker:
                     st.inline_blob = entry[2]
                 self._obj_cv.notify_all()
             self._notify_info_waiters(oid)
+            # The last ref may have died while the task was still pending
+            # (_maybe_free's pending guard kept the entry); now that the
+            # state is terminal, free if fully unreferenced.
+            with self._obj_lock:
+                st = self._objects.get(oid)
+                if st is not None:
+                    self._maybe_free(oid, st)
         if pend is not None:
             self._unpin_after_task(pend[0])
         return True
@@ -880,12 +920,31 @@ class CoreWorker:
                 st.local_refs += 1
 
     def _maybe_free(self, oid: ObjectID, st: _ObjectState) -> None:
-        """Caller holds _obj_lock. Free the object when fully unreferenced."""
+        """Caller holds _obj_lock. Free the object when fully unreferenced.
+
+        Objects whose refs were serialized outward get a grace period before
+        the plasma delete: a receiver's add_borrower notify may still be in
+        flight when the owner's last local ref dies (the reference resolves
+        this with the full borrow-table protocol, reference_count.h:834; the
+        grace window + lineage recovery approximate it)."""
         if st.local_refs > 0 or st.borrowers > 0 or st.submitted_task_deps > 0:
+            st.free_after = None
             return
         if st.state == "pending":
             return  # task still running; lineage bookkeeping keeps it
+        if st.shipped and st.state in ("plasma", "inline"):
+            # Inline objects race identically: the receiver's add_borrower
+            # notify may be in flight when the owner's last ref dies.
+            if st.free_after is None:
+                st.free_after = (time.monotonic()
+                                 + get_config().object_free_grace_period_ms / 1000.0)
+                self._deferred_frees.append(oid)
+                self._ensure_free_sweeper()
+            return
         self._objects.pop(oid, None)
+        self._delete_plasma(oid, st)
+
+    def _delete_plasma(self, oid: ObjectID, st: _ObjectState) -> None:
         if st.state == "plasma" and st.location:
             try:
                 if st.location == self.raylet_address:
@@ -894,6 +953,66 @@ class CoreWorker:
                     self.peer(st.location).notify("obj_delete", {"object_id": oid})
             except Exception:
                 pass
+
+    def _notify_owner_async(self, owner: str, method: str, payload: dict) -> None:
+        self._owner_notify_q.put((owner, method, payload))
+        t = self._owner_notify_thread
+        if t is None or not t.is_alive():
+            t = threading.Thread(target=self._owner_notify_loop,
+                                 name="owner-notify", daemon=True)
+            self._owner_notify_thread = t
+            t.start()
+
+    def _owner_notify_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                owner, method, payload = self._owner_notify_q.get(timeout=5)
+            except queue.Empty:
+                if self._owner_notify_q.empty():
+                    return  # idle: exit; next release restarts the thread
+                continue
+            try:
+                self.peer(owner).notify(method, payload)
+            except Exception:
+                logger.debug("%s notify to %s failed", method, owner)
+
+    def _ensure_free_sweeper(self) -> None:
+        if self._free_sweeper is None or not self._free_sweeper.is_alive():
+            t = threading.Thread(target=self._free_sweep_loop,
+                                 name="free-sweeper", daemon=True)
+            self._free_sweeper = t
+            t.start()
+
+    def _free_sweep_loop(self) -> None:
+        while not self._shutdown.is_set():
+            time.sleep(0.1)
+            due: List[Tuple[ObjectID, _ObjectState]] = []
+            now = time.monotonic()
+            with self._obj_lock:
+                remaining: deque = deque()
+                while self._deferred_frees:
+                    oid = self._deferred_frees.popleft()
+                    st = self._objects.get(oid)
+                    if st is None or st.free_after is None:
+                        continue  # resurrected or already freed
+                    if st.free_after > now:
+                        remaining.append(oid)
+                        continue
+                    if (st.local_refs > 0 or st.borrowers > 0
+                            or st.submitted_task_deps > 0):
+                        st.free_after = None  # a borrow landed within grace
+                        continue
+                    self._objects.pop(oid, None)
+                    due.append((oid, st))
+                self._deferred_frees = remaining
+                if not self._deferred_frees and not due:
+                    # Nothing left: exit instead of idling forever. Cleared
+                    # under _obj_lock, which every _ensure_free_sweeper caller
+                    # holds, so a concurrent deferral can't miss the restart.
+                    self._free_sweeper = None
+                    return
+            for oid, st in due:
+                self._delete_plasma(oid, st)
 
     # --------------------------------------------------------------- actors
     def create_actor(self, spec: ActorCreationSpec, class_name: str) -> None:
@@ -1233,6 +1352,9 @@ class CoreWorker:
             cfg = get_config()
             for oid, v in zip(spec.return_object_ids(), values):
                 s = serialization.serialize(v)
+                # Own refs nested in a return value (e.g. an actor handing out
+                # refs to objects it created) escape to the caller.
+                self._mark_shipped(s.contained_refs)
                 if s.total_bytes <= cfg.max_direct_call_object_size:
                     results.append(("inline", oid, s.to_bytes()))
                 else:

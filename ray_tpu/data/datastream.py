@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import builtins
 import functools
+from collections import deque
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Union
 
 import numpy as np
@@ -334,9 +335,31 @@ class Datastream:
     def _executed_refs(self) -> List[ObjectRef]:
         return self.materialize()._block_refs
 
+    def _stream_refs(self, max_inflight: Optional[int] = None) -> Iterator[ObjectRef]:
+        """Backpressured streaming execution (reference
+        `_internal/execution/streaming_executor.py:45`): yield executed block
+        refs in order while keeping at most `max_inflight` block tasks
+        submitted-but-unconsumed, so consumption drives submission and a
+        dataset far larger than the object store streams through a bounded
+        window instead of flooding it."""
+        if not self._ops:
+            yield from self._block_refs
+            return
+        if max_inflight is None:
+            from ray_tpu.core.config import get_config
+
+            max_inflight = get_config().data_max_inflight_blocks
+        inflight: deque = deque()
+        for r in self._block_refs:
+            if len(inflight) >= max_inflight:
+                yield inflight.popleft()
+            inflight.append(_exec_block.remote(r, self._ops))
+        while inflight:
+            yield inflight.popleft()
+
     # ----------------------------------------------------------- consumers
     def count(self) -> int:
-        return sum(_block_len(b) for b in ray_tpu.get(self._executed_refs()))
+        return sum(_block_len(ray_tpu.get(r)) for r in self._stream_refs())
 
     def _column_reduce(self, col: str, block_fn, combine):
         task = ray_tpu.remote(
@@ -442,7 +465,7 @@ class Datastream:
 
     def take(self, limit: int = 20) -> List[Any]:
         out: List[Any] = []
-        for ref in self._executed_refs():
+        for ref in self._stream_refs():
             out.extend(_block_rows(ray_tpu.get(ref)))
             if len(out) >= limit:
                 break
@@ -450,12 +473,12 @@ class Datastream:
 
     def take_all(self) -> List[Any]:
         out: List[Any] = []
-        for ref in self._executed_refs():
+        for ref in self._stream_refs():
             out.extend(_block_rows(ray_tpu.get(ref)))
         return out
 
     def schema(self) -> Optional[Dict[str, Any]]:
-        for ref in self._executed_refs():
+        for ref in self._stream_refs():
             b = ray_tpu.get(ref)
             if _block_len(b):
                 if isinstance(b, dict):
@@ -469,16 +492,16 @@ class Datastream:
         return len(self._block_refs)
 
     def iter_rows(self) -> Iterator[Any]:
-        for ref in self._executed_refs():
+        for ref in self._stream_refs():
             yield from _block_rows(ray_tpu.get(ref))
 
     def iter_batches(self, *, batch_size: int = 256,
                      batch_format: str = "numpy",
                      drop_last: bool = False) -> Iterator[Block]:
-        """Stream batches; blocks execute as tasks ahead of consumption."""
-        refs = self._executed_refs()
+        """Stream batches; a bounded window of block tasks executes ahead of
+        consumption (backpressure — consumption drives submission)."""
         carry: Optional[Block] = None
-        for ref in refs:
+        for ref in self._stream_refs():
             block = ray_tpu.get(ref)
             if carry is not None:
                 block = _concat_blocks([carry, block])
@@ -546,10 +569,12 @@ class Datastream:
         return [Datastream(r) for r in out]
 
     def streaming_split(self, n: int, *, equal: bool = True) -> List["DataIterator"]:
-        """Per-consumer iterators fed by a coordinator actor (SURVEY §H)."""
-        refs = self._executed_refs()
+        """Per-consumer iterators fed by a coordinator actor (SURVEY §H).
+        Block tasks execute lazily inside the coordinator as consumers pull
+        (one block of prefetch per consumer) — the full pipeline output is
+        never resident at once."""
         coord = _SplitCoordinator.options(num_cpus=0).remote(
-            [r for r in refs], n)
+            list(self._block_refs), n, list(self._ops))
         return [DataIterator(coord, i) for i in builtins.range(n)]
 
     def __repr__(self):
@@ -780,19 +805,39 @@ class GroupedData:
 
 @ray_tpu.remote
 class _SplitCoordinator:
-    """Serves block refs round-robin to n consumers, epoch-synchronized."""
+    """Serves block refs round-robin to n consumers, epoch-synchronized.
 
-    def __init__(self, refs: List[ObjectRef], n: int):
+    Blocks with pending ops execute lazily on demand (reference
+    StreamSplitDataIterator over the streaming executor,
+    `stream_split_iterator.py:41`): each next_block submits the consumer's
+    block if needed plus one block of prefetch, so at most ~2 executed
+    blocks per consumer are resident at a time."""
+
+    def __init__(self, refs: List[ObjectRef], n: int, ops: Optional[list] = None):
         self.refs = refs
         self.n = n
+        self.ops = list(ops or [])
         self.epoch_positions: Dict[int, int] = {}
+        self._prefetched: Dict[int, Any] = {}  # pos -> executed block ref
+
+    def _executed(self, pos: int):
+        if not self.ops:
+            return self.refs[pos]
+        ref = self._prefetched.pop(pos, None)
+        if ref is None:
+            ref = _exec_block.remote(self.refs[pos], self.ops)
+        return ref
 
     def next_block(self, consumer: int):
         pos = self.epoch_positions.get(consumer, consumer)
         if pos >= len(self.refs):
             return None
         self.epoch_positions[consumer] = pos + self.n
-        return self.refs[pos]
+        ref = self._executed(pos)
+        nxt = pos + self.n
+        if self.ops and nxt < len(self.refs) and nxt not in self._prefetched:
+            self._prefetched[nxt] = _exec_block.remote(self.refs[nxt], self.ops)
+        return ref
 
     def reset(self, consumer: int):
         self.epoch_positions[consumer] = consumer

@@ -219,3 +219,35 @@ def test_cluster_resources(ray_start_regular):
     total = ray_tpu.cluster_resources()
     assert total.get("CPU") == 4.0
     assert total.get("TPU") == 8.0
+
+
+def test_dropped_ref_frees_object_after_completion(ray_start_regular):
+    """A counted ref GC'd while its task is still pending must still free
+    the object once the result reports (the pending guard in _maybe_free
+    defers, rpc_report_task_result re-checks)."""
+    import time
+
+    import numpy as np
+
+    from ray_tpu.core.worker import current_worker
+
+    @ray_tpu.remote
+    def big():
+        import time as t
+
+        t.sleep(0.3)
+        return np.ones(1 << 19)  # ~4 MiB -> plasma
+
+    r = big.remote()
+    oid = r.id
+    del r  # dies while the task is pending
+    w = current_worker()
+    deadline = time.monotonic() + 30
+    present = True
+    while time.monotonic() < deadline:
+        with w._obj_lock:
+            present = oid in w._objects
+        if not present:
+            break
+        time.sleep(0.1)
+    assert not present, "owner table leaked an object dropped while pending"
