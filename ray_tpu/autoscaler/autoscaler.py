@@ -39,7 +39,9 @@ class StandardAutoscaler:
                  node_types: List[NodeType],
                  update_interval_s: float = 1.0,
                  idle_timeout_s: float = 60.0):
-        self.gcs = rpc.connect_with_retry(gcs_address)
+        # Reconnecting: the autoscaler must survive a GCS restart (its demand
+        # polls would otherwise raise RpcDisconnected forever).
+        self.gcs = rpc.ReconnectingClient(gcs_address)
         self.provider = provider
         self.node_types = {t.name: t for t in node_types}
         self.update_interval_s = update_interval_s

@@ -18,8 +18,8 @@ from ray_tpu.core.raylet import Raylet
 
 
 class Cluster:
-    def __init__(self):
-        self.gcs = GcsServer()
+    def __init__(self, gcs_snapshot_path: Optional[str] = None):
+        self.gcs = GcsServer(snapshot_path=gcs_snapshot_path)
         self.gcs.start()
         self._raylets: list[Raylet] = []
         self.head: Optional[Raylet] = None
@@ -54,6 +54,17 @@ class Cluster:
         import ray_tpu
 
         return ray_tpu.init(address=self.gcs.address, **init_kwargs)
+
+    def restart_gcs(self) -> None:
+        """Kill and restart the GCS on the SAME address (reference
+        test_gcs_fault_tolerance.py pattern): raylets, drivers and actor
+        workers detect the drop and re-register over their reconnecting
+        clients, rebuilding live cluster state."""
+        host, port = self.gcs.address.rsplit(":", 1)
+        snapshot = self.gcs._snapshot_path
+        self.gcs.stop()
+        self.gcs = GcsServer(host=host, snapshot_path=snapshot, port=int(port))
+        self.gcs.start()
 
     def remove_node(self, raylet: Raylet) -> None:
         """Simulate node failure: kill raylet + its workers abruptly."""

@@ -39,14 +39,18 @@ CH_LOGS = "logs"        # worker stdout/stderr fan-out to drivers
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1",
                  snapshot_path: Optional[str] = None,
-                 snapshot_interval_s: float = 5.0):
+                 snapshot_interval_s: float = 5.0,
+                 port: int = 0):
         """`snapshot_path` enables control-plane persistence: the durable
         tables (internal KV and the job table) checkpoint to disk and
         reload on the next start — the role Redis plays for the reference's
         HA GCS (`gcs_table_storage.h`, `redis_client.h`). Runtime state
-        (live nodes/actors/PGs) re-registers via heartbeats and is
-        deliberately not persisted."""
-        self._server = rpc.RpcServer(host)
+        (live nodes/actors/PGs) is NOT persisted: raylets and actor workers
+        detect the restart and re-register over their reconnecting clients
+        (reference gcs_redis_failure_detector + component resubscribe), so
+        live state is rebuilt from its sources of truth. A fixed `port`
+        lets a restarted GCS come back on the same address."""
+        self._server = rpc.RpcServer(host, port)
         self._server.register_all(self)
         self._lock = threading.RLock()
         self._snapshot_path = snapshot_path
@@ -72,6 +76,9 @@ class GcsServer:
         self._actor_specs: Dict[ActorID, ActorCreationSpec] = {}
         self._actor_owners: Dict[ActorID, str] = {}
         self._named_actors: Dict[tuple, ActorID] = {}  # (namespace, name) -> id
+
+        # actors restored from a snapshot, awaiting worker re-registration
+        self._awaiting_rereg: Dict[ActorID, float] = {}
 
         # placement groups
         self._pgs: Dict[PlacementGroupID, dict] = {}
@@ -128,9 +135,29 @@ class GcsServer:
                         job["status"] = "FAILED"
                         job.setdefault("end_time", time.time())
                     self._jobs[jid] = job
-            logger.info("GCS restored %d KV namespaces, %d jobs from %s",
+                # Actors come back as awaiting-re-registration: their budget
+                # and identity restore from the snapshot, liveness only from
+                # the worker's reregister_actor (the source of truth). The
+                # health loop reaps those that never re-announce.
+                for aid, m in data.get("actor_meta", {}).items():
+                    info = ActorInfo(
+                        actor_id=aid, name=m["name"], namespace=m["namespace"],
+                        state=ActorState.RESTARTING,
+                        max_restarts=m["max_restarts"],
+                        num_restarts=m["num_restarts"],
+                        class_name=m.get("class_name", ""),
+                    )
+                    self._actors[aid] = info
+                    self._actor_owners[aid] = m.get("owner", "")
+                    if m.get("spec") is not None:
+                        self._actor_specs[aid] = m["spec"]
+                    if m["name"]:
+                        self._named_actors[(m["namespace"], m["name"])] = aid
+                    self._awaiting_rereg[aid] = time.monotonic()
+            logger.info("GCS restored %d KV namespaces, %d jobs, %d actor "
+                        "records from %s",
                         len(self._kv), len(data.get("jobs", {})),
-                        self._snapshot_path)
+                        len(data.get("actor_meta", {})), self._snapshot_path)
         except Exception:
             logger.exception("snapshot restore failed; starting fresh")
 
@@ -140,7 +167,21 @@ class GcsServer:
         with self._snapshot_write_lock:  # stop() vs loop: one writer at a time
             with self._lock:
                 data = {"kv": {ns: dict(t) for ns, t in self._kv.items()},
-                        "jobs": dict(self._jobs)}
+                        "jobs": dict(self._jobs),
+                        # durable actor metadata: restart budgets, names and
+                        # owners survive a GCS restart (reference persists the
+                        # actor table in Redis, gcs_table_storage.h:50)
+                        "actor_meta": {
+                            aid: {"name": i.name, "namespace": i.namespace,
+                                  "max_restarts": i.max_restarts,
+                                  "num_restarts": i.num_restarts,
+                                  "class_name": i.class_name,
+                                  "owner": self._actor_owners.get(aid, ""),
+                                  # full creation spec: restart-on-failure of
+                                  # a restored actor needs the class blob
+                                  "spec": self._actor_specs.get(aid)}
+                            for aid, i in self._actors.items()
+                            if i.state != ActorState.DEAD}}
                 self._dirty = False
             try:
                 tmp = f"{self._snapshot_path}.tmp{os.getpid()}"
@@ -233,10 +274,12 @@ class GcsServer:
                 "address": payload["address"],
                 "object_store_address": payload.get("object_store_address", payload["address"]),
                 "resources_total": dict(payload["resources"]),
-                "resources_available": dict(payload["resources"]),
+                # re-registration after a GCS restart reports true availability
+                "resources_available": dict(
+                    payload.get("resources_available", payload["resources"])),
                 "labels": payload.get("labels", {}),
                 "alive": True,
-                "start_time": time.time(),
+                "start_time": payload.get("start_time") or time.time(),
             }
             self._last_heartbeat[node_id] = time.monotonic()
             try:
@@ -328,6 +371,25 @@ class GcsServer:
             for nid in dead:
                 logger.warning("node %s missed heartbeats; marking dead", nid.hex()[:8])
                 self._mark_node_dead(nid, "health check failed")
+            # Reap snapshot-restored actors whose worker never re-announced
+            # (the process died together with the old GCS's view of it).
+            reap = []
+            with self._lock:
+                for aid, since in list(self._awaiting_rereg.items()):
+                    if now - since > 60.0:
+                        self._awaiting_rereg.pop(aid, None)
+                        info = self._actors.get(aid)
+                        if info is not None and info.state == ActorState.RESTARTING:
+                            reap.append(aid)
+            for aid in reap:
+                with self._lock:
+                    info = self._actors[aid]
+                    info.state = ActorState.DEAD
+                    info.death_cause = "did not re-register after GCS restart"
+                    self._dirty = True
+                self._publish(CH_ACTORS, {
+                    "actor_id": aid, "state": "DEAD", "address": "",
+                    "death_cause": info.death_cause})
 
     def _mark_node_dead(self, node_id: bytes, reason: str) -> None:
         with self._lock:
@@ -468,6 +530,12 @@ class GcsServer:
         spec: ActorCreationSpec = payload["spec"]
         owner_address: str = payload.get("owner_address", "")
         with self._lock:
+            # Idempotent: a retried register (reconnecting client re-sending
+            # after the reply was lost in a GCS crash) must not schedule a
+            # second worker for the same actor id.
+            existing_info = self._actors.get(spec.actor_id)
+            if existing_info is not None and existing_info.state != ActorState.DEAD:
+                return {"ok": True}
             if spec.name:
                 key = (spec.namespace, spec.name)
                 if key in self._named_actors:
@@ -486,6 +554,7 @@ class GcsServer:
             self._actors[spec.actor_id] = info
             self._actor_specs[spec.actor_id] = spec
             self._actor_owners[spec.actor_id] = owner_address
+            self._dirty = True
         ok = self._schedule_actor(spec.actor_id)
         if not ok:
             err = (f"no feasible node for actor resources {spec.resources} "
@@ -503,7 +572,11 @@ class GcsServer:
         """Pick a node for the actor and ask its raylet to create it
         (cf. GcsActorScheduler::Schedule, gcs_actor_scheduler.cc:49)."""
         with self._lock:
-            spec = self._actor_specs[actor_id]
+            spec = self._actor_specs.get(actor_id)
+            if spec is None:
+                # Snapshot-restored actor whose spec didn't survive and whose
+                # worker never re-registered: nothing to schedule from.
+                return False
             views = [
                 NodeView(nid, n["resources_total"], n["resources_available"], n["labels"])
                 for nid, n in self._nodes.items()
@@ -531,7 +604,20 @@ class GcsServer:
         with self._lock:
             info = self._actors.get(actor_id)
             if info is None:
-                return False
+                spec: Optional[ActorCreationSpec] = payload.get("spec")
+                if spec is None or not payload.get("success", True):
+                    return False
+                # The GCS restarted between dispatching this creation and its
+                # completion: rebuild the record from the worker's spec so
+                # the actor still becomes ALIVE.
+                info = ActorInfo(
+                    actor_id=actor_id, name=spec.name,
+                    namespace=spec.namespace, state=ActorState.PENDING,
+                    max_restarts=spec.max_restarts, class_name="")
+                self._actors[actor_id] = info
+                self._actor_specs[actor_id] = spec
+                if spec.name:
+                    self._named_actors[(spec.namespace, spec.name)] = actor_id
             if payload.get("success", True):
                 info.state = ActorState.ALIVE
                 info.address = payload["address"]
@@ -539,8 +625,45 @@ class GcsServer:
             else:
                 info.state = ActorState.DEAD
                 info.death_cause = payload.get("error", "creation failed")
+            self._dirty = True
         self._publish(CH_ACTORS, {"actor_id": actor_id, "state": info.state.value,
                                   "address": info.address, "death_cause": info.death_cause})
+        return True
+
+    def rpc_reregister_actor(self, conn, req_id, payload):
+        """A live actor worker re-announces itself after a GCS restart
+        (reference: GCS rebuilds the actor table from Redis +
+        resubscription; here the worker IS the source of truth). Restores
+        the ALIVE record, the creation spec (so restart-on-failure still
+        works) and the named-actor binding."""
+        actor_id: ActorID = payload["actor_id"]
+        spec: Optional[ActorCreationSpec] = payload.get("spec")
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None:
+                # No snapshot record: rebuild identity from the spec. The
+                # restart budget (num_restarts) is preserved whenever the
+                # snapshot had it — a GCS restart must not reset it.
+                info = ActorInfo(
+                    actor_id=actor_id,
+                    name=spec.name if spec else None,
+                    namespace=spec.namespace if spec else "",
+                    state=ActorState.ALIVE,
+                    max_restarts=spec.max_restarts if spec else 0,
+                )
+                self._actors[actor_id] = info
+            info.state = ActorState.ALIVE
+            info.address = payload["address"]
+            info.node_id = payload.get("node_id")
+            self._awaiting_rereg.pop(actor_id, None)
+            if spec is not None:
+                self._actor_specs[actor_id] = spec
+                if spec.name:
+                    self._named_actors[(spec.namespace, spec.name)] = actor_id
+            self._dirty = True
+        self._publish(CH_ACTORS, {"actor_id": actor_id, "state": "ALIVE",
+                                  "address": payload["address"],
+                                  "death_cause": ""})
         return True
 
     def rpc_actor_failed(self, conn, req_id, payload):
@@ -561,6 +684,7 @@ class GcsServer:
             else:
                 info.state = ActorState.DEAD
                 info.death_cause = reason
+            self._dirty = True
         if info.state == ActorState.RESTARTING:
             self._publish(CH_ACTORS, {"actor_id": actor_id, "state": info.state.value,
                                       "address": "", "death_cause": ""})

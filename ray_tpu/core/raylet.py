@@ -142,19 +142,19 @@ class Raylet:
         self._pull_budget = _PullBudget(cfg.pull_admission_max_bytes)
 
         self._gcs: Optional[rpc.RpcClient] = None
+        self._start_time = time.time()
         self._shutdown = threading.Event()
         self._threads: List[threading.Thread] = []
 
     # ------------------------------------------------------------------ boot
     def start(self) -> str:
         self._server.start()
-        self._gcs = rpc.connect_with_retry(self.gcs_address, push_handler=self._on_gcs_push)
-        reply = self._gcs.call("register_node", {
-            "node_id": self.node_id.binary(),
-            "address": self._server.address,
-            "resources": self.resources_total,
-            "labels": self.labels,
-        })
+        # Reconnecting link: a restarted GCS gets this node re-registered and
+        # re-subscribed before any other call proceeds (GCS fault tolerance).
+        self._gcs = rpc.ReconnectingClient(
+            self.gcs_address, push_handler=self._on_gcs_push,
+            on_reconnect=self._replay_gcs_registration)
+        reply = self._gcs.call("register_node", self._registration_payload())
         for n in reply["nodes"]:
             self._note_node(n)
         self._gcs.call("subscribe", {"channels": ["resources", "nodes", "control"]})
@@ -171,6 +171,31 @@ class Raylet:
     @property
     def address(self) -> str:
         return self._server.address
+
+    def _registration_payload(self) -> dict:
+        with self._lock:
+            available = dict(self.resources_available)
+        return {
+            "node_id": self.node_id.binary(),
+            "address": self._server.address,
+            "resources": self.resources_total,
+            # On RE-registration the node may be mid-load: a restarted GCS
+            # must not advertise full capacity for a saturated node.
+            "resources_available": available,
+            "labels": self.labels,
+            "start_time": self._start_time,
+        }
+
+    def _replay_gcs_registration(self, raw: rpc.RpcClient) -> None:
+        """Re-register on a fresh GCS connection (uses the RAW client — the
+        wrapper's lock is held during replay)."""
+        reply = raw.call("register_node", self._registration_payload(), timeout=30)
+        for n in reply.get("nodes", []):
+            self._note_node(n)
+        raw.call("subscribe", {"channels": ["resources", "nodes", "control"]},
+                 timeout=30)
+        logger.info("raylet %s re-registered with restarted GCS",
+                    self.node_id.hex()[:8])
 
     def stop(self) -> None:
         self._shutdown.set()

@@ -330,6 +330,122 @@ class RpcCallError(RuntimeError):
     """Remote handler raised; message contains remote traceback."""
 
 
+class ReconnectingClient:
+    """Client that transparently re-establishes a lost connection (reference
+    retryable gRPC clients, `src/ray/rpc/grpc_util.h`): `call()` retries
+    across one reconnect, `notify()` is best-effort, and `on_reconnect(raw)`
+    replays session state (registrations, subscriptions) on every fresh
+    connection before other calls proceed. Built for long-lived links to the
+    control plane, which may restart (GCS fault tolerance)."""
+
+    def __init__(self, address: str,
+                 push_handler: Optional[Callable[[str, Any], None]] = None,
+                 timeout: float = 30.0,
+                 on_reconnect: Optional[Callable[["RpcClient"], None]] = None,
+                 reconnect_timeout: float = 30.0):
+        self.address = address
+        self._push_handler = push_handler
+        self._on_reconnect = on_reconnect
+        self._reconnect_timeout = reconnect_timeout
+        self._lock = threading.Lock()
+        self._closed = False
+        self._reconnecting = False
+        self._client = self._connect(timeout)
+
+    def _connect(self, timeout: float) -> RpcClient:
+        # Eager recovery: a drop triggers a background reconnect so even a
+        # process that never initiates calls (an idle actor worker) promptly
+        # re-registers with a restarted control plane.
+        return connect_with_retry(
+            self.address, timeout=timeout, push_handler=self._push_handler,
+            on_disconnect=self._schedule_reconnect)
+
+    def _schedule_reconnect(self) -> None:
+        if self._closed or self._reconnecting:
+            return
+
+        def run():
+            self._reconnecting = True
+            try:
+                time.sleep(0.2)
+                while not self._closed:
+                    try:
+                        self._live_client()
+                        return
+                    except Exception:
+                        time.sleep(1.0)
+            finally:
+                self._reconnecting = False
+
+        threading.Thread(target=run, name="rpc-reconnect", daemon=True).start()
+
+    def _live_client(self) -> RpcClient:
+        cli = self._client
+        if cli is not None and not cli.closed:
+            return cli
+        with self._lock:
+            if self._closed:
+                raise RpcDisconnected(f"client to {self.address} closed")
+            cli = self._client
+            if cli is not None and not cli.closed:
+                return cli
+            cli = self._connect(self._reconnect_timeout)
+            try:
+                if self._closed:
+                    # close() raced the reconnect: never install or register
+                    # a connection for a torn-down component (ghost nodes).
+                    raise RpcDisconnected(f"client to {self.address} closed")
+                # Replay registrations while holding the lock so concurrent
+                # calls can't race ahead of re-registration on the new link.
+                # A FAILED replay must not install the client: the process
+                # would be connected-but-unregistered forever (heartbeats
+                # accepted, node absent from the cluster view).
+                if self._on_reconnect is not None:
+                    self._on_reconnect(cli)
+            except Exception:
+                cli.close()
+                raise
+            self._client = cli
+            return cli
+
+    def call(self, method: str, payload: Any = None,
+             timeout: Optional[float] = None) -> Any:
+        for attempt in (0, 1):
+            try:
+                return self._live_client().call(method, payload, timeout=timeout)
+            except RpcDisconnected:
+                if attempt:
+                    raise
+        raise RpcDisconnected(f"call {method} to {self.address} failed")
+
+    def notify(self, method: str, payload: Any = None) -> None:
+        """Best-effort AND non-blocking: while the link is down the message
+        is dropped and a background reconnect is kicked off — callers are
+        fire-and-forget paths (task events, resource reports) that must
+        never stall an exec thread or RPC loop for a connect timeout."""
+        cli = self._client
+        if cli is None or cli.closed:
+            self._schedule_reconnect()
+            return
+        try:
+            cli.notify(method, payload)
+        except Exception:
+            self._schedule_reconnect()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        # Deliberately not taking _lock: an in-flight reconnect may hold it
+        # for a full connect timeout; it re-checks _closed post-connect and
+        # self-closes instead of installing.
+        self._closed = True
+        cli = self._client
+        if cli is not None:
+            cli.close()
+
+
 def connect_with_retry(address: str, timeout: float = 30.0, **kw) -> RpcClient:
     deadline = time.monotonic() + timeout
     last: Exception | None = None

@@ -186,6 +186,7 @@ class CoreWorker:
         # background owner notifications (ref releases from __del__)
         self._owner_notify_q: "queue.Queue[Tuple[str, str, dict]]" = queue.Queue()
         self._owner_notify_thread: Optional[threading.Thread] = None
+        self._owner_notify_lock = threading.Lock()
 
         self._task_counter = _TaskIDCounter(self.worker_id)
         self._put_counter = 0
@@ -233,7 +234,12 @@ class CoreWorker:
         self.raylet = rpc.connect_with_retry(
             raylet_address, push_handler=self._on_raylet_push,
             timeout=connect_timeout or get_config().rpc_connect_timeout_s)
-        self.gcs = rpc.connect_with_retry(gcs_address, push_handler=self._on_gcs_push)
+        # Reconnecting control-plane link: survives a GCS restart by
+        # re-registering this process's durable facts (job, subscriptions,
+        # hosted actor) on every fresh connection.
+        self.gcs = rpc.ReconnectingClient(
+            gcs_address, push_handler=self._on_gcs_push,
+            on_reconnect=self._replay_gcs_state)
 
         # Visible to task code before the first task can possibly arrive.
         set_current_worker(self)
@@ -956,20 +962,27 @@ class CoreWorker:
 
     def _notify_owner_async(self, owner: str, method: str, payload: dict) -> None:
         self._owner_notify_q.put((owner, method, payload))
-        t = self._owner_notify_thread
-        if t is None or not t.is_alive():
-            t = threading.Thread(target=self._owner_notify_loop,
-                                 name="owner-notify", daemon=True)
-            self._owner_notify_thread = t
-            t.start()
+        # The lock pairs with the loop's exit decision: either the live
+        # thread sees our item (queue non-empty under the lock), or it has
+        # cleared _owner_notify_thread and we start a fresh one — an item
+        # can never be stranded behind a thread that decided to exit.
+        with self._owner_notify_lock:
+            t = self._owner_notify_thread
+            if t is None or not t.is_alive():
+                t = threading.Thread(target=self._owner_notify_loop,
+                                     name="owner-notify", daemon=True)
+                self._owner_notify_thread = t
+                t.start()
 
     def _owner_notify_loop(self) -> None:
         while not self._shutdown.is_set():
             try:
                 owner, method, payload = self._owner_notify_q.get(timeout=5)
             except queue.Empty:
-                if self._owner_notify_q.empty():
-                    return  # idle: exit; next release restarts the thread
+                with self._owner_notify_lock:
+                    if self._owner_notify_q.empty():
+                        self._owner_notify_thread = None
+                        return  # idle: next release starts a fresh thread
                 continue
             try:
                 self.peer(owner).notify(method, payload)
@@ -1136,6 +1149,29 @@ class CoreWorker:
                              daemon=True).start()
         return q
 
+    def _replay_gcs_state(self, raw: rpc.RpcClient) -> None:
+        """Rebuild this process's GCS-side state after a GCS restart (uses
+        the RAW client — the reconnecting wrapper's lock is held)."""
+        if self.mode == "driver":
+            raw.call("register_job", {
+                "job_id": self.job_id.binary(),
+                "driver_address": self._server.address,
+            }, timeout=30)
+            channels = ["actors"]
+            if self.log_to_driver:
+                channels.append("logs")
+            raw.call("subscribe", {"channels": channels}, timeout=30)
+        if self.actor_id is not None and self._actor_instance is not None:
+            spec = self._actor_creation_spec
+            raw.call("reregister_actor", {
+                "actor_id": self.actor_id,
+                "address": self.address,
+                "node_id": self.node_id,
+                "spec": spec,
+            }, timeout=30)
+            logger.info("actor %s re-registered with restarted GCS",
+                        self.actor_id)
+
     def _on_gcs_push(self, method: str, payload) -> None:
         if method != "pubsub":
             return
@@ -1248,9 +1284,12 @@ class CoreWorker:
             self._actor_instance = cls(*args, **kwargs)
             n = max(1, spec.max_concurrency)
             self._start_exec_threads(n)
+            # spec included so a GCS that restarted DURING our __init__ (and
+            # so never saw the registration) can rebuild the actor record.
             self.gcs.call("actor_creation_done", {
                 "actor_id": spec.actor_id, "success": True,
-                "address": self.address, "node_id": self.node_id})
+                "address": self.address, "node_id": self.node_id,
+                "spec": spec})
         except Exception as e:
             logger.exception("actor creation failed")
             self.gcs.call("actor_creation_done", {
