@@ -46,6 +46,7 @@ from ray_tpu.core.exceptions import (
     TaskError,
     ActorError,
     ActorDiedError,
+    OutOfMemoryError,
     WorkerCrashedError,
     ObjectLostError,
     GetTimeoutError,
@@ -76,6 +77,7 @@ __all__ = [
     "TaskError",
     "ActorError",
     "ActorDiedError",
+    "OutOfMemoryError",
     "WorkerCrashedError",
     "ObjectLostError",
     "GetTimeoutError",

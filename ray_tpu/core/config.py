@@ -69,6 +69,18 @@ class Config:
     # if the race is still lost).
     object_free_grace_period_ms: int = 500
 
+    # --- memory monitor (cf. reference memory_monitor.h:52 +
+    # worker_killing_policy.h:34: kill retriable tasks under node pressure) ---
+    memory_monitor_refresh_ms: int = 250
+    memory_usage_threshold: float = 0.95
+    # 0 = monitor whole-node memory via psutil; >0 = budget for the summed
+    # RSS of this raylet's task workers (deterministic for tests/containers)
+    memory_monitor_worker_budget_bytes: int = 0
+    # don't kill a task younger than this (it hasn't allocated yet), and
+    # wait this long between kills (let the previous kill's memory return)
+    memory_monitor_min_task_age_ms: int = 500
+    memory_monitor_kill_cooldown_ms: int = 1000
+
     # --- data streaming executor (cf. reference streaming_executor.py:45:
     # operator-level backpressure; here: bounded in-flight block tasks) ---
     data_max_inflight_blocks: int = 8

@@ -55,6 +55,12 @@ class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died unexpectedly."""
 
 
+class OutOfMemoryError(WorkerCrashedError):
+    """The raylet's memory monitor killed the worker under node memory
+    pressure and the task's retry budget is exhausted (reference
+    worker_killing_policy.h:34 + OutOfMemoryError in ray.exceptions)."""
+
+
 class ObjectLostError(RayTpuError):
     """An object was lost (e.g. node died) and could not be reconstructed."""
 

@@ -42,6 +42,7 @@ class WorkerHandle:
     proc: Optional[subprocess.Popen] = None
     actor_id: Optional[ActorID] = None    # dedicated actor worker
     current_task: Optional[TaskSpec] = None
+    task_started: float = 0.0             # monotonic start of current_task
     idle_since: float = field(default_factory=time.monotonic)
     env_key: Optional[str] = None         # pip runtime-env pool this worker serves
     is_driver: bool = False
@@ -143,6 +144,9 @@ class Raylet:
 
         self._gcs: Optional[rpc.RpcClient] = None
         self._start_time = time.time()
+        # workers we SIGKILLed for memory pressure: their death notification
+        # carries reason="oom" so exhausted retries surface OutOfMemoryError
+        self._oom_killed: set = set()
         self._shutdown = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -164,6 +168,10 @@ class Raylet:
         t2 = threading.Thread(target=self._reaper_loop, name="raylet-reaper", daemon=True)
         t2.start()
         self._threads.append(t2)
+        t3 = threading.Thread(target=self._memory_monitor_loop,
+                              name="raylet-memory-monitor", daemon=True)
+        t3.start()
+        self._threads.append(t3)
         logger.info("raylet %s on %s resources=%s", self.node_id.hex()[:8],
                     self._server.address, self.resources_total)
         return self._server.address
@@ -425,9 +433,11 @@ class Raylet:
             actor_id = handle.actor_id
         if self._shutdown.is_set():
             return
+        was_oom = wid in self._oom_killed
+        self._oom_killed.discard(wid)
         if spec is not None:
             self._release_resources(spec)
-            self._notify_owner_worker_died(spec)
+            self._notify_owner_worker_died(spec, reason="oom" if was_oom else "")
         self._release_actor_charge(handle)
         if actor_id is not None:
             try:
@@ -444,13 +454,100 @@ class Raylet:
         except Exception:
             logger.warning("could not notify owner of failed task %s", spec.task_id)
 
-    def _notify_owner_worker_died(self, spec: TaskSpec) -> None:
-        from ray_tpu.core.exceptions import WorkerCrashedError
+    def _notify_owner_worker_died(self, spec: TaskSpec, reason: str = "") -> None:
         try:
             owner = self._peer(spec.owner_address)
-            owner.notify("task_worker_died", {"task_id": spec.task_id})
+            owner.notify("task_worker_died",
+                         {"task_id": spec.task_id, "reason": reason})
         except Exception:
             logger.warning("could not notify owner of dead worker for task %s", spec.task_id)
+
+    # ---------------------------------------------------------- memory guard
+    def _memory_monitor_loop(self) -> None:
+        """Node memory watchdog (reference MemoryMonitor, memory_monitor.h:52):
+        when usage crosses the watermark, SIGKILL a worker running the
+        NEWEST retriable task (reference retriable-LIFO killing policy,
+        worker_killing_policy.h:34). The owner resubmits it (kills are
+        cooldown-paced so a retry has a window to succeed); with retries
+        exhausted the caller sees OutOfMemoryError."""
+        try:
+            import psutil
+        except ImportError:
+            return
+        cfg = get_config()
+        period = cfg.memory_monitor_refresh_ms / 1000.0
+        last_kill = 0.0
+        while not self._shutdown.wait(period):
+            try:
+                usage = self._memory_usage_fraction(psutil)
+            except Exception:
+                continue
+            if usage <= cfg.memory_usage_threshold:
+                continue
+            # Cooldown between kills: a SIGKILLed worker's memory takes time
+            # to return to the OS; killing every tick would cascade through
+            # innocent workers before pressure can drop.
+            now = time.monotonic()
+            if now - last_kill < cfg.memory_monitor_kill_cooldown_ms / 1000.0:
+                continue
+            if self._kill_memory_victim(usage):
+                last_kill = time.monotonic()
+
+    def _kill_memory_victim(self, usage: float) -> bool:
+        """Pick, flag and SIGKILL atomically under the lock so the signal
+        can't land on a worker that finished its task (or became an actor
+        worker) between selection and kill."""
+        cfg = get_config()
+        min_age = cfg.memory_monitor_min_task_age_ms / 1000.0
+        now = time.monotonic()
+        with self._lock:
+            candidates = [
+                w for w in self._workers.values()
+                if w.current_task is not None and not w.is_driver
+                and w.actor_id is None and now - w.task_started >= min_age]
+            if not candidates:
+                return False
+            # Retriable first, newest first (cheapest work to redo); never
+            # drivers or actor workers (actor death is a bigger blast
+            # radius — reference group-by-owner policy escalates there).
+            retriable = [w for w in candidates
+                         if w.current_task.max_retries != 0]
+            pool = retriable or candidates
+            victim = max(pool, key=lambda w: w.task_started)
+            logger.warning(
+                "memory pressure %.0f%% > %.0f%%: killing worker %d running "
+                "task %s", usage * 100,
+                get_config().memory_usage_threshold * 100, victim.pid,
+                victim.current_task.method_name)
+            self._oom_killed.add(victim.worker_id)
+            try:
+                if victim.proc is not None:
+                    victim.proc.kill()
+                else:
+                    os.kill(victim.pid, 9)
+            except Exception:
+                self._oom_killed.discard(victim.worker_id)
+                return False
+        return True
+
+    def _memory_usage_fraction(self, psutil) -> float:
+        cfg = get_config()
+        budget = cfg.memory_monitor_worker_budget_bytes
+        if budget > 0:
+            # Budget mode counts only the workers the kill policy may touch:
+            # actor-held memory must not trigger an endless kill loop of
+            # innocent task workers it can never relieve.
+            with self._lock:
+                pids = [w.pid for w in self._workers.values()
+                        if not w.is_driver and w.actor_id is None]
+            total = 0
+            for pid in pids:
+                try:
+                    total += psutil.Process(pid).memory_info().rss
+                except Exception:
+                    pass
+            return total / budget
+        return psutil.virtual_memory().percent / 100.0
 
     def _reaper_loop(self) -> None:
         """Reap dead spawned processes + kill long-idle workers."""
@@ -573,6 +670,7 @@ class Raylet:
                     continue
                 self._charge_resources(spec, demand)
                 handle.current_task = spec
+                handle.task_started = time.monotonic()
                 handle.conn.push("execute_task", {"spec": spec})
                 dispatched_any = True
             self._queue = pending

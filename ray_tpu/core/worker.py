@@ -852,14 +852,22 @@ class CoreWorker:
             else:
                 self._pending_tasks.pop(task_id, None)
         if retry:
-            logger.warning("task %s worker died; retrying (%d left)",
-                           spec.method_name, retries_left)
+            logger.warning("task %s worker died (%s); retrying (%d left)",
+                           spec.method_name, payload.get("reason") or "crash",
+                           retries_left)
             delay = get_config().task_retry_delay_ms / 1000.0
             threading.Timer(delay, lambda: self.raylet.notify(
                 "submit_task", {"spec": spec})).start()
             return True
-        err_blob = serialization.dumps(
-            WorkerCrashedError(f"worker died while running {spec.method_name}"))
+        if payload.get("reason") == "oom":
+            from ray_tpu.core.exceptions import OutOfMemoryError
+
+            err_blob = serialization.dumps(OutOfMemoryError(
+                f"task {spec.method_name} was killed by the memory monitor "
+                f"under node memory pressure (retries exhausted)"))
+        else:
+            err_blob = serialization.dumps(WorkerCrashedError(
+                f"worker died while running {spec.method_name}"))
         for oid in spec.return_object_ids():
             with self._obj_lock:
                 st = self._objects.get(oid)

@@ -1,0 +1,65 @@
+"""Memory monitor + retriable-task killing (reference
+`src/ray/common/memory_monitor.h:52`, `worker_killing_policy.h:34`): under
+node memory pressure the raylet SIGKILLs the worker running the newest
+retriable task; owners retry it, so an over-subscribing fleet completes
+under a cap that can't hold all tasks at once."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.cluster import Cluster
+from ray_tpu.core.config import get_config
+
+
+@pytest.fixture
+def tight_memory_cluster():
+    """Worker-RSS budget of ~1 GiB with 4 CPU slots: four concurrent
+    ~450 MiB tasks oversubscribe it roughly 2x."""
+    cfg = get_config()
+    saved = (cfg.memory_monitor_worker_budget_bytes,
+             cfg.memory_usage_threshold, cfg.memory_monitor_refresh_ms)
+    cfg.memory_monitor_worker_budget_bytes = 1 << 30
+    cfg.memory_usage_threshold = 0.9
+    cfg.memory_monitor_refresh_ms = 100
+    cluster = Cluster()
+    cluster.add_node(num_cpus=4)
+    cluster.connect()
+    yield cluster
+    cluster.shutdown()
+    (cfg.memory_monitor_worker_budget_bytes,
+     cfg.memory_usage_threshold, cfg.memory_monitor_refresh_ms) = saved
+
+
+def test_oversubscribed_fleet_completes(tight_memory_cluster):
+    @ray_tpu.remote(max_retries=10)
+    def hog(i):
+        import numpy as np
+        import time as t
+
+        ballast = np.ones((450 << 20) // 8)  # ~450 MiB
+        t.sleep(1.0)
+        return i + int(ballast[0])
+
+    refs = [hog.remote(i) for i in range(8)]
+    out = ray_tpu.get(refs, timeout=300)
+    assert out == [i + 1 for i in range(8)]
+
+
+def test_oom_error_when_retries_exhausted(tight_memory_cluster):
+    """A non-retriable hog that ALWAYS trips the monitor must surface
+    OutOfMemoryError, not hang or a bare crash."""
+
+    @ray_tpu.remote(max_retries=0)
+    def hog():
+        import numpy as np
+        import time as t
+
+        ballast = np.ones((1200 << 20) // 8)  # alone exceeds the budget
+        t.sleep(30.0)
+        return int(ballast[0])
+
+    with pytest.raises(ray_tpu.WorkerCrashedError) as ei:
+        ray_tpu.get(hog.remote(), timeout=120)
+    assert isinstance(ei.value, ray_tpu.OutOfMemoryError)
