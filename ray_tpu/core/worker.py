@@ -84,6 +84,7 @@ class _ObjectState:
     shipped: bool = False           # a ref to this object was serialized out
     free_after: Optional[float] = None  # deferred-free deadline (monotonic)
     waiters: List[Tuple] = field(default_factory=list)  # (conn, req_id) info waiters
+    callbacks: List[Callable] = field(default_factory=list)  # done callbacks
 
 
 class ReferenceCounter:
@@ -223,6 +224,9 @@ class CoreWorker:
         self._registered = threading.Event()
         self._task_queue: "queue.Queue[TaskSpec]" = queue.Queue()
         self._executing_count = 0
+        # executing+queued actor tasks excluding control-plane probes, so a
+        # load reading is never inflated by the health checks that sample it
+        self._load_count = 0
         self._exec_count_lock = threading.Lock()
         self._profile_flush_lock = threading.Lock()
         self._profile_events_sent = 0
@@ -762,18 +766,40 @@ class CoreWorker:
             return {"kind": "error", "data": st.inline_blob}
         return {"kind": "plasma", "raylet": st.location, "size": st.size}
 
+    def add_done_callback(self, ref: ObjectRef, cb: Callable[[], None]) -> None:
+        """Invoke `cb` (cheap, non-blocking!) when the owned object reaches a
+        terminal state — the thread-free alternative to polling/`get_async`
+        for completion accounting (e.g. Serve's in-flight router counts).
+        Fires immediately if already terminal; runs on the RPC reader thread
+        otherwise."""
+        with self._obj_lock:
+            st = self._objects.get(ref.id)
+            if st is not None and st.state == "pending":
+                st.callbacks.append(cb)
+                return
+        try:
+            cb()
+        except Exception:
+            logger.exception("done callback failed")
+
     def _notify_info_waiters(self, oid: ObjectID) -> None:
         with self._obj_lock:
             st = self._objects.get(oid)
             if st is None or st.state == "pending":
                 return
             waiters, st.waiters = st.waiters, []
+            callbacks, st.callbacks = st.callbacks, []
             payload = self._info_payload(st)
         for conn, req_id in waiters:
             try:
                 conn.reply(req_id, payload)
             except Exception:
                 pass
+        for cb in callbacks:
+            try:
+                cb()
+            except Exception:
+                logger.exception("done callback failed")
 
     def rpc_report_task_result(self, conn, req_id, payload):
         """Executor pushed results for a task we own."""
@@ -829,13 +855,18 @@ class CoreWorker:
             self._unpin_after_task(pend[0])
         return True
 
+    _PROBE_METHODS = frozenset({"health", "__ray_ready__", "__ray_terminate__"})
+
     def rpc_actor_stats(self, conn, req_id, payload):
         """Out-of-band load probe: executing + queued task counts, answered
         from the RPC thread so it can NOT be delayed by the exec queue it
         measures (Serve autoscaling reads this; cf. reference replicas
-        pushing queue metrics to the controller out-of-band)."""
+        pushing queue metrics to the controller out-of-band). `load` excludes
+        control-plane probes (health checks) that would otherwise inflate
+        every sample by the probe itself."""
         return {"executing": self._executing_count,
-                "queued": self._task_queue.qsize()}
+                "queued": self._task_queue.qsize(),
+                "load": self._load_count}
 
     def rpc_task_worker_died(self, conn, req_id, payload):
         """Raylet push: the worker running our task died. Retry or fail."""
@@ -1248,6 +1279,15 @@ class CoreWorker:
             logger.info("worker exiting on raylet request")
             os._exit(0)
 
+    def _enqueue_actor_task(self, spec: TaskSpec) -> None:
+        # Load accounting happens HERE — only for tasks that actually enter
+        # the exec queue (the matching decrement runs at execution end);
+        # duplicate/stranded pushes must not inflate the load reading.
+        if spec.method_name not in self._PROBE_METHODS:
+            with self._exec_count_lock:
+                self._load_count += 1
+        self._task_queue.put(spec)
+
     def rpc_push_actor_task(self, conn, req_id, payload) -> None:
         """Direct actor transport target (callers push here)."""
         spec: TaskSpec = payload["spec"]
@@ -1256,12 +1296,12 @@ class CoreWorker:
             expected = self._actor_next_seq.get(caller, 0)
             if spec.sequence_number == expected:
                 self._actor_next_seq[caller] = expected + 1
-                self._task_queue.put(spec)
+                self._enqueue_actor_task(spec)
                 # flush any buffered successors
                 buf = self._actor_ooo_buffer.get(caller, {})
                 nxt = expected + 1
                 while nxt in buf:
-                    self._task_queue.put(buf.pop(nxt))
+                    self._enqueue_actor_task(buf.pop(nxt))
                     self._actor_next_seq[caller] = nxt + 1
                     nxt += 1
             else:
@@ -1422,6 +1462,9 @@ class CoreWorker:
             self._tls.placement_group_id = prev_pg
             with self._exec_count_lock:
                 self._executing_count -= 1
+                if (spec.task_type == TaskType.ACTOR_TASK
+                        and spec.method_name not in self._PROBE_METHODS):
+                    self._load_count -= 1
         self._emit_task_event(spec, "FAILED" if failed else "FINISHED")
         self.flush_profile_events(min_events=1)
         try:

@@ -78,10 +78,16 @@ class ServeController:
         self._deployments: Dict[str, dict] = {}
         self._replicas: Dict[str, List[Any]] = {}
         self._versions: Dict[str, int] = {}
+        self._version_cv = threading.Condition()
         self._probes: Dict[str, dict] = {}  # deployment -> {replica: ref}
         self._shutdown = False
         self._thread = threading.Thread(target=self._reconcile_loop, daemon=True)
         self._thread.start()
+
+    def _bump_version(self, name: str) -> None:
+        with self._version_cv:
+            self._versions[name] = self._versions.get(name, 0) + 1
+            self._version_cv.notify_all()
 
     # -------------------------------------------------------------- deploy
     def deploy(self, name: str, def_blob: bytes, init_args, init_kwargs,
@@ -95,7 +101,7 @@ class ServeController:
                     ray_tpu.kill(r)
                 except Exception:
                     pass
-            self._versions[name] = self._versions.get(name, 0) + 1
+            self._bump_version(name)
         self._deployments[name] = {
             "def_blob": def_blob,
             "init_args": init_args,
@@ -119,7 +125,7 @@ class ServeController:
                 ray_tpu.kill(r)
             except Exception:
                 pass
-        self._versions[name] = self._versions.get(name, 0) + 1
+        self._bump_version(name)
         return d is not None
 
     def shutdown(self):
@@ -131,11 +137,15 @@ class ServeController:
     # ----------------------------------------------------------- discovery
     def get_replicas(self, name: str, known_version: int = -1,
                      timeout_s: float = 2.0):
-        """Versioned long-poll (reference LongPollHost, long_poll.py:186)."""
+        """Versioned long-poll (reference LongPollHost, long_poll.py:186):
+        event-driven — the wait wakes on the version bump, not a poll."""
         deadline = time.monotonic() + timeout_s
-        while (self._versions.get(name, 0) == known_version
-               and time.monotonic() < deadline):
-            time.sleep(0.05)
+        with self._version_cv:
+            while self._versions.get(name, 0) == known_version:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._version_cv.wait(timeout=remaining)
         return {
             "version": self._versions.get(name, 0),
             "replicas": list(self._replicas.get(name, [])),
@@ -211,7 +221,7 @@ class ServeController:
                     pass
         if dead:
             self._replicas[name] = [r for r in replicas if r not in dead]
-            self._versions[name] = self._versions.get(name, 0) + 1
+            self._bump_version(name)
 
     def _reconcile_one(self, name: str):
         d = self._deployments.get(name)
@@ -235,7 +245,7 @@ class ServeController:
                 pass
             changed = True
         if changed:
-            self._versions[name] = self._versions.get(name, 0) + 1
+            self._bump_version(name)
 
     def _evict_stats_client(self, replica) -> None:
         cache = getattr(self, "_stats_clients", None)
@@ -287,7 +297,10 @@ class ServeController:
         for r in replicas:
             try:
                 stats = self._worker_stats(r)
-                qlens.append(stats["executing"] + stats["queued"])
+                # `load` excludes our own health probes (they queue on the
+                # same worker and would inflate every sample by 1)
+                qlens.append(stats.get(
+                    "load", stats["executing"] + stats["queued"]))
             except Exception:
                 # partial stats must not drive scaling: a wrongly-low total
                 # would trigger a scale-down of an overloaded deployment
@@ -313,30 +326,86 @@ class ServeController:
 
 class DeploymentHandle:
     """Routes calls to replicas: power-of-two-choices over client-side
-    in-flight counts (reference router.py:263)."""
+    in-flight counts (reference router.py:263). Thread-free data plane: the
+    in-flight decrement is a completion callback on the ownership layer
+    (no per-request thread), and replica-set updates arrive via ONE
+    background long-poll loop per handle (reference LongPollClient,
+    long_poll.py:68) instead of per-request controller polls."""
 
     def __init__(self, deployment_name: str, method_name: str = "__call__"):
         self._name = deployment_name
         self._method = method_name
         self._version = -1
         self._replicas: List[Any] = []
-        self._inflight: Dict[int, int] = {}
+        # keyed by replica actor id, NOT list index: a replica-set change
+        # must not let stale completions decrement a new replica's count
+        self._inflight: Dict[bytes, int] = {}
         self._lock = threading.Lock()
+        self._refresher: Optional[threading.Thread] = None
+        self._closed = False
 
     def _controller(self):
         return ray_tpu.get_actor(CONTROLLER_NAME)
+
+    @staticmethod
+    def _rkey(replica) -> bytes:
+        aid = getattr(replica, "_actor_id", None) or getattr(
+            replica, "actor_id", None)
+        return aid.binary() if hasattr(aid, "binary") else bytes(str(aid), "utf8")
+
+    def _apply(self, info: dict) -> None:
+        with self._lock:
+            if info["version"] != self._version:
+                self._version = info["version"]
+                self._replicas = info["replicas"]
+                # keep counts for surviving replicas; drop departed ones
+                live = {self._rkey(r) for r in self._replicas}
+                self._inflight = {k: v for k, v in self._inflight.items()
+                                  if k in live}
 
     def _refresh(self, block: bool = True):
         deadline = time.monotonic() + 30
         while True:
             info = ray_tpu.get(self._controller().get_replicas.remote(
                 self._name, self._version))
+            self._apply(info)
             with self._lock:
-                self._version = info["version"]
-                self._replicas = info["replicas"]
                 if self._replicas or not block or time.monotonic() > deadline:
                     return
             time.sleep(0.1)
+
+    def _ensure_refresher(self) -> None:
+        with self._lock:
+            t = self._refresher
+            if t is not None and t.is_alive():
+                return
+
+            def loop():
+                failures = 0
+                while not self._closed and failures < 5:
+                    try:
+                        info = ray_tpu.get(self._controller().get_replicas.remote(
+                            self._name, self._version), timeout=30)
+                        self._apply(info)
+                        failures = 0
+                    except Exception:
+                        # Controller gone (serve.shutdown) or unreachable:
+                        # exit after a few strikes rather than spinning
+                        # forever; the next remote() restarts the loop.
+                        failures += 1
+                        time.sleep(1.0)
+                with self._lock:
+                    if self._refresher is threading.current_thread():
+                        self._refresher = None
+
+            t = threading.Thread(target=loop,
+                                 name=f"serve-longpoll-{self._name}",
+                                 daemon=True)
+            self._refresher = t
+            t.start()
+
+    def close(self) -> None:
+        self._closed = True
 
     def options(self, method_name: str = "__call__") -> "DeploymentHandle":
         h = DeploymentHandle(self._name, method_name)
@@ -348,27 +417,33 @@ class DeploymentHandle:
             replicas = list(self._replicas)
         if not replicas:
             self._refresh()
-            replicas = list(self._replicas)
+            with self._lock:
+                replicas = list(self._replicas)
             if not replicas:
                 raise RuntimeError(f"deployment {self._name} has no replicas")
+        self._ensure_refresher()
         # power of two choices on locally-tracked in-flight counts
         if len(replicas) == 1:
-            idx = 0
+            replica = replicas[0]
         else:
             a, b = random.sample(range(len(replicas)), 2)
-            idx = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
-        replica = replicas[idx]
+            ka, kb = self._rkey(replicas[a]), self._rkey(replicas[b])
+            with self._lock:
+                replica = (replicas[a]
+                           if self._inflight.get(ka, 0) <= self._inflight.get(kb, 0)
+                           else replicas[b])
+        key = self._rkey(replica)
         with self._lock:
-            self._inflight[idx] = self._inflight.get(idx, 0) + 1
+            self._inflight[key] = self._inflight.get(key, 0) + 1
         ref = replica.handle_request.remote(self._method, args, kwargs)
-        # decrement when result lands (best-effort, background thread)
-        def _done():
-            try:
-                ray_tpu.wait([ref], num_returns=1, timeout=300)
-            finally:
-                with self._lock:
-                    self._inflight[idx] = max(0, self._inflight.get(idx, 1) - 1)
-        threading.Thread(target=_done, daemon=True).start()
+
+        def _dec():
+            with self._lock:
+                self._inflight[key] = max(0, self._inflight.get(key, 1) - 1)
+
+        from ray_tpu.core.api import _global_worker
+
+        _global_worker().add_done_callback(ref, _dec)
         return ref
 
     def __reduce__(self):
@@ -428,7 +503,10 @@ def _get_or_create_controller():
         return ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:
         return ServeController.options(
-            name=CONTROLLER_NAME, num_cpus=0, max_concurrency=8).remote()
+            # high concurrency: every handle parks a 2s get_replicas
+            # long-poll on an exec thread; deploy/metrics calls must never
+            # queue behind those CV waits
+            name=CONTROLLER_NAME, num_cpus=0, max_concurrency=64).remote()
 
 
 def _collect_graph(root: Deployment, order: List[Deployment],
@@ -516,7 +594,8 @@ def _update_serve_gauges() -> None:
     try:
         proxy = ray_tpu.get_actor(PROXY_NAME)
         metrics_mod.merge_snapshot(
-            ray_tpu.get(proxy.metrics_snapshot.remote(), timeout=5))
+            ray_tpu.get(proxy.metrics_snapshot.remote(), timeout=5),
+            source="http_proxy")
     except Exception:
         pass  # no HTTP ingress running (handle-only traffic counts locally)
     try:

@@ -26,9 +26,25 @@ class Metric:
         self.tag_keys = tuple(tag_keys)
         self._default_tags: Dict[str, str] = {}
         self._values: Dict[Tuple, float] = {}
+        # per-source series merged in from other processes (see
+        # merge_snapshot); combined with local values at export time
+        self._remote: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
         with _registry_lock:
             _registry.append(self)
+
+    def _combined_values(self) -> Dict[Tuple, float]:
+        """Local + remote series: counters sum per tag key, gauges take the
+        remote value when present (the remote process owns that series)."""
+        out = dict(self._values)
+        additive = getattr(self, "kind", "") == "counter"
+        for entry in self._remote.values():
+            for k, v in entry.get("values", {}).items():
+                if additive:
+                    out[k] = out.get(k, 0.0) + v
+                else:
+                    out[k] = v
+        return out
 
     def set_default_tags(self, tags: Dict[str, str]):
         self._default_tags = dict(tags)
@@ -99,7 +115,22 @@ def export_prometheus() -> str:
         lines.append(f"# HELP {m.name} {m.description}")
         lines.append(f"# TYPE {m.name} {m.kind}")
         if isinstance(m, Histogram):
-            for k, counts in m._counts.items():
+            with m._lock:
+                # combine local + merged remote series additively per tag key
+                counts_by_k = {k: list(v) for k, v in m._counts.items()}
+                sums = dict(m._sums)
+                totals = dict(m._totals)
+                for entry in m._remote.values():
+                    for k, v in entry.get("counts", {}).items():
+                        cur = counts_by_k.setdefault(
+                            k, [0] * (len(m.boundaries) + 1))
+                        for i, c in enumerate(v):
+                            cur[i] += c
+                    for k, v in entry.get("sums", {}).items():
+                        sums[k] = sums.get(k, 0.0) + v
+                    for k, v in entry.get("totals", {}).items():
+                        totals[k] = totals.get(k, 0) + v
+            for k, counts in counts_by_k.items():
                 cum = 0
                 for i, b in enumerate(m.boundaries):
                     cum += counts[i]
@@ -112,10 +143,12 @@ def export_prometheus() -> str:
                 labels["le"] = "+Inf"
                 inner = ",".join(f'{kk}="{vv}"' for kk, vv in sorted(labels.items()))
                 lines.append(f"{m.name}_bucket{{{inner}}} {cum}")
-                lines.append(f"{m.name}_sum{m._fmt_labels(k)} {m._sums.get(k, 0.0)}")
-                lines.append(f"{m.name}_count{m._fmt_labels(k)} {m._totals.get(k, 0)}")
+                lines.append(f"{m.name}_sum{m._fmt_labels(k)} {sums.get(k, 0.0)}")
+                lines.append(f"{m.name}_count{m._fmt_labels(k)} {totals.get(k, 0)}")
         else:
-            for k, v in m._values.items():
+            with m._lock:
+                combined = m._combined_values()
+            for k, v in combined.items():
                 lines.append(f"{m.name}{m._fmt_labels(k)} {v}")
     return "\n".join(lines) + "\n"
 
@@ -153,9 +186,14 @@ def snapshot(prefix: str = "") -> Dict[str, Dict[str, Any]]:
     return out
 
 
-def merge_snapshot(snap: Dict[str, Dict[str, Any]]) -> None:
-    """Install another process's snapshot into this registry, REPLACING the
-    local series of the same names (the remote process owns those series)."""
+def merge_snapshot(snap: Dict[str, Dict[str, Any]], source: str = "remote") -> None:
+    """Install another process's snapshot into this registry under `source`.
+
+    Remote series are kept SEPARATE from local values and re-installed
+    wholesale on every merge (idempotent per scrape); export combines them
+    — additively for counters/histograms, remote-wins for gauges. This way
+    mixed traffic (e.g. driver-side handle calls + HTTP-proxy requests)
+    reports the sum instead of the proxy clobbering local counts."""
     for name, entry in snap.items():
         kwargs = {"tag_keys": entry.get("tag_keys", ())}
         if entry["kind"] == "histogram":
@@ -164,9 +202,4 @@ def merge_snapshot(snap: Dict[str, Dict[str, Any]]) -> None:
         m = get_or_create(entry["kind"], name,
                           entry.get("description", ""), **kwargs)
         with m._lock:
-            m._values = dict(entry.get("values", {}))
-            if isinstance(m, Histogram):
-                m._counts = {k: list(v)
-                             for k, v in entry.get("counts", {}).items()}
-                m._sums = dict(entry.get("sums", {}))
-                m._totals = dict(entry.get("totals", {}))
+            m._remote[source] = entry

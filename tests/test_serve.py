@@ -313,3 +313,37 @@ def test_replica_health_check_restart(serve_cluster):
         time.sleep(0.5)
     else:
         raise AssertionError((serve.status(), seen))
+
+
+@pytest.mark.slow
+def test_handle_closed_loop_throughput(ray_start_regular):
+    """Thread-free data plane throughput: >=1k req/s closed-loop through the
+    handle router on CPU (the old per-request _done threads collapsed well
+    below this). Best of 3 to tolerate CI load spikes."""
+    import time as _time
+
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2, max_concurrent_queries=32)
+    def echo(x):
+        return x
+
+    h = serve.run(echo.bind(), name="tput")
+    ray_tpu.get([h.remote(i) for i in range(32)], timeout=60)  # warm
+
+    best = 0.0
+    for _ in range(3):
+        n, window = 2000, 128
+        t0 = _time.perf_counter()
+        pending, done, i = [], 0, 0
+        while done < n:
+            while i < n and len(pending) < window:
+                pending.append(h.remote(i))
+                i += 1
+            ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=30)
+            done += len(ready)
+        best = max(best, n / (_time.perf_counter() - t0))
+        if best >= 1000:
+            break
+    serve.shutdown()
+    assert best >= 1000, f"handle throughput {best:.0f} req/s < 1000"
