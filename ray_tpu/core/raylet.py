@@ -352,6 +352,12 @@ class Raylet:
         env = dict(os.environ)
         env.update(self.worker_env)
         env.setdefault("JAX_PLATFORMS", "cpu")  # workers default to CPU JAX
+        if env.get("JAX_PLATFORMS") == "cpu":
+            # CPU-mode workers skip accelerator-plugin registration in
+            # sitecustomize (it imports jax eagerly — multiple seconds per
+            # worker spawn that most workers never need; jax still imports
+            # normally from site-packages on first in-task use).
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         # Workers must find ray_tpu even when it is on sys.path but not
         # installed (driver ran `sys.path.insert`): prepend our package root.
         import ray_tpu

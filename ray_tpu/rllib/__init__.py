@@ -1,6 +1,7 @@
 from ray_tpu.rllib.env import (
     CartPoleEnv, ContinuousVectorEnv, PendulumEnv, VectorEnv)
 from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.learner import Learner, LearnerGroup
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.a2c import A2C, A2CConfig
 from ray_tpu.rllib.appo import APPO, APPOConfig

@@ -15,6 +15,7 @@ import numpy as np
 import ray_tpu
 from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
+from ray_tpu.rllib.learner import Learner
 from ray_tpu.rllib.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 
 
@@ -81,68 +82,64 @@ class EpsilonGreedyWorker:
         return out
 
 
-class DQNLearner:
-    """Double-DQN TD update, jitted."""
+class DQNLearner(Learner):
+    """Double-DQN TD update on the Learner stack; the target network rides
+    through jit as the Learner's `extra` pytree. Pass `mesh=` to shard
+    batches over dp (LearnerGroup mesh backend)."""
 
     def __init__(self, obs_dim: int, num_actions: int, lr: float,
-                 gamma: float, seed: int = 0):
+                 gamma: float, seed: int = 0, mesh=None):
+        self._obs_dim = obs_dim
+        self._num_actions = num_actions
+        self._gamma = gamma
+        super().__init__(lr=lr, mesh=mesh, seed=seed)
+
+    def init_params(self, seed: int):
+        return init_q_params(seed, self._obs_dim, self._num_actions)
+
+    def make_extra(self):
+        # params pytrees are immutable (updates build new ones), so the
+        # target net can alias the online params at sync points
+        return self.params
+
+    def loss(self, params, batch, extra):
         import jax
         import jax.numpy as jnp
-        import optax
 
-        self.params = init_q_params(seed, obs_dim, num_actions)
-        self.target_params = {k: v.copy() for k, v in self.params.items()}
-        self.optimizer = optax.adam(lr)
-        self.opt_state = self.optimizer.init(self.params)
-
-        def loss_fn(params, target_params, batch):
-            q = q_apply(params, batch["obs"])
-            q_taken = jnp.take_along_axis(
-                q, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
-            # double DQN: online net picks argmax, target net evaluates
-            next_online = q_apply(params, batch["next_obs"])
-            next_a = jnp.argmax(next_online, axis=-1)
-            next_target = q_apply(target_params, batch["next_obs"])
-            next_q = jnp.take_along_axis(next_target, next_a[:, None], axis=-1)[:, 0]
-            target = batch["rewards"] + gamma * (1.0 - batch["dones"]) * \
-                jax.lax.stop_gradient(next_q)
-            td = q_taken - target
-            w = batch.get("weights", jnp.ones_like(td))
-            loss = (w * td ** 2).mean()
-            return loss, td
-
-        def update(params, opt_state, target_params, batch):
-            (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, target_params, batch)
-            updates, opt_state = self.optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, loss, td
-
-        self._update = jax.jit(update)
+        target_params = extra
+        q = q_apply(params, batch["obs"])
+        q_taken = jnp.take_along_axis(
+            q, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+        # double DQN: online net picks argmax, target net evaluates
+        next_online = q_apply(params, batch["next_obs"])
+        next_a = jnp.argmax(next_online, axis=-1)
+        next_target = q_apply(target_params, batch["next_obs"])
+        next_q = jnp.take_along_axis(next_target, next_a[:, None], axis=-1)[:, 0]
+        target = batch["rewards"] + self._gamma * (1.0 - batch["dones"]) * \
+            jax.lax.stop_gradient(next_q)
+        td = q_taken - target
+        w = batch.get("weights", jnp.ones_like(td))
+        loss = (w * td ** 2).mean()
+        return loss, {"td": td}
 
     def update_batch(self, batch: Dict[str, np.ndarray]):
         import jax
 
-        self.params, self.opt_state, loss, td = self._update(
-            self.params, self.opt_state, self.target_params, batch)
-        return float(loss), np.asarray(jax.device_get(td))
+        aux = self.update(batch)
+        aux = jax.device_get(aux)
+        return float(aux["total_loss"]), np.asarray(aux["td"])
 
     def sync_target(self) -> None:
-        import jax
-
-        self.target_params = jax.device_get(self.params)
-
-    def get_weights(self):
-        import jax
-
-        return {k: np.asarray(v) for k, v in jax.device_get(self.params).items()}
+        self.extra = self.params
 
     def set_weights(self, weights):
-        import jax.numpy as jnp
+        super().set_weights(weights)
+        self.extra = self.params
 
-        self.params = {k: jnp.asarray(v) for k, v in weights.items()}
-        self.target_params = {k: np.asarray(v) for k, v in weights.items()}
-        self.opt_state = self.optimizer.init(self.params)
+    # kept for callers that referenced the old attribute name
+    @property
+    def target_params(self):
+        return self.extra
 
 
 class DQNConfig:

@@ -20,6 +20,7 @@ import numpy as np
 import ray_tpu
 from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
+from ray_tpu.rllib.learner import Learner
 
 
 # ------------------------------------------------------------- policy model
@@ -131,69 +132,55 @@ def compute_gae(batch: Dict[str, np.ndarray], gamma: float, lam: float):
 # ----------------------------------------------------------------- learner
 
 
-class PPOLearner:
-    """Jitted clipped-surrogate update (reference core/learner/learner.py)."""
+class PPOLearner(Learner):
+    """Jitted clipped-surrogate update on the Learner stack (reference
+    core/learner/learner.py); pass `mesh=` to shard minibatches over the dp
+    axis with XLA-inserted gradient all-reduce (LearnerGroup mesh backend)."""
 
     def __init__(self, obs_dim: int, num_actions: int, lr: float,
                  clip: float = 0.2, vf_coeff: float = 0.5,
-                 entropy_coeff: float = 0.01, seed: int = 0):
+                 entropy_coeff: float = 0.01, seed: int = 0, mesh=None):
+        self._obs_dim = obs_dim
+        self._num_actions = num_actions
+        self._clip = clip
+        self._vf_coeff = vf_coeff
+        self._entropy_coeff = entropy_coeff
+        super().__init__(lr=lr, mesh=mesh, seed=seed)
+
+    def init_params(self, seed: int):
+        return init_policy_params(seed, self._obs_dim, self._num_actions)
+
+    def loss(self, params, batch, extra):
         import jax
         import jax.numpy as jnp
-        import optax
 
-        self.params = init_policy_params(seed, obs_dim, num_actions)
-        self.optimizer = optax.adam(lr)
-        self.opt_state = self.optimizer.init(self.params)
-
-        def loss_fn(params, batch):
-            logits, value = policy_apply(params, batch["obs"])
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, batch["actions"][:, None], axis=-1)[:, 0]
-            ratio = jnp.exp(logp - batch["logp"])
-            adv = batch["advantages"]
-            pg = -jnp.minimum(
-                ratio * adv,
-                jnp.clip(ratio, 1 - clip, 1 + clip) * adv).mean()
-            vf = 0.5 * ((value - batch["returns"]) ** 2).mean()
-            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-            total = pg + vf_coeff * vf - entropy_coeff * entropy
-            return total, {"policy_loss": pg, "vf_loss": vf, "entropy": entropy}
-
-        def update(params, opt_state, batch):
-            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
-            updates, opt_state = self.optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            aux["total_loss"] = loss
-            return params, opt_state, aux
-
-        self._update = jax.jit(update)
+        logits, value = policy_apply(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], axis=-1)[:, 0]
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["advantages"]
+        pg = -jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - self._clip, 1 + self._clip) * adv).mean()
+        vf = 0.5 * ((value - batch["returns"]) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = pg + self._vf_coeff * vf - self._entropy_coeff * entropy
+        return total, {"policy_loss": pg, "vf_loss": vf, "entropy": entropy}
 
     def update_minibatches(self, flat: Dict[str, np.ndarray],
                            num_epochs: int, minibatch_size: int,
                            rng: np.random.Generator) -> Dict[str, float]:
+        import jax
+
         n = len(flat["obs"])
-        stats = {}
+        stats: Dict[str, Any] = {}
         for _ in range(num_epochs):
             idx = rng.permutation(n)
             for start in range(0, n, minibatch_size):
                 mb = {k: v[idx[start:start + minibatch_size]] for k, v in flat.items()}
-                self.params, self.opt_state, stats = self._update(
-                    self.params, self.opt_state, mb)
-        import jax
-
+                stats = self.update(mb)
         return {k: float(v) for k, v in jax.device_get(stats).items()}
-
-    def get_weights(self):
-        import jax
-
-        return {k: np.asarray(v) for k, v in jax.device_get(self.params).items()}
-
-    def set_weights(self, weights):
-        import jax.numpy as jnp
-
-        self.params = {k: jnp.asarray(v) for k, v in weights.items()}
-        self.opt_state = self.optimizer.init(self.params)
 
 
 # --------------------------------------------------------------- algorithm

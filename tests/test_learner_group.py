@@ -1,0 +1,93 @@
+"""Learner / LearnerGroup (reference `rllib/core/learner/learner.py:100`,
+`learner_group.py:52`): the mesh backend shards batches over the virtual
+8-device dp axis inside one jitted update; the actors backend all-reduces
+gradients across learner actors via the host collective."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from ray_tpu.rllib.ppo import PPOLearner, init_policy_params
+from ray_tpu.rllib.dqn import DQNLearner
+from ray_tpu.rllib.learner import LearnerGroup
+from ray_tpu.parallel import MeshConfig, make_mesh
+
+
+def _ppo_batch(n, obs_dim=4, num_actions=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "obs": rng.normal(size=(n, obs_dim)).astype(np.float32),
+        "actions": rng.integers(0, num_actions, n),
+        "logp": rng.normal(size=n).astype(np.float32) * 0.1 - 0.7,
+        "advantages": rng.normal(size=n).astype(np.float32),
+        "returns": rng.normal(size=n).astype(np.float32),
+    }
+
+
+def test_ppo_learner_mesh_matches_single_device():
+    """The dp-sharded update must compute the same step as the unsharded
+    one: params replicated, gradients globally averaged by GSPMD."""
+    mesh = make_mesh(MeshConfig(dp=8, fsdp=1, tp=1, sp=1))
+    batch = _ppo_batch(64)
+    plain = PPOLearner(4, 2, lr=1e-3, seed=7)
+    meshed = PPOLearner(4, 2, lr=1e-3, seed=7, mesh=mesh)
+    aux_plain = jax.device_get(plain.update(batch))
+    aux_mesh = jax.device_get(meshed.update(batch))
+    np.testing.assert_allclose(float(aux_plain["total_loss"]),
+                               float(aux_mesh["total_loss"]), rtol=1e-5)
+    for k in plain.params:
+        np.testing.assert_allclose(np.asarray(plain.params[k]),
+                                   np.asarray(meshed.params[k]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_dqn_learner_mesh_update_and_target_sync():
+    mesh = make_mesh(MeshConfig(dp=8, fsdp=1, tp=1, sp=1))
+    learner = DQNLearner(4, 2, lr=1e-3, gamma=0.99, mesh=mesh)
+    rng = np.random.default_rng(0)
+    batch = {
+        "obs": rng.normal(size=(32, 4)).astype(np.float32),
+        "actions": rng.integers(0, 2, 32),
+        "rewards": rng.normal(size=32).astype(np.float32),
+        "next_obs": rng.normal(size=(32, 4)).astype(np.float32),
+        "dones": rng.integers(0, 2, 32).astype(np.float32),
+    }
+    loss1, td = learner.update_batch(batch)
+    assert np.isfinite(loss1) and td.shape == (32,)
+    learner.sync_target()
+    loss2, _ = learner.update_batch(batch)
+    assert np.isfinite(loss2)
+
+
+def test_learner_group_mesh_backend():
+    group = LearnerGroup(
+        PPOLearner, {"obs_dim": 4, "num_actions": 2, "lr": 1e-3},
+        backend="mesh", mesh=make_mesh(MeshConfig(dp=8, fsdp=1, tp=1, sp=1)))
+    stats = group.update(_ppo_batch(64))
+    assert np.isfinite(stats["total_loss"])
+    w = group.get_weights()
+    group.set_weights(w)
+    w2 = group.get_weights()
+    for k in w:
+        np.testing.assert_array_equal(w[k], w2[k])
+
+
+def test_learner_group_actor_backend(ray_start_regular):
+    """2 learner actors, host-collective gradient all-reduce: both replicas
+    must hold identical params after an update (DDP invariant)."""
+    group = LearnerGroup(
+        PPOLearner, {"obs_dim": 4, "num_actions": 2, "lr": 1e-3, "seed": 3},
+        backend="actors", num_learners=2)
+    stats = group.update(_ppo_batch(64, seed=1))
+    assert np.isfinite(stats["total_loss"])
+    import ray_tpu
+
+    w0, w1 = ray_tpu.get([a.get_weights.remote() for a in group._actors])
+    for k in w0:
+        np.testing.assert_allclose(w0[k], w1[k], rtol=1e-5, atol=1e-6,
+                                   err_msg=f"replicas diverged at {k}")
+    # odd-size batch: wrap-padded so every rank trains and no data is lost
+    stats = group.update(_ppo_batch(65, seed=2))
+    assert np.isfinite(stats["total_loss"])
+    group.shutdown()
