@@ -645,6 +645,7 @@ class Raylet:
         dispatch (`cluster_task_manager.cc:44,418`).
         """
         dispatched_any = False
+        spawn_wants: Dict[Optional[str], list] = {}  # env_key -> [count, env]
         with self._lock:
             pending: deque[_QueuedTask] = deque()
             while self._queue:
@@ -672,7 +673,8 @@ class Raylet:
                 handle = self._acquire_worker(ekey)
                 if handle is None:
                     pending.append(qt)
-                    self._maybe_spawn(ekey, spec.runtime_env)
+                    w = spawn_wants.setdefault(ekey, [0, spec.runtime_env])
+                    w[0] += 1
                     continue
                 self._charge_resources(spec, demand)
                 handle.current_task = spec
@@ -680,6 +682,8 @@ class Raylet:
                 handle.conn.push("execute_task", {"spec": spec})
                 dispatched_any = True
             self._queue = pending
+            for ekey, (count, renv) in spawn_wants.items():
+                self._maybe_spawn(ekey, renv, needed=count)
         if dispatched_any:
             self._report_resources()
 
@@ -769,12 +773,23 @@ class Raylet:
                 return w
         return None
 
+    def _starting_for(self, env_key: Optional[str]) -> int:
+        return sum(1 for p in self._starting
+                   if self._starting_env.get(p.pid) == env_key)
+
     def _maybe_spawn(self, env_key: Optional[str] = None,
-                     runtime_env: Optional[dict] = None) -> None:
+                     runtime_env: Optional[dict] = None,
+                     needed: int = 1) -> None:
+        """Spawn at most (needed - already starting) workers for this env.
+        Without the deficit check, every scheduling pass during a worker's
+        multi-second boot would spawn ANOTHER worker per still-pending task
+        — an overspawn storm that serializes all boots on small hosts."""
         if env_key is not None and \
                 self._env_manager.creation_error(env_key) is not None:
             return  # creation already failed; don't respawn forever
-        if len(self._starting) < get_config().maximum_startup_concurrency:
+        deficit = needed - self._starting_for(env_key)
+        budget = get_config().maximum_startup_concurrency - len(self._starting)
+        for _ in range(max(0, min(deficit, budget))):
             self._spawn_worker(env_key, runtime_env)
 
     def rpc_task_done(self, conn, req_id, payload):
@@ -810,7 +825,9 @@ class Raylet:
             handle = self._acquire_worker(ekey)
             if handle is None:
                 self._pending_actor_specs.append(spec)
-                self._maybe_spawn(ekey, spec.runtime_env)
+                needed = sum(1 for s in self._pending_actor_specs
+                             if _env_key(s.runtime_env) == ekey)
+                self._maybe_spawn(ekey, spec.runtime_env, needed=needed)
                 return True
             self._assign_actor(handle, spec)
         return True

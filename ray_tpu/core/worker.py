@@ -219,6 +219,7 @@ class CoreWorker:
         self._actor_seq_counters: Dict[ActorID, int] = {}
         self._actor_addresses: Dict[ActorID, str] = {}
         self._actor_dead: Dict[ActorID, str] = {}
+        self._actor_cv = threading.Condition()  # pubsub wakes address waits
 
         # execution
         self._registered = threading.Event()
@@ -715,6 +716,9 @@ class CoreWorker:
     def wait(self, refs: List[ObjectRef], num_returns: int, timeout: Optional[float],
              fetch_local: bool = True):
         deadline = None if timeout is None else time.monotonic() + timeout
+        if all(r.owner_address in ("", self.address) for r in refs):
+            return self._wait_owned(refs, num_returns, deadline)
+        # borrowed refs involved: poll the owners (latency floor = interval)
         pending = list(refs)
         ready: List[ObjectRef] = []
         while len(ready) < num_returns:
@@ -730,6 +734,30 @@ class CoreWorker:
             if deadline is not None and time.monotonic() >= deadline:
                 break
             time.sleep(get_config().get_check_interval_s)
+        return ready[:num_returns], pending + ready[num_returns:]
+
+    def _wait_owned(self, refs: List[ObjectRef], num_returns: int,
+                    deadline: Optional[float]):
+        """Event-driven wait for refs we own: sleeps on the object condition
+        variable (notified at every state transition) instead of polling —
+        no get_check_interval_s latency floor (reference WaitManager is
+        likewise event-driven)."""
+        with self._obj_cv:
+            while True:
+                ready = []
+                pending = []
+                for r in refs:
+                    st = self._objects.get(r.id)
+                    if st is not None and st.state != "pending":
+                        ready.append(r)
+                    else:
+                        pending.append(r)
+                if len(ready) >= num_returns or not pending:
+                    break
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    break
+                self._obj_cv.wait(timeout=min(remaining, 5.0) if remaining else 5.0)
         return ready[:num_returns], pending + ready[num_returns:]
 
     def _is_ready(self, ref: ObjectRef) -> bool:
@@ -1135,20 +1163,38 @@ class CoreWorker:
 
     def _wait_actor_address(self, actor_id: ActorID, spec: TaskSpec,
                             timeout: float = 60.0) -> Optional[str]:
+        """Wait for the actor to become ALIVE: pubsub pushes (drivers are
+        subscribed to the actors channel) wake the condition variable
+        instantly; an authoritative GCS poll runs as a 1 s fallback so
+        non-subscribed workers still converge without hammering the GCS at
+        the old 100 ms cadence."""
         deadline = time.monotonic() + timeout
+        poll_next = 0.0
         while time.monotonic() < deadline:
-            info = self.gcs.call("get_actor_info", {"actor_id": actor_id}, timeout=10)
-            if info is None:
-                self._fail_task(spec, ActorDiedError(f"actor {actor_id} unknown"))
+            addr = self._actor_addresses.get(actor_id)
+            if addr is not None:
+                return addr
+            dead = self._actor_dead.get(actor_id)
+            if dead is not None:
+                self._fail_task(spec, ActorDiedError(dead))
                 return None
-            if info["state"] == "ALIVE":
-                self._actor_addresses[actor_id] = info["address"]
-                return info["address"]
-            if info["state"] == "DEAD":
-                self._actor_dead[actor_id] = info["death_cause"] or "actor died"
-                self._fail_task(spec, ActorDiedError(self._actor_dead[actor_id]))
-                return None
-            time.sleep(0.1)
+            now = time.monotonic()
+            if now >= poll_next:
+                poll_next = now + 1.0
+                info = self.gcs.call("get_actor_info", {"actor_id": actor_id},
+                                     timeout=10)
+                if info is None:
+                    self._fail_task(spec, ActorDiedError(f"actor {actor_id} unknown"))
+                    return None
+                if info["state"] == "ALIVE":
+                    self._actor_addresses[actor_id] = info["address"]
+                    return info["address"]
+                if info["state"] == "DEAD":
+                    self._actor_dead[actor_id] = info["death_cause"] or "actor died"
+                    self._fail_task(spec, ActorDiedError(self._actor_dead[actor_id]))
+                    return None
+            with self._actor_cv:
+                self._actor_cv.wait(timeout=0.1)
         self._fail_task(spec, ActorDiedError(f"timed out waiting for actor {actor_id}"))
         return None
 
@@ -1242,6 +1288,8 @@ class CoreWorker:
                     self._actor_seq_counters.pop(aid, None)
                 self._fail_inflight_actor_tasks(
                     aid, "actor restarting; in-flight call lost")
+            with self._actor_cv:
+                self._actor_cv.notify_all()
 
     def _fail_inflight_actor_tasks(self, actor_id: ActorID, reason: str) -> None:
         """The actor process died: calls sent to it will never report back.

@@ -82,7 +82,11 @@ def start_dashboard(port: int = 0) -> Tuple[ThreadingHTTPServer, int]:
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
             try:
-                if self.path == "/api/nodes":
+                if self.path in ("/", "/index.html"):
+                    from ray_tpu.dashboard_ui import DASHBOARD_HTML
+
+                    body, ctype = DASHBOARD_HTML, "text/html"
+                elif self.path == "/api/nodes":
                     body, ctype = json.dumps(state_api.list_nodes()), "application/json"
                 elif self.path == "/api/actors":
                     body, ctype = json.dumps(state_api.list_actors()), "application/json"
@@ -103,6 +107,10 @@ def start_dashboard(port: int = 0) -> Tuple[ThreadingHTTPServer, int]:
                 elif self.path == "/timeline":
                     body, ctype = json.dumps(
                         {"traceEvents": tracing.get_events()}), "application/json"
+                elif self.path == "/api/serve/applications":
+                    from ray_tpu import serve as serve_mod
+
+                    body, ctype = json.dumps(serve_mod.status()), "application/json"
                 else:
                     self.send_response(404)
                     self.end_headers()
@@ -119,6 +127,36 @@ def start_dashboard(port: int = 0) -> Tuple[ThreadingHTTPServer, int]:
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+
+        def do_PUT(self):
+            """Declarative REST deploy (reference `serve deploy` REST mode,
+            `python/ray/serve/schema.py`): PUT /api/serve/applications with
+            the JSON/YAML config body deploys every application."""
+            if self.path != "/api/serve/applications":
+                self.send_response(404)
+                self.end_headers()
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n).decode()
+                try:
+                    cfg = json.loads(raw)
+                except ValueError:
+                    import yaml
+
+                    cfg = yaml.safe_load(raw)
+                from ray_tpu.serve.config import deploy_config
+
+                deployed = deploy_config(cfg)
+                data = json.dumps({"deployed": deployed}).encode()
+                self.send_response(200)
+            except Exception as e:
+                data = json.dumps({"error": str(e)}).encode()
+                self.send_response(400)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
 
         def log_message(self, *a):
             pass

@@ -72,9 +72,12 @@ def _apply_overrides(root: serve_api.Deployment,
     return rewrite(root)
 
 
-def deploy_config_file(path: str) -> Dict[str, Any]:
-    """Deploy every application in the config; returns {app_name: root}."""
-    cfg = load_config(path)
+def deploy_config(cfg: Dict[str, Any]) -> Dict[str, str]:
+    """Deploy every application in an in-memory config dict (the REST
+    `PUT /api/serve/applications` body — reference `serve deploy` REST
+    mode); returns {app_name: root deployment name}."""
+    if not isinstance(cfg, dict) or "applications" not in cfg:
+        raise ValueError("expected a mapping with 'applications'")
     deployed: Dict[str, str] = {}
     for app in cfg["applications"]:
         root = _import_target(app["import_path"])
@@ -83,3 +86,8 @@ def deploy_config_file(path: str) -> Dict[str, Any]:
         serve_api.run(root, name=app.get("name", root.name))
         deployed[app.get("name", root.name)] = root.name
     return deployed
+
+
+def deploy_config_file(path: str) -> Dict[str, Any]:
+    """Deploy every application in the config file; returns {app_name: root}."""
+    return deploy_config(load_config(path))

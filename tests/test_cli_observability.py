@@ -50,6 +50,7 @@ def test_global_gc_runs_in_workers(gcs_address, capsys):
     assert rc == 0 and "triggered" in out
 
 
+@pytest.mark.slow
 def test_stack_dumps_worker_threads(gcs_address, capsys):
     import time
 
@@ -71,6 +72,7 @@ def test_stack_dumps_worker_threads(gcs_address, capsys):
     ray_tpu.get(ref, timeout=30)
 
 
+@pytest.mark.slow
 def test_microbenchmark_runs(ray_start_regular, capsys):
     from ray_tpu.microbenchmark import run_microbenchmark
 

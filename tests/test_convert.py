@@ -20,6 +20,7 @@ def _tiny_hf_llama(tie=False):
 
 
 @pytest.mark.parametrize("tie", [False, True])
+@pytest.mark.slow
 def test_hf_llama_logits_match(tie):
     from ray_tpu.models.convert import load_hf_llama
 

@@ -1,0 +1,69 @@
+"""Dashboard web UI + Serve REST deploy mode (reference
+`dashboard/client` + `serve deploy` REST / `python/ray/serve/schema.py`)."""
+
+import json
+import sys
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def dash(ray_start_regular, tmp_path):
+    from ray_tpu.dashboard import start_dashboard
+
+    srv, port = start_dashboard()
+    yield port
+    srv.shutdown()
+
+
+def test_dashboard_serves_html_ui(dash):
+    html = urllib.request.urlopen(
+        f"http://127.0.0.1:{dash}/").read().decode()
+    assert "ray_tpu dashboard" in html
+    assert "/api/nodes" in html and "refresh()" in html
+    nodes = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{dash}/api/nodes").read())
+    assert len(nodes) == 1
+
+
+def test_serve_rest_deploy_roundtrip(dash, tmp_path):
+    app_dir = tmp_path / "restapp"
+    app_dir.mkdir()
+    (app_dir / "rest_demo_app.py").write_text(
+        "from ray_tpu import serve\n\n"
+        "@serve.deployment(num_replicas=1)\n"
+        "def hello(x):\n"
+        "    return f'hi {x}'\n\n"
+        "app = hello.bind()\n")
+    sys.path.insert(0, str(app_dir))
+    try:
+        cfg = {"applications": [
+            {"name": "demo", "import_path": "rest_demo_app:app"}]}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{dash}/api/serve/applications",
+            data=json.dumps(cfg).encode(), method="PUT")
+        out = json.loads(urllib.request.urlopen(req).read())
+        assert out["deployed"] == {"demo": "hello"}
+
+        from ray_tpu import serve
+
+        h = serve.get_deployment_handle("hello")
+        assert ray_tpu.get(h.remote("rest"), timeout=60) == "hi rest"
+        status = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{dash}/api/serve/applications").read())
+        assert "hello" in status
+        serve.shutdown()
+    finally:
+        sys.path.remove(str(app_dir))
+
+
+def test_serve_rest_deploy_rejects_bad_config(dash):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{dash}/api/serve/applications",
+        data=json.dumps({"nope": 1}).encode(), method="PUT")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 400

@@ -1,0 +1,90 @@
+"""GceTpuNodeProvider control logic against a mocked HTTP transport
+(reference provider tests pattern: fake the cloud, verify the calls)."""
+
+from ray_tpu.autoscaler.node_provider import GceTpuNodeProvider
+
+
+class _FakeCloud:
+    """Minimal TPU API double recording requests."""
+
+    def __init__(self):
+        self.nodes = {}
+        self.calls = []
+
+    def request(self, method, url, body=None, headers=None):
+        self.calls.append((method, url, body))
+        if "metadata.google.internal" in url:
+            assert headers == {"Metadata-Flavor": "Google"}
+            return {"access_token": "tok", "expires_in": 3600}
+        assert headers.get("Authorization") == "Bearer tok"
+        if method == "POST":
+            node_id = url.split("nodeId=")[1]
+            self.nodes[node_id] = {
+                "name": f"projects/p/locations/z/nodes/{node_id}",
+                "state": "READY", "labels": body["labels"],
+            }
+            return {"name": "operations/op1"}
+        if method == "DELETE":
+            node_id = url.rsplit("/", 1)[-1]
+            self.nodes[node_id]["state"] = "DELETING"
+            return {"name": "operations/op2"}
+        if method == "GET":
+            return {"nodes": list(self.nodes.values())}
+        raise AssertionError(f"unexpected {method} {url}")
+
+
+def test_gce_tpu_provider_lifecycle():
+    cloud = _FakeCloud()
+    p = GceTpuNodeProvider("proj", "us-central2-b", "10.0.0.1:6379",
+                           request_fn=cloud.request)
+    nid = p.create_node("tpu_16", {"TPU": 16}, {"team": "ml"})
+    # node type sanitized to RFC-1035 (no underscores)
+    assert nid.startswith("ray-tpu-tpu-16-")
+    method, url, body = cloud.calls[-1]
+    assert method == "POST" and "us-central2-b" in url
+    assert body["acceleratorType"] == "v5litepod-16"
+    assert "10.0.0.1:6379" in body["metadata"]["startup-script"]
+    assert body["labels"]["ray-tpu-cluster"] == "1"
+    assert body["labels"]["team"] == "ml"
+
+    assert p.non_terminated_nodes() == [nid]
+    p.terminate_node(nid)
+    assert p.non_terminated_nodes() == []
+
+
+def test_gce_tpu_provider_accelerator_mapping():
+    cloud = _FakeCloud()
+    p = GceTpuNodeProvider("proj", "z", "gcs:1",
+                           accelerator_types={"big": "v5litepod-256"},
+                           request_fn=cloud.request)
+    p.create_node("big", {"TPU": 256}, {})
+    assert cloud.calls[-1][2]["acceleratorType"] == "v5litepod-256"
+
+
+def test_gce_tpu_provider_excludes_preempted_nodes():
+    cloud = _FakeCloud()
+    p = GceTpuNodeProvider("proj", "z", "gcs:1", request_fn=cloud.request)
+    nid = p.create_node("a", {"TPU": 4}, {})
+    cloud.nodes[nid]["state"] = "PREEMPTED"
+    assert p.non_terminated_nodes() == []
+
+
+def test_gce_tpu_provider_refreshes_expired_token():
+    import time as _time
+
+    cloud = _FakeCloud()
+    p = GceTpuNodeProvider("proj", "z", "gcs:1", request_fn=cloud.request)
+    p.non_terminated_nodes()
+    first_token_calls = sum(1 for c in cloud.calls if "metadata" in c[1])
+    p._token_expiry = _time.time() - 1  # simulate expiry
+    p.non_terminated_nodes()
+    assert sum(1 for c in cloud.calls if "metadata" in c[1]) == first_token_calls + 1
+
+
+def test_gce_tpu_provider_ignores_foreign_nodes():
+    cloud = _FakeCloud()
+    cloud.nodes["other"] = {
+        "name": "projects/p/locations/z/nodes/other",
+        "state": "READY", "labels": {}}
+    p = GceTpuNodeProvider("proj", "z", "gcs:1", request_fn=cloud.request)
+    assert p.non_terminated_nodes() == []

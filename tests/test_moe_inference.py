@@ -46,6 +46,7 @@ def test_moe_ffn_shapes_and_grads():
     assert float(jnp.abs(grads[0]).sum()) > 0  # router receives gradient
 
 
+@pytest.mark.slow
 def test_moe_model_trains_sharded():
     cfg = ModelConfig.tiny_moe()
     mesh = make_virtual_mesh(8, MeshConfig(dp=2, fsdp=2, tp=2, sp=1))

@@ -80,6 +80,7 @@ def test_sharded_matches_unsharded():
     np.testing.assert_allclose(float(loss_1dev), float(loss_8dev), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_chunked_loss_matches_dense():
     """cfg.loss_chunk computes identical loss+grads without full logits."""
     import dataclasses

@@ -80,9 +80,15 @@ def test_asha_stops_bad_trials(ray_start_regular):
 
 def test_pbt_exploits_checkpoints(ray_start_regular):
     def trainable(config):
+        import time as _t
+
         ckpt = tune.get_checkpoint()
         score = ckpt.to_dict()["score"] if ckpt else 0.0
         for _ in range(12):
+            # PBT exploitation requires temporally-overlapping trials; with
+            # instant iterations the first trial finishes before the second
+            # one's worker even boots (real workloads train for minutes).
+            _t.sleep(0.1)
             score += config["lr"]
             tune.report({"score": score},
                         checkpoint=Checkpoint.from_dict({"score": score}))
