@@ -6,6 +6,7 @@ from ray_tpu.serve.api import (
     delete,
     get_deployment_handle,
     start_http_proxy,
+    start_http_proxies_per_node,
     start_rpc_proxy,
     AutoscalingConfig,
     Deployment,

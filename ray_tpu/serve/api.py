@@ -591,13 +591,25 @@ def _update_serve_gauges() -> None:
     proxy actor, queue depth + replica counts in the controller."""
     from ray_tpu.util import metrics as metrics_mod
 
+    # The single driver-started proxy plus every per-node proxy
+    # (PROXY_NAME:<hex8>): each merges under its own source so counters sum.
+    proxy_names = [PROXY_NAME]
     try:
-        proxy = ray_tpu.get_actor(PROXY_NAME)
-        metrics_mod.merge_snapshot(
-            ray_tpu.get(proxy.metrics_snapshot.remote(), timeout=5),
-            source="http_proxy")
+        from ray_tpu import state as _state
+
+        proxy_names += [a["name"] for a in _state.list_actors()
+                        if a.get("name", "").startswith(PROXY_NAME + ":")
+                        and a.get("state") == "ALIVE"]
     except Exception:
-        pass  # no HTTP ingress running (handle-only traffic counts locally)
+        pass
+    for name in proxy_names:
+        try:
+            proxy = ray_tpu.get_actor(name)
+            metrics_mod.merge_snapshot(
+                ray_tpu.get(proxy.metrics_snapshot.remote(), timeout=5),
+                source=name)
+        except Exception:
+            pass  # ingress not running (handle-only traffic counts locally)
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:
@@ -652,9 +664,10 @@ class _HTTPProxyActor:
     """HTTP ingress: POST /<deployment> with a JSON body -> handle call
     (reference HTTPProxyActor, _private/http_proxy.py:250,434)."""
 
-    def __init__(self, port: int):
+    def __init__(self, port: int, host: str = "127.0.0.1"):
         import http.server
 
+        self._host = host
         proxy = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -706,7 +719,7 @@ class _HTTPProxyActor:
                 pass
 
         self._handles: Dict[str, DeploymentHandle] = {}
-        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._server = http.server.ThreadingHTTPServer((self._host, port), Handler)
         self.port = self._server.server_address[1]
         threading.Thread(target=self._server.serve_forever, daemon=True).start()
 
@@ -725,6 +738,42 @@ def start_http_proxy(port: int = 0):
     actor = _HTTPProxyActor.options(
         num_cpus=0, max_concurrency=8, name=PROXY_NAME).remote(port)
     return actor, ray_tpu.get(actor.get_port.remote())
+
+
+def start_http_proxies_per_node(port: int = 0):
+    """One HTTP ingress actor pinned to EVERY alive node (reference
+    HTTPProxyActor-per-node, `_private/http_proxy.py:434` /
+    `http_state.py`): each proxy binds 0.0.0.0 so an external load balancer
+    (or local clients) can reach every node. Returns
+    [(node_id_hex, node_host, handle, port)].
+
+    With a fixed `port`, every node listens on the same port (one proxy per
+    HOST — in-process test clusters share one host, where only the first
+    bind succeeds); with port=0 each proxy picks a free port. Actors are
+    created in parallel; nodes that died since the snapshot (or whose bind
+    failed) are skipped with a warning rather than hanging the caller."""
+    from ray_tpu.core.task_spec import SchedulingStrategy
+
+    started = []
+    for n in ray_tpu.nodes():
+        if not n.get("alive", True):
+            continue
+        node_id = n["node_id"]
+        host = str(n.get("address", "127.0.0.1")).rsplit(":", 1)[0]
+        actor = _HTTPProxyActor.options(
+            num_cpus=0, max_concurrency=8,
+            name=f"{PROXY_NAME}:{node_id.hex()[:8]}",
+            scheduling_strategy=SchedulingStrategy(
+                name=None, node_id=node_id)).remote(port, "0.0.0.0")
+        started.append((node_id.hex(), host, actor))
+    out = []
+    for node_hex, host, actor in started:
+        try:
+            out.append((node_hex, host, actor,
+                        ray_tpu.get(actor.get_port.remote(), timeout=60)))
+        except Exception as e:
+            logger.warning("per-node proxy on %s failed: %s", node_hex[:8], e)
+    return out
 
 
 # ------------------------------------------------------------------- rpc

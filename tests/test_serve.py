@@ -347,3 +347,37 @@ def test_handle_closed_loop_throughput(ray_start_regular):
             break
     serve.shutdown()
     assert best >= 1000, f"handle throughput {best:.0f} req/s < 1000"
+
+
+def test_per_node_http_proxies():
+    """One ingress proxy pinned to each node (reference proxy-per-node
+    topology): both nodes serve the same deployment locally."""
+    import json
+    import urllib.request
+
+    from ray_tpu.core.cluster import Cluster
+    from ray_tpu import serve
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    try:
+        @serve.deployment(num_replicas=2)
+        def echo(x):
+            return {"echo": x}
+
+        serve.run(echo.bind(), name="pn")
+        proxies = serve.start_http_proxies_per_node()
+        assert len(proxies) == 2
+        seen_nodes = {p[0] for p in proxies}
+        assert len(seen_nodes) == 2, "proxies not spread across nodes"
+        for _nid, _host, _actor, port in proxies:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/echo",
+                data=json.dumps("hi").encode(), method="POST")
+            body = json.loads(urllib.request.urlopen(req, timeout=30).read())
+            assert body == {"result": {"echo": "hi"}}, body
+        serve.shutdown()
+    finally:
+        cluster.shutdown()
