@@ -96,7 +96,35 @@ class ReferenceCounter:
     def __init__(self, worker: "CoreWorker"):
         self._worker = worker
         self._borrowed: Dict[ObjectID, dict] = {}
+        # one reconnecting link per owner: borrow registrations ride it, and
+        # on every fresh connection the live borrows are REPLAYED — so a
+        # transient drop (which the owner treats as borrower death and
+        # releases) re-establishes the borrow instead of silently losing it
+        self._owner_links: Dict[str, rpc.ReconnectingClient] = {}
         self._lock = threading.RLock()
+
+    def owner_link(self, owner: str) -> rpc.ReconnectingClient:
+        with self._lock:
+            link = self._owner_links.get(owner)
+            if link is None or link.closed:
+                link = rpc.ReconnectingClient(
+                    owner,
+                    on_reconnect=lambda raw, o=owner: self._replay_borrows(o, raw))
+                self._owner_links[owner] = link
+            return link
+
+    def _replay_borrows(self, owner: str, raw: "rpc.RpcClient") -> None:
+        with self._lock:
+            oids = [oid for oid, e in self._borrowed.items()
+                    if e["owner"] == owner and e["count"] > 0]
+        for oid in oids:
+            raw.notify("add_borrower", {"object_id": oid})
+
+    def close(self) -> None:
+        with self._lock:
+            links, self._owner_links = list(self._owner_links.values()), {}
+        for link in links:
+            link.close()
 
     def add_borrowed(self, ref: ObjectRef) -> None:
         w = self._worker
@@ -114,7 +142,7 @@ class ReferenceCounter:
         if not ref.owner_address:
             return
         try:
-            self._worker.peer(ref.owner_address).notify(
+            self.owner_link(ref.owner_address).notify(
                 "add_borrower", {"object_id": ref.id})
             self._borrowed[ref.id]["registered"] = True
         except Exception:
@@ -180,6 +208,10 @@ class CoreWorker:
         # Insertion-ordered; FIFO-evicted at lineage_table_max_entries.
         self._lineage: Dict[ObjectID, TaskSpec] = {}
         self._lineage_attempts: Dict[TaskID, int] = {}
+
+        # borrows keyed by the borrower's server connection (see
+        # rpc_add_borrower): conn id -> {object_id: count}
+        self._conn_borrows: Dict[int, Dict[ObjectID, int]] = {}
 
         # grace-deferred plasma frees (see _maybe_free)
         self._deferred_frees: deque = deque()
@@ -289,6 +321,7 @@ class CoreWorker:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        self.reference_counter.close()
         if self.mode == "driver":
             try:
                 self.gcs.call("mark_job_finished", {"job_id": self.job_id.binary()}, timeout=2)
@@ -962,20 +995,65 @@ class CoreWorker:
         return True
 
     def rpc_add_borrower(self, conn, req_id, payload):
-        with self._obj_lock:
-            st = self._objects.get(payload["object_id"])
-            if st is not None:
-                st.borrowers += 1
-        return True
-
-    def rpc_remove_borrower(self, conn, req_id, payload):
+        """Borrow registration, scoped to the borrower's CONNECTION: if the
+        borrower process dies, its connection drop releases every borrow it
+        held — a died borrower can no longer leak objects forever (the
+        liveness role of the reference's WaitForRefRemoved long-polls,
+        reference_count.h:834)."""
         oid = payload["object_id"]
         with self._obj_lock:
             st = self._objects.get(oid)
-            if st is not None:
-                st.borrowers -= 1
+            if st is None:
+                return True
+            st.borrowers += 1
+            if conn is not None:
+                key = id(conn)
+                m = self._conn_borrows.get(key)
+                if m is None:
+                    m = self._conn_borrows[key] = {}
+                    conn.on_close.append(
+                        lambda c, k=key: self._on_borrower_conn_close(k))
+                m[oid] = m.get(oid, 0) + 1
+        return True
+
+    def rpc_remove_borrower(self, conn, req_id, payload):
+        """Symmetric to rpc_add_borrower: the decrement is honored only when
+        THIS connection's map recorded the borrow. A remove arriving on a
+        fresh connection after the old one's close already released the
+        borrow must be a no-op — an unconditional decrement would free an
+        object out from under a different live borrower."""
+        oid = payload["object_id"]
+        with self._obj_lock:
+            recorded = conn is None  # internal calls bypass conn accounting
+            if conn is not None:
+                m = self._conn_borrows.get(id(conn))
+                if m is not None and m.get(oid, 0) > 0:
+                    recorded = True
+                    left = m[oid] - 1
+                    if left > 0:
+                        m[oid] = left
+                    else:
+                        m.pop(oid, None)
+            st = self._objects.get(oid)
+            if st is not None and recorded:
+                st.borrowers = max(0, st.borrowers - 1)
                 self._maybe_free(oid, st)
         return True
+
+    def _on_borrower_conn_close(self, conn_key: int) -> None:
+        """The borrower's process (or its link) died: release every borrow
+        registered over that connection."""
+        with self._obj_lock:
+            m = self._conn_borrows.pop(conn_key, None)
+            if not m:
+                return
+            for oid, count in m.items():
+                st = self._objects.get(oid)
+                if st is not None:
+                    st.borrowers = max(0, st.borrowers - count)
+                    self._maybe_free(oid, st)
+        logger.debug("released %d borrows from dead borrower connection",
+                     sum(m.values()))
 
     # ------------------------------------------------------------- ref count
     def _remove_owned_local_ref(self, oid: ObjectID) -> None:
@@ -1052,7 +1130,10 @@ class CoreWorker:
                         return  # idle: next release starts a fresh thread
                 continue
             try:
-                self.peer(owner).notify(method, payload)
+                # Same link the borrow was registered over: the owner's
+                # conn-scoped accounting only honors removes that arrive on
+                # the connection that recorded the add.
+                self.reference_counter.owner_link(owner).notify(method, payload)
             except Exception:
                 logger.debug("%s notify to %s failed", method, owner)
 

@@ -251,3 +251,52 @@ def test_dropped_ref_frees_object_after_completion(ray_start_regular):
             break
         time.sleep(0.1)
     assert not present, "owner table leaked an object dropped while pending"
+
+
+def test_dead_borrower_releases_object(ray_start_regular):
+    """Borrows are connection-scoped (reference WaitForRefRemoved liveness):
+    killing a borrower actor releases its borrow, so the owner can free the
+    object once its own refs are gone — a died borrower no longer pins
+    objects forever."""
+    import time
+
+    import numpy as np
+
+    from ray_tpu.core.worker import current_worker
+
+    @ray_tpu.remote
+    class Holder:
+        def hold(self, wrapped):
+            self.kept = wrapped  # keeps the nested ref (a borrow) alive
+            return True
+
+    big = ray_tpu.put(np.ones(1 << 17))  # ~1 MiB -> plasma, driver-owned
+    oid = big.id
+    h = Holder.remote()
+    assert ray_tpu.get(h.hold.remote([big]), timeout=60)
+
+    w = current_worker()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        with w._obj_lock:
+            if w._objects[oid].borrowers >= 1:
+                break
+        time.sleep(0.1)
+    with w._obj_lock:
+        assert w._objects[oid].borrowers >= 1, "borrow never registered"
+
+    del big  # owner's local ref gone; the actor's borrow keeps it alive
+    time.sleep(1.0)
+    with w._obj_lock:
+        assert oid in w._objects, "freed while still borrowed"
+
+    ray_tpu.kill(h)  # borrower dies -> its connection drops -> borrow released
+    deadline = time.monotonic() + 30
+    present = True
+    while time.monotonic() < deadline:
+        with w._obj_lock:
+            present = oid in w._objects
+        if not present:
+            break
+        time.sleep(0.2)
+    assert not present, "dead borrower's borrow was never released"
