@@ -102,8 +102,12 @@ class Learner:
         if r == 0:
             return batch
         if n < dp:
-            raise ValueError(
-                f"batch of {n} rows is smaller than the dp axis ({dp})")
+            # wrap-pad tiny batches up to dp (mirrors the actor backend's
+            # shard padding) — a ragged SGD tail must not crash training
+            import numpy as np
+
+            idx = np.arange(dp) % n
+            return {k: v[idx] for k, v in batch.items()}
         return {k: v[:n - r] for k, v in batch.items()}
 
     # -------------------------------------------------------------- update
@@ -261,6 +265,22 @@ class LearnerGroup:
                              for a, s in zip(self._actors, shards)])
         return {k: float(np.mean([s[k] for s in stats]))
                 for k in stats[0]} if stats else {}
+
+    def update_minibatches(self, flat: Dict[str, np.ndarray],
+                           num_epochs: int, minibatch_size: int,
+                           rng: np.random.Generator) -> Dict[str, float]:
+        """Epoch/shuffle/minibatch SGD driven through group update()s —
+        one loop serving both backends (reference LearnerGroup.update with
+        minibatching)."""
+        n = len(next(iter(flat.values())))
+        stats: Dict[str, float] = {}
+        for _ in range(num_epochs):
+            idx = rng.permutation(n)
+            for start in range(0, n, minibatch_size):
+                mb = {k: v[idx[start:start + minibatch_size]]
+                      for k, v in flat.items()}
+                stats = self.update(mb)
+        return stats
 
     def get_weights(self) -> Dict[str, np.ndarray]:
         if self._learner is not None:

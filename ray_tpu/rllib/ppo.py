@@ -205,6 +205,13 @@ class PPOConfig:
         self.num_sgd_iter = 4
         self.sgd_minibatch_size = 256
         self.seed = 0
+        # LearnerGroup scaling (reference AlgorithmConfig.resources /
+        # learner settings): backend None = plain local learner;
+        # "mesh" = one jitted update dp-sharded over a Mesh;
+        # "actors" = num_learners gradient-allreducing learner actors.
+        self.learner_backend: Optional[str] = None
+        self.num_learners = 1
+        self.learner_mesh = None
 
     def environment(self, env_maker=None, *, obs_dim=None, num_actions=None) -> "PPOConfig":
         if env_maker is not None:
@@ -236,6 +243,18 @@ class PPOConfig:
                 setattr(self, k, v)
         return self
 
+    def learners(self, *, backend=None, num_learners=None,
+                 mesh=None) -> "PPOConfig":
+        """Scale the update with a LearnerGroup (reference
+        AlgorithmConfig.learners): backend "mesh" or "actors"."""
+        if backend is not None:
+            self.learner_backend = backend
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if mesh is not None:
+            self.learner_mesh = mesh
+        return self
+
     def build(self) -> "PPO":
         return PPO({"ppo_config": self})
 
@@ -244,9 +263,19 @@ class PPO(Algorithm):
     def setup(self, config: Dict[str, Any]) -> None:
         cfg: PPOConfig = config.get("ppo_config") or PPOConfig()
         self.cfg = cfg
-        self.learner = PPOLearner(
-            cfg.obs_dim, cfg.num_actions, cfg.lr, cfg.clip_param,
-            cfg.vf_coeff, cfg.entropy_coeff, cfg.seed)
+        lk = dict(obs_dim=cfg.obs_dim, num_actions=cfg.num_actions,
+                  lr=cfg.lr, clip=cfg.clip_param, vf_coeff=cfg.vf_coeff,
+                  entropy_coeff=cfg.entropy_coeff, seed=cfg.seed)
+        self.learner_group = None
+        if cfg.learner_backend is not None:
+            from ray_tpu.rllib.learner import LearnerGroup
+
+            self.learner_group = LearnerGroup(
+                PPOLearner, lk, backend=cfg.learner_backend,
+                mesh=cfg.learner_mesh, num_learners=cfg.num_learners)
+            self.learner = None
+        else:
+            self.learner = PPOLearner(**lk)
         self.workers = [
             RolloutWorker.options(num_cpus=1).remote(
                 cfg.env_maker, cfg.num_envs_per_worker, cfg.seed + 1000 * (i + 1),
@@ -259,7 +288,8 @@ class PPO(Algorithm):
         self._total_steps = 0
 
     def _broadcast_weights(self) -> None:
-        w = self.learner.get_weights()
+        w = (self.learner_group.get_weights() if self.learner_group is not None
+             else self.learner.get_weights())
         ray_tpu.get([wk.set_weights.remote(w) for wk in self.workers])
 
     def training_step(self) -> Dict[str, Any]:
@@ -285,8 +315,10 @@ class PPO(Algorithm):
         adv = flat["advantages"]
         flat["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
         self._total_steps += int(flat["actions"].size)
-        # 3. learner update
-        stats = self.learner.update_minibatches(
+        # 3. learner update (group-scaled when configured: reference
+        # training_step -> LearnerGroup.update, learner_group.py:52)
+        target = self.learner_group if self.learner_group is not None else self.learner
+        stats = target.update_minibatches(
             flat, cfg.num_sgd_iter, cfg.sgd_minibatch_size, self._rng)
         # 4. broadcast new weights
         self._broadcast_weights()
@@ -301,13 +333,20 @@ class PPO(Algorithm):
         }
 
     def get_weights(self):
+        if self.learner_group is not None:
+            return self.learner_group.get_weights()
         return self.learner.get_weights()
 
     def set_weights(self, weights) -> None:
-        self.learner.set_weights(weights)
+        if self.learner_group is not None:
+            self.learner_group.set_weights(weights)
+        else:
+            self.learner.set_weights(weights)
         self._broadcast_weights()
 
     def stop(self) -> None:
+        if self.learner_group is not None:
+            self.learner_group.shutdown()
         for w in self.workers:
             try:
                 ray_tpu.kill(w)
