@@ -77,6 +77,7 @@ class ServeController:
     def __init__(self):
         self._deployments: Dict[str, dict] = {}
         self._replicas: Dict[str, List[Any]] = {}
+        self._replica_def_version: Dict[int, int] = {}  # id(handle) -> def ver
         self._versions: Dict[str, int] = {}
         self._version_cv = threading.Condition()
         self._probes: Dict[str, dict] = {}  # deployment -> {replica: ref}
@@ -93,15 +94,18 @@ class ServeController:
     def deploy(self, name: str, def_blob: bytes, init_args, init_kwargs,
                num_replicas: int, actor_options: Optional[dict],
                autoscaling: Optional[AutoscalingConfig], max_concurrency: int):
-        if name in self._deployments:
-            # redeploy: tear down old-version replicas; reconcile recreates
-            # them from the new definition (rolling updates are round-2)
-            for r in self._replicas.pop(name, []):
-                try:
-                    ray_tpu.kill(r)
-                except Exception:
-                    pass
-            self._bump_version(name)
+        existing = self._deployments.get(name)
+        # Redeploy = ROLLING update (reference DeploymentState version
+        # rollout): old replicas keep serving; the reconcile loop replaces
+        # them one at a time with health-checked new-definition replicas.
+        def_version = (existing.get("def_version", 0) + 1) if existing else 0
+        carried_draining = []
+        if existing is not None:
+            # a redeploy mid-rollout must not orphan the in-flight replica
+            # (not serving yet — safe to kill) or the draining ones
+            if existing.get("_rolling") is not None:
+                self._kill_replica(name, existing["_rolling"][0])
+            carried_draining = existing.get("_draining", [])
         self._deployments[name] = {
             "def_blob": def_blob,
             "init_args": init_args,
@@ -112,6 +116,8 @@ class ServeController:
             "max_concurrency": max_concurrency,
             "last_scale_up": 0.0,
             "last_scale_down": 0.0,
+            "def_version": def_version,
+            "_draining": carried_draining,
         }
         self._reconcile_one(name)
         return True
@@ -119,12 +125,13 @@ class ServeController:
     def delete_deployment(self, name: str):
         d = self._deployments.pop(name, None)
         self._probes.pop(name, None)
-        for r in self._replicas.pop(name, []):
-            self._evict_stats_client(r)
-            try:
-                ray_tpu.kill(r)
-            except Exception:
-                pass
+        doomed = list(self._replicas.pop(name, []))
+        if d is not None:
+            doomed += [r for r, _dl in d.get("_draining", [])]
+            if d.get("_rolling") is not None:
+                doomed.append(d["_rolling"][0])
+        for r in doomed:
+            self._kill_replica(name, r)
         self._bump_version(name)
         return d is not None
 
@@ -214,14 +221,26 @@ class ServeController:
                 logger.warning("replica of %s failed health check; "
                                "replacing", name)
                 dead.append(r)
-                self._evict_stats_client(r)
-                try:
-                    ray_tpu.kill(r)
-                except Exception:
-                    pass
+                self._kill_replica(name, r)
         if dead:
             self._replicas[name] = [r for r in replicas if r not in dead]
             self._bump_version(name)
+
+    def _new_replica(self, d: dict):
+        opts = dict(d["actor_options"])
+        opts["max_concurrency"] = max(d["max_concurrency"], 4)
+        replica = _ReplicaActor.options(**opts).remote(
+            d["def_blob"], d["init_args"], d["init_kwargs"])
+        self._replica_def_version[id(replica)] = d.get("def_version", 0)
+        return replica
+
+    def _kill_replica(self, name: str, r) -> None:
+        self._replica_def_version.pop(id(r), None)
+        self._evict_stats_client(r)
+        try:
+            ray_tpu.kill(r)
+        except Exception:
+            pass
 
     def _reconcile_one(self, name: str):
         d = self._deployments.get(name)
@@ -230,22 +249,75 @@ class ServeController:
         replicas = self._replicas.setdefault(name, [])
         changed = False
         while len(replicas) < d["target"]:
-            opts = dict(d["actor_options"])
-            opts["max_concurrency"] = max(d["max_concurrency"], 4)
-            replica = _ReplicaActor.options(**opts).remote(
-                d["def_blob"], d["init_args"], d["init_kwargs"])
-            replicas.append(replica)
+            replicas.append(self._new_replica(d))
             changed = True
         while len(replicas) > d["target"]:
             r = replicas.pop()
-            self._evict_stats_client(r)
-            try:
-                ray_tpu.kill(r)
-            except Exception:
-                pass
+            self._kill_replica(name, r)
+            changed = True
+        if self._advance_rollout(name, d, replicas):
             changed = True
         if changed:
             self._bump_version(name)
+
+    def _advance_rollout(self, name: str, d: dict, replicas: List[Any]) -> bool:
+        """One rolling-update step per reconcile pass (reference
+        DeploymentState rollout): start a new-definition replica, wait for
+        its health probe, then swap it in for ONE stale replica — the old
+        version keeps serving throughout, and the displaced replica drains
+        (kill once idle, or after a 30 s deadline)."""
+        ver = d.get("def_version", 0)
+        # reap draining replicas that are idle (or past deadline)
+        draining = d.setdefault("_draining", [])
+        still = []
+        for r, deadline in draining:
+            idle = False
+            try:
+                idle = self._worker_stats(r).get("load", 0) == 0
+            except Exception:
+                # transient stats failure must NOT count as idle (it would
+                # kill a busy replica mid-request); the deadline bounds us
+                idle = False
+            if idle or time.monotonic() > deadline:
+                self._kill_replica(name, r)
+            else:
+                still.append((r, deadline))
+        d["_draining"] = still
+
+        stale = [r for r in replicas
+                 if self._replica_def_version.get(id(r), ver) != ver]
+        roll = d.get("_rolling")
+        if roll is None:
+            if stale and len(replicas) >= d["target"]:
+                nr = self._new_replica(d)
+                d["_rolling"] = (nr, nr.health.remote())
+            return False
+        nr, probe = roll
+        done, _ = ray_tpu.wait([probe], num_returns=1, timeout=0)
+        if not done:
+            return False
+        ok = False
+        try:
+            ok = bool(ray_tpu.get(probe, timeout=1))
+        except Exception:
+            ok = False
+        d["_rolling"] = None
+        if not ok:
+            self._kill_replica(name, nr)  # failed rollout step; retried next pass
+            return False
+        victim = next((r for r in replicas
+                       if self._replica_def_version.get(id(r), ver) != ver), None)
+        if victim is None:
+            # the stale replica disappeared meanwhile (health-check kill +
+            # refill at the current version): the set is already current,
+            # and appending would overshoot target — next pass would kill
+            # the fresh replica mid-request
+            self._kill_replica(name, nr)
+            return False
+        replicas.append(nr)
+        replicas.remove(victim)
+        d["_draining"].append((victim, time.monotonic() + 30.0))
+        return True
 
     def _evict_stats_client(self, replica) -> None:
         cache = getattr(self, "_stats_clients", None)

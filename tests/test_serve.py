@@ -381,3 +381,42 @@ def test_per_node_http_proxies():
         serve.shutdown()
     finally:
         cluster.shutdown()
+
+
+def test_rolling_redeploy_zero_downtime(ray_start_regular):
+    """Redeploying a live deployment rolls replicas one at a time: the old
+    version keeps serving until each new replica passes health (reference
+    DeploymentState version rollout) — requests issued continuously across
+    the rollout must never fail, and eventually all answers come from v2."""
+    import time as _time
+
+    from ray_tpu import serve
+
+    def make(version):
+        @serve.deployment(num_replicas=2, name="roller")
+        def app(x):
+            return {"v": version, "x": x}
+
+        return app
+
+    try:
+        h = serve.run(make(1).bind(), name="roll")
+        assert ray_tpu.get(h.remote(0), timeout=60)["v"] == 1
+
+        h2 = serve.run(make(2).bind(), name="roll")
+        deadline = _time.monotonic() + 90
+        seen_v2 = False
+        while _time.monotonic() < deadline:
+            out = ray_tpu.get(h2.remote(1), timeout=30)  # must NEVER fail
+            assert out["v"] in (1, 2)
+            if out["v"] == 2:
+                seen_v2 = True
+                # all subsequent answers settle on v2 once the roll completes
+                votes = [ray_tpu.get(h2.remote(i), timeout=30)["v"]
+                         for i in range(6)]
+                if all(v == 2 for v in votes):
+                    break
+            _time.sleep(0.2)
+        assert seen_v2, "rollout never produced a v2 response"
+    finally:
+        serve.shutdown()
