@@ -126,6 +126,11 @@ def _exec_block(block_or_ref, ops: List[tuple]) -> Block:
     return _apply_ops(block_or_ref, ops)
 
 
+@ray_tpu.remote
+def _count_rows_after_ops(block_or_ref, ops: List[tuple]) -> int:
+    return _block_len(_apply_ops(block_or_ref, ops))
+
+
 def _apply_batched(fn, batch_size: int, block: Block) -> Block:
     """Slice a block into <=batch_size row batches, apply fn, re-concat."""
     if isinstance(block, list):
@@ -359,7 +364,16 @@ class Datastream:
 
     # ----------------------------------------------------------- consumers
     def count(self) -> int:
-        return sum(_block_len(ray_tpu.get(r)) for r in self._stream_refs())
+        # Logical-plan rules (reference _internal/logical optimizer):
+        # `map` preserves row counts, so a map-only chain counts SOURCE
+        # blocks without running any UDF; and counting ships per-block row
+        # COUNTS, never block data.
+        if all(op[0] == "map" for op in self._ops):
+            ops: List[tuple] = []
+        else:
+            ops = self._ops
+        return sum(ray_tpu.get(
+            [_count_rows_after_ops.remote(r, ops) for r in self._block_refs]))
 
     def _column_reduce(self, col: str, block_fn, combine):
         task = ray_tpu.remote(

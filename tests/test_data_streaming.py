@@ -108,3 +108,23 @@ def test_take_early_exit_does_not_run_everything(small_store_cluster):
     executed = len(os.listdir(marker_dir))
     assert executed <= 2 + get_config().data_max_inflight_blocks + 1, (
         f"{executed} of 24 block tasks ran for take(2)")
+
+
+def test_count_skips_map_udfs(small_store_cluster):
+    """Logical rule: map preserves row counts, so count() on a map-only
+    chain must not execute the UDF (reference logical optimizer)."""
+    import os
+    import tempfile
+
+    cluster, head = small_store_cluster
+    marker_dir = tempfile.mkdtemp(prefix="rtpu_count_")
+
+    def boom(row):
+        open(os.path.join(marker_dir, str(row["id"])), "w").close()
+        return row
+
+    ds = ray_tpu.data.range(16, parallelism=8).map(boom)
+    assert ds.count() == 16
+    assert os.listdir(marker_dir) == [], "count() executed map UDFs"
+    # a filter chain cannot use the shortcut — UDFs must run
+    assert ds.filter(lambda r: r["id"] % 2 == 0).count() == 8
