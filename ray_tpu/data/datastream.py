@@ -128,6 +128,9 @@ def _exec_block(block_or_ref, ops: List[tuple]) -> Block:
 
 @ray_tpu.remote
 def _count_rows_after_ops(block_or_ref, ops: List[tuple]) -> int:
+    """Row count of a block after the op chain (ops=[] = raw block length —
+    the zip()/count() shared counting helper; only ints ship to the driver).
+    """
     return _block_len(_apply_ops(block_or_ref, ops))
 
 
@@ -269,8 +272,8 @@ class Datastream:
         the overlapping right-side blocks — rows never land on the driver."""
         a_refs = self._executed_refs()
         b_refs = other._executed_refs()
-        a_sizes = ray_tpu.get([_count_block.remote(r) for r in a_refs])
-        b_sizes = ray_tpu.get([_count_block.remote(r) for r in b_refs])
+        a_sizes = ray_tpu.get([_count_rows_after_ops.remote(r, []) for r in a_refs])
+        b_sizes = ray_tpu.get([_count_rows_after_ops.remote(r, []) for r in b_refs])
         if sum(a_sizes) != sum(b_sizes):
             raise ValueError(
                 f"zip requires equal lengths: {sum(a_sizes)} vs {sum(b_sizes)}")
@@ -729,11 +732,6 @@ def _zip_merge(a_block: Block, ranges: List[tuple], *b_blocks: Block) -> Block:
 def _limit_exec_block(block: Block, ops: List[tuple], n: int) -> Block:
     block = _apply_ops(block, ops)
     return _slice_block(block, 0, min(n, _block_len(block)))
-
-
-@ray_tpu.remote
-def _count_block(block: Block) -> int:
-    return _block_len(block)
 
 
 class GroupedData:
