@@ -290,10 +290,10 @@ class Raylet:
     def _heartbeat_loop(self) -> None:
         period = get_config().health_check_period_ms / 1000.0
         while not self._shutdown.wait(period):
+            with self._lock:
+                demands = [self._effective_demand(qt.spec)
+                           for qt in list(self._queue)[:100]]
             try:
-                with self._lock:
-                    demands = [self._effective_demand(qt.spec)
-                               for qt in list(self._queue)[:100]]
                 self._gcs.call("heartbeat", {
                     "node_id": self.node_id.binary(),
                     "resources_available": dict(self.resources_available),
@@ -302,6 +302,19 @@ class Raylet:
             except Exception:
                 if not self._shutdown.is_set():
                     logger.warning("heartbeat to GCS failed")
+            # Periodic retry for queued tasks — independent of the GCS call
+            # (local dispatch needs no GCS, and a down control plane is
+            # exactly when the retry matters): scheduling is otherwise
+            # event-driven (resource broadcasts fire on ACTIVITY), so on an
+            # idle cluster a task queued behind a dead/suspect target would
+            # starve forever — e.g. a lineage reconstruction spilled to a
+            # node that died with no other traffic to re-trigger dispatch.
+            try:
+                if demands:
+                    self._schedule()
+            except Exception:
+                if not self._shutdown.is_set():
+                    logger.exception("periodic schedule retry failed")
 
     def _report_resources(self) -> None:
         try:

@@ -1,0 +1,75 @@
+"""Chaos: node death AND a GCS restart under live load (reference
+`release/nightly_tests/chaos_test/` + NodeKillerActor,
+`python/ray/_private/test_utils.py:1366`): every submitted task must still
+complete correctly through retries, lineage recovery and control-plane
+re-registration."""
+
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.cluster import Cluster
+
+
+@pytest.mark.slow
+def test_tasks_survive_node_kill_and_gcs_restart():
+    snap = tempfile.mktemp(prefix="rtpu_chaos_snap_")
+    cluster = Cluster(gcs_snapshot_path=snap)
+    cluster.add_node(num_cpus=2, resources={"keep": 1})
+    victim = cluster.add_node(num_cpus=2)
+    cluster.connect()
+    try:
+        @ray_tpu.remote(max_retries=5)
+        def work(i):
+            import time as t
+
+            t.sleep(0.3)
+            return int(np.sum(np.arange(i + 1)))
+
+        refs = [work.remote(i) for i in range(24)]
+        time.sleep(1.0)  # let work spread across both nodes
+        cluster.remove_node(victim)          # chaos 1: node death mid-run
+        cluster.restart_gcs()                # chaos 2: control plane restart
+        cluster.add_node(num_cpus=2)         # replacement capacity
+        out = ray_tpu.get(refs, timeout=300)
+        assert out == [i * (i + 1) // 2 for i in range(24)]
+
+        # cluster still fully functional: actors schedulable, state intact
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return "ok"
+
+        a = A.remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == "ok"
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_lineage_recovery_under_gcs_restart():
+    """Object reconstruction must work even when the GCS restarted between
+    production and loss of the object (recovery is owner<->raylet, but the
+    resubmitted task schedules against the rebuilt cluster view)."""
+    snap = tempfile.mktemp(prefix="rtpu_chaos_snap2_")
+    cluster = Cluster(gcs_snapshot_path=snap)
+    cluster.add_node(num_cpus=2, resources={"head": 1})
+    work = cluster.add_node(num_cpus=2, resources={"work": 2})
+    cluster.connect()
+    try:
+        @ray_tpu.remote(resources={"work": 1})
+        def produce():
+            return np.full(1 << 17, 3.0)  # ~1 MiB -> plasma on work node
+
+        ref = produce.remote()
+        ray_tpu.wait([ref], num_returns=1, timeout=60)
+        cluster.restart_gcs()
+        cluster.remove_node(work)
+        cluster.add_node(num_cpus=2, resources={"work": 2})
+        out = ray_tpu.get(ref, timeout=180)
+        assert float(out[0]) == 3.0
+    finally:
+        cluster.shutdown()
