@@ -22,6 +22,7 @@ import inspect
 import logging
 import os
 import queue
+import random
 import threading
 import time
 import traceback
@@ -77,6 +78,7 @@ class _ObjectState:
     state: str = "pending"          # pending | inline | plasma | error
     inline_blob: Optional[bytes] = None
     location: Optional[str] = None  # raylet address holding the primary copy
+    extra_locations: List[str] = field(default_factory=list)  # pulled copies
     size: int = 0
     local_refs: int = 0
     borrowers: int = 0
@@ -212,6 +214,8 @@ class CoreWorker:
         # borrows keyed by the borrower's server connection (see
         # rpc_add_borrower): conn id -> {object_id: count}
         self._conn_borrows: Dict[int, Dict[ObjectID, int]] = {}
+        # objects whose local pulled copy we already announced to the owner
+        self._registered_copies: set = set()
 
         # grace-deferred plasma frees (see _maybe_free)
         self._deferred_frees: deque = deque()
@@ -586,11 +590,15 @@ class CoreWorker:
                         f"object {ref.id} lost from {source} and could not "
                         f"be reconstructed")
                 try:
-                    return self._fetch_plasma(ref, info, deadline)
+                    value = self._fetch_plasma(ref, info, deadline)
+                    self._note_pulled_copy(ref)
+                    return value
                 except ObjectLostError:
-                    # First failure of this source: re-resolve before spending
-                    # a reconstruction — a concurrent getter's recovery may
-                    # already have produced a copy at a new location.
+                    # First failure of this source: tell the owner so other
+                    # resolvers stop being pointed at the stale copy, then
+                    # re-resolve before spending a reconstruction — another
+                    # location (or a concurrent getter's recovery) may serve.
+                    self._note_location_failed(ref, source)
                     failed_sources.add(source)
                     continue
             if kind == "error":
@@ -678,6 +686,43 @@ class CoreWorker:
             return serialization.loads(data)
         raise ObjectLostError(f"object {ref.id} vanished during fetch: {last_err}")
 
+    def _note_pulled_copy(self, ref: ObjectRef) -> None:
+        """A successful pull materialized a copy on OUR raylet: register it
+        with the owner so later readers spread across holders (once per
+        object — repeat gets of a hot ref must not spam the owner)."""
+        if ref.id in self._registered_copies:
+            return
+        self._registered_copies.add(ref.id)
+        if len(self._registered_copies) > 100_000:
+            self._registered_copies.clear()  # crude bound; re-notifies are idempotent
+        try:
+            if ref.owner_address in ("", self.address):
+                with self._obj_lock:
+                    st = self._objects.get(ref.id)
+                    if (st is not None and st.state == "plasma"
+                            and self.raylet_address != st.location
+                            and self.raylet_address not in st.extra_locations):
+                        st.extra_locations.append(self.raylet_address)
+            else:
+                self.peer(ref.owner_address).notify(
+                    "add_object_location",
+                    {"object_id": ref.id, "raylet": self.raylet_address})
+        except Exception:
+            pass
+
+    def _note_location_failed(self, ref: ObjectRef, source: Optional[str]) -> None:
+        if not source:
+            return
+        try:
+            if ref.owner_address in ("", self.address):
+                self._drop_location(ref.id, source)
+            else:
+                self.peer(ref.owner_address).notify(
+                    "object_location_failed",
+                    {"object_id": ref.id, "raylet": source})
+        except Exception:
+            pass
+
     # ------------------------------------------------------ lineage recovery
     def _recover_object(self, ref: ObjectRef) -> bool:
         """Arrange for a lost object to be recomputed. Returns True if a
@@ -703,6 +748,46 @@ class CoreWorker:
         Callers must NOT hold _obj_lock: the trailing notifies do network I/O.
         """
         cfg = get_config()
+        # Owner-side liveness probe first (reference ObjectRecoveryManager
+        # pins/locates before reconstructing): if ANY known location still
+        # holds the object, repair the directory instead of re-executing —
+        # a reader's failed pull of one stale copy must not re-run tasks.
+        with self._obj_lock:
+            st0 = self._objects.get(oid)
+            locs = ([st0.location] + list(st0.extra_locations)
+                    if st0 is not None and st0.state == "plasma" else [])
+        live = None
+        for loc in locs:
+            if not loc:
+                continue
+            try:
+                if loc == self.raylet_address:
+                    found = self.raylet.call("obj_lookup", {"object_id": oid},
+                                             timeout=3)
+                else:
+                    # short-lived, short-timeout probe: peer() would retry
+                    # connecting to a dead raylet for rpc_connect_timeout_s
+                    # (30s) — far too long for a liveness check, and this
+                    # runs on the RPC handler path for borrower-triggered
+                    # reconstructions
+                    probe = rpc.RpcClient(loc, connect_timeout=2)
+                    try:
+                        found = probe.call("obj_lookup", {"object_id": oid},
+                                           timeout=3)
+                    finally:
+                        probe.close()
+                if found is not None:
+                    live = loc
+                    break
+            except Exception:
+                continue
+        if live is not None:
+            with self._obj_lock:
+                st0 = self._objects.get(oid)
+                if st0 is not None and st0.state == "plasma":
+                    st0.location = live
+                    st0.extra_locations = []  # dead copies re-register on pull
+            return True
         with self._obj_lock:
             spec = self._lineage.get(oid)
             if spec is None:
@@ -825,7 +910,39 @@ class CoreWorker:
             return {"kind": "inline", "data": st.inline_blob}
         if st.state == "error":
             return {"kind": "error", "data": st.inline_blob}
-        return {"kind": "plasma", "raylet": st.location, "size": st.size}
+        # Location spreading (reference OwnershipBasedObjectDirectory with
+        # multiple locations): readers that pulled a copy register it, and
+        # later readers are pointed at a random holder — a 1 GiB broadcast
+        # fans out across copies instead of hammering the primary.
+        locs = [st.location] + st.extra_locations
+        return {"kind": "plasma", "raylet": random.choice(locs),
+                "size": st.size}
+
+    def rpc_add_object_location(self, conn, req_id, payload):
+        """A reader materialized a copy of our object on its raylet."""
+        with self._obj_lock:
+            st = self._objects.get(payload["object_id"])
+            loc = payload["raylet"]
+            if (st is not None and st.state == "plasma"
+                    and loc != st.location and loc not in st.extra_locations):
+                st.extra_locations.append(loc)
+        return True
+
+    def rpc_object_location_failed(self, conn, req_id, payload):
+        """A reader's pull from `raylet` failed: prune the stale copy
+        (evicted or node died) so resolvers stop being pointed at it."""
+        self._drop_location(payload["object_id"], payload["raylet"])
+        return True
+
+    def _drop_location(self, oid: ObjectID, loc: str) -> None:
+        """Prune a stale PULLED copy. The pinned primary is never dropped on
+        a reader's report alone (a transient pull failure would orphan the
+        pinned plasma copy); primary repair happens in _try_reconstruct's
+        owner-side liveness probe."""
+        with self._obj_lock:
+            st = self._objects.get(oid)
+            if st is not None and loc in st.extra_locations:
+                st.extra_locations.remove(loc)
 
     def add_done_callback(self, ref: ObjectRef, cb: Callable[[], None]) -> None:
         """Invoke `cb` (cheap, non-blocking!) when the owned object reaches a
@@ -899,6 +1016,7 @@ class CoreWorker:
                 elif kind == "plasma":
                     st.state = "plasma"
                     st.location = entry[2]
+                    st.extra_locations = []  # stale copies died with the old run
                     st.size = entry[3]
                 elif kind == "error":
                     st.state = "error"
@@ -1096,12 +1214,16 @@ class CoreWorker:
         self._delete_plasma(oid, st)
 
     def _delete_plasma(self, oid: ObjectID, st: _ObjectState) -> None:
-        if st.state == "plasma" and st.location:
+        if st.state != "plasma":
+            return
+        for loc in [st.location] + st.extra_locations:
+            if not loc:
+                continue
             try:
-                if st.location == self.raylet_address:
+                if loc == self.raylet_address:
                     self.raylet.notify("obj_delete", {"object_id": oid})
                 else:
-                    self.peer(st.location).notify("obj_delete", {"object_id": oid})
+                    self.peer(loc).notify("obj_delete", {"object_id": oid})
             except Exception:
                 pass
 

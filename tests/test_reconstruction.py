@@ -115,3 +115,38 @@ def test_reconstruction_survives_repeat_gets(head_and_worker_cluster):
     with open(marker) as f:
         assert f.read().count("ran") == 2
     os.unlink(marker)
+
+
+def test_copy_failover_avoids_reexecution():
+    """Pulled copies register with the owner (multi-location directory):
+    when the primary's node dies but a pulled copy survives elsewhere, gets
+    fail over to the copy WITHOUT re-executing the creating task."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2, resources={"head": 1})
+    a = cluster.add_node(num_cpus=2, resources={"a": 1})
+    cluster.add_node(num_cpus=2, resources={"b": 1})
+    cluster.connect()
+    marker = _counter_file()
+    try:
+        @ray_tpu.remote(resources={"a": 1})
+        def produce(path):
+            with open(path, "a") as f:
+                f.write("ran\n")
+            return np.full(1 << 17, 5.0)
+
+        @ray_tpu.remote(resources={"b": 1})
+        def consume(arr):
+            return float(arr[0])
+
+        ref = produce.remote(marker)
+        # consuming on node b pulls a copy there and registers the location
+        assert ray_tpu.get(consume.remote(ref), timeout=120) == 5.0
+        cluster.remove_node(a)  # primary copy gone; b's copy survives
+        out = ray_tpu.get(ref, timeout=120)
+        assert float(out[0]) == 5.0
+        with open(marker) as f:
+            assert f.read().count("ran") == 1, (
+                "re-executed despite a surviving copy")
+    finally:
+        cluster.shutdown()
+        os.unlink(marker)
