@@ -72,6 +72,7 @@ def test_ring_attention_matches_reference(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_ring_attention_grads_match():
     mesh = make_virtual_mesh(8, MeshConfig(dp=1, fsdp=1, tp=2, sp=4))
     rng = jax.random.PRNGKey(7)
@@ -107,6 +108,7 @@ def test_ulysses_attention_matches_reference(causal):
                                atol=2e-4)
 
 
+@pytest.mark.slow
 def test_ulysses_attention_grads_match():
     from ray_tpu.ops.ulysses import ulysses_attention_sharded
 

@@ -133,6 +133,7 @@ def test_train_step_with_ulysses_sequence_parallel():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_hybrid_dcn_mesh_train_step():
     """2 simulated slices x 4-chip ICI mesh: dp rides the dcn axis."""
     from ray_tpu.parallel import make_hybrid_mesh
