@@ -91,9 +91,12 @@ class _ObjectState:
 
 class ReferenceCounter:
     """Ownership + borrowed reference tracking (reference semantics of
-    `src/ray/core_worker/reference_count.h`, simplified: borrower count is a
-    plain distributed count rather than the full transitive borrow-table
-    protocol; nested borrows are registered at deserialization time)."""
+    `src/ray/core_worker/reference_count.h`). Borrows are registered with
+    the owner at deserialization time over a per-owner reconnecting link
+    and are CONNECTION-SCOPED on the owner (a dead borrower's dropped link
+    releases them — the reference's WaitForRefRemoved liveness role — and
+    a reconnect replays live borrows). Transitive borrowers register with
+    the owner directly rather than through per-hop borrow tables."""
 
     def __init__(self, worker: "CoreWorker"):
         self._worker = worker
