@@ -231,6 +231,20 @@ class GcsServer:
                 conn.on_close.append(lambda c, ch=ch: self._unsub(ch, c))
         return True
 
+    def rpc_publish(self, conn, req_id, payload):
+        """Generic application-level publish: fan a message out to every
+        subscriber of an arbitrary channel (reference GcsPublisher allows
+        app channels the same way, pubsub.proto:28-46). Serve's controller
+        uses this to PUSH replica-set version bumps to handles instead of
+        parking their long-polls on its exec threads."""
+        self._publish(payload["channel"], payload["message"])
+        return True
+
+    def rpc_unsubscribe(self, conn, req_id, payload):
+        for ch in payload["channels"]:
+            self._unsub(ch, conn)
+        return True
+
     def _unsub(self, channel: str, conn) -> None:
         try:
             self._subs.get(channel, []).remove(conn)

@@ -84,6 +84,9 @@ class _ObjectState:
     borrowers: int = 0
     submitted_task_deps: int = 0    # in-flight tasks depending on this object
     shipped: bool = False           # a ref to this object was serialized out
+    container_pinned: int = 0       # live owned containers holding our ref
+    contained_pins: List["ObjectID"] = field(default_factory=list)  # inner oids we pin
+    contained_borrows: List = field(default_factory=list)  # counted refs we borrow
     free_after: Optional[float] = None  # deferred-free deadline (monotonic)
     waiters: List[Tuple] = field(default_factory=list)  # (conn, req_id) info waiters
     callbacks: List[Callable] = field(default_factory=list)  # done callbacks
@@ -213,6 +216,11 @@ class CoreWorker:
         # Insertion-ordered; FIFO-evicted at lineage_table_max_entries.
         self._lineage: Dict[ObjectID, TaskSpec] = {}
         self._lineage_attempts: Dict[TaskID, int] = {}
+        # per-task record of arg pins actually taken (guarded by _obj_lock)
+        self._task_pins: Dict[TaskID, List[ObjectID]] = {}
+        # application pubsub subscriptions (channel -> callbacks)
+        self._channel_callbacks: Dict[str, List[Callable]] = {}
+        self._channel_cb_lock = threading.Lock()
 
         # borrows keyed by the borrower's server connection (see
         # rpc_add_borrower): conn id -> {object_id: count}
@@ -373,7 +381,7 @@ class CoreWorker:
             task_type=TaskType.NORMAL,
             function_blob=cloudpickle.dumps(func),
             method_name=getattr(func, "__name__", "anonymous"),
-            args=self._serialize_args(args),
+            args=self._serialize_args(args, task_id),
             kwargs_blob=serialization.dumps(kwargs) if kwargs else None,
             num_returns=num_returns,
             resources=dict(resources or {}),
@@ -451,7 +459,8 @@ class CoreWorker:
                 self._lineage_attempts.pop(old.task_id, None)
         return refs
 
-    def _serialize_args(self, args: tuple) -> List[Tuple]:
+    def _serialize_args(self, args: tuple,
+                        task_id: Optional[TaskID] = None) -> List[Tuple]:
         """Inline small values; pass refs through; promote big args to the
         object store (cf. reference: big args -> plasma `Put`)."""
         out: List[Tuple] = []
@@ -459,7 +468,7 @@ class CoreWorker:
         for a in args:
             if isinstance(a, ObjectRef):
                 out.append(("ref", a.id, a.owner_address))
-                self._pin_for_submission(a)
+                self._pin_for_submission(a, task_id)
             else:
                 s = serialization.serialize(a)
                 self._mark_shipped(s.contained_refs)
@@ -470,11 +479,19 @@ class CoreWorker:
                     # Pin: the promoted ref's only Python instance dies right
                     # here, so without the task-dep pin the object would be
                     # freed before the executor fetches it.
-                    self._pin_for_submission(ref)
+                    self._pin_for_submission(ref, task_id)
                     out.append(("ref", ref.id, ref.owner_address))
         return out
 
-    def _pin_for_submission(self, ref: ObjectRef) -> None:
+    def _pin_for_submission(self, ref: ObjectRef,
+                            task_id: Optional[TaskID]) -> None:
+        """Pin an owned arg for a task's lifetime. Pins are RECORDED per
+        task so the unpin decrements exactly what was pinned: an arg whose
+        entry was already freed at pin time must not be decremented at
+        report time (it may have been recreated by recursive recovery in
+        between, and an unmatched decrement would drive the count negative
+        and let a later task's dep be freed out from under it). task_id
+        None (actor-creation args) pins for the actor's lifetime."""
         if ref.owner_address != self.address:
             return
         with self._obj_lock:
@@ -482,6 +499,8 @@ class CoreWorker:
             if st is not None:
                 st.submitted_task_deps += 1
                 st.shipped = True  # the executor materializes a borrow
+                if task_id is not None:
+                    self._task_pins.setdefault(task_id, []).append(ref.id)
 
     def _mark_shipped(self, refs) -> None:
         """Mark owned objects whose refs were serialized into an outgoing
@@ -494,13 +513,14 @@ class CoreWorker:
                         st.shipped = True
 
     def _unpin_after_task(self, spec: TaskSpec) -> None:
-        for a in spec.args:
-            if a[0] == "ref" and a[2] == self.address:
-                with self._obj_lock:
-                    st = self._objects.get(a[1])
-                    if st is not None:
-                        st.submitted_task_deps -= 1
-                        self._maybe_free(a[1], st)
+        """Release exactly the pins _pin_for_submission recorded for this
+        task (pop makes a double report idempotent)."""
+        with self._obj_lock:
+            for oid in self._task_pins.pop(spec.task_id, ()):
+                st = self._objects.get(oid)
+                if st is not None:
+                    st.submitted_task_deps -= 1
+                    self._maybe_free(oid, st)
 
     # ------------------------------------------------------------------ put
     @property
@@ -532,8 +552,21 @@ class CoreWorker:
                 st.size = s.total_bytes
                 self._obj_cv.notify_all()
         # Refs nested in the stored value: shipping them into the store means
-        # borrows can materialize later from any reader.
+        # borrows can materialize later from any reader. Owned inner objects
+        # additionally get a CONTAINER PIN — they stay alive as long as the
+        # enclosing object does, because a reader may deserialize the payload
+        # (and only then register its borrow) arbitrarily late. The reference
+        # tracks this as nested-ref containment in its borrow tables
+        # (reference_count.h:834); a grace window alone cannot cover it.
         self._mark_shipped(s.contained_refs)
+        with self._obj_lock:
+            seen = set()
+            for r in s.contained_refs or ():
+                if (r.owner_address == self.address and r.id != oid
+                        and r.id not in seen and r.id in self._objects):
+                    seen.add(r.id)
+                    self._objects[r.id].container_pinned += 1
+                    st.contained_pins.append(r.id)
         self._notify_info_waiters(oid)
         ref = ObjectRef(oid, owner_address=self.address)
         ref._counted = True
@@ -825,7 +858,7 @@ class CoreWorker:
                 for a in spec.args:
                     if a[0] == "ref" and a[2] == self.address:
                         self._pin_for_submission(
-                            ObjectRef(a[1], owner_address=a[2]))
+                            ObjectRef(a[1], owner_address=a[2]), spec.task_id)
         if submit:
             logger.info("reconstructing %s by re-executing task %s",
                         oid, spec.method_name)
@@ -1007,6 +1040,7 @@ class CoreWorker:
             return True
         for entry in payload["results"]:
             kind, oid = entry[0], entry[1]
+            contained = ()
             with self._obj_lock:
                 st = self._objects.get(oid)
                 if st is None:
@@ -1016,15 +1050,19 @@ class CoreWorker:
                     st.state = "inline"
                     st.inline_blob = entry[2]
                     st.size = len(entry[2])
+                    contained = entry[3] if len(entry) > 3 else ()
                 elif kind == "plasma":
                     st.state = "plasma"
                     st.location = entry[2]
                     st.extra_locations = []  # stale copies died with the old run
                     st.size = entry[3]
+                    contained = entry[4] if len(entry) > 4 else ()
                 elif kind == "error":
                     st.state = "error"
                     st.inline_blob = entry[2]
                 self._obj_cv.notify_all()
+            if contained:
+                self._adopt_contained_refs(oid, contained)
             self._notify_info_waiters(oid)
             # The last ref may have died while the task was still pending
             # (_maybe_free's pending guard kept the entry); now that the
@@ -1199,7 +1237,8 @@ class CoreWorker:
         flight when the owner's last local ref dies (the reference resolves
         this with the full borrow-table protocol, reference_count.h:834; the
         grace window + lineage recovery approximate it)."""
-        if st.local_refs > 0 or st.borrowers > 0 or st.submitted_task_deps > 0:
+        if (st.local_refs > 0 or st.borrowers > 0
+                or st.submitted_task_deps > 0 or st.container_pinned > 0):
             st.free_after = None
             return
         if st.state == "pending":
@@ -1208,13 +1247,72 @@ class CoreWorker:
             # Inline objects race identically: the receiver's add_borrower
             # notify may be in flight when the owner's last ref dies.
             if st.free_after is None:
-                st.free_after = (time.monotonic()
-                                 + get_config().object_free_grace_period_ms / 1000.0)
+                grace_ms = get_config().object_free_grace_period_ms
+                if oid not in self._lineage:
+                    # No lineage means no reconstruction backstop (puts and
+                    # actor returns, worker.py _register_returns): a borrow
+                    # landing after the free would be an UNRECOVERABLE loss,
+                    # so give the registration far longer to arrive — it may
+                    # be stuck behind an owner-link reconnect backoff.
+                    grace_ms *= 10
+                st.free_after = time.monotonic() + grace_ms / 1000.0
                 self._deferred_frees.append(oid)
                 self._ensure_free_sweeper()
             return
         self._objects.pop(oid, None)
+        self._release_contained_pins(st)
         self._delete_plasma(oid, st)
+
+    def _release_contained_pins(self, st: _ObjectState) -> None:
+        """Caller holds _obj_lock. The container object is gone: drop the
+        pins it held on owned refs nested inside its payload, and the
+        counted borrow refs for other-owned inner objects (their __del__
+        notifies the owners off-thread)."""
+        pins, st.contained_pins = st.contained_pins, []
+        st.contained_borrows = []
+        for inner in pins:
+            ist = self._objects.get(inner)
+            if ist is not None:
+                ist.container_pinned = max(0, ist.container_pinned - 1)
+                self._maybe_free(inner, ist)
+
+    def _adopt_contained_refs(self, container_oid: ObjectID, contained) -> None:
+        """A task return we own carries nested refs: keep each inner object
+        alive for the CONTAINER's lifetime — a reader may deserialize the
+        payload (registering its own borrow only then) arbitrarily late.
+        Caller-owned inner refs get a container pin (like put()); refs owned
+        elsewhere (e.g. the executing actor) get a counted borrow held by
+        the container (reference nested-ref tracking, reference_count.h:834)."""
+        borrows = []
+        for ioid, iowner in contained:
+            if iowner == self.address:
+                with self._obj_lock:
+                    cst = self._objects.get(container_oid)
+                    ist = self._objects.get(ioid)
+                    # a re-reported task (retry/reconstruction) must not
+                    # double-pin: ids are deterministic across re-runs
+                    if (cst is not None and ist is not None
+                            and ioid != container_oid
+                            and ioid not in cst.contained_pins):
+                        ist.container_pinned += 1
+                        cst.contained_pins.append(ioid)
+            else:
+                with self._obj_lock:
+                    cst = self._objects.get(container_oid)
+                    if cst is not None and any(
+                            b.id == ioid for b in cst.contained_borrows):
+                        continue  # re-report: borrow already held
+                r = ObjectRef(ioid, owner_address=iowner)
+                self.reference_counter.add_borrowed(r)
+                r._counted = True
+                borrows.append(r)
+        if borrows:
+            with self._obj_lock:
+                cst = self._objects.get(container_oid)
+                if cst is not None:
+                    cst.contained_borrows.extend(borrows)
+            # container already freed: `borrows` dies here and the refs'
+            # __del__ releases the just-taken borrows
 
     def _delete_plasma(self, oid: ObjectID, st: _ObjectState) -> None:
         if st.state != "plasma":
@@ -1285,10 +1383,12 @@ class CoreWorker:
                         remaining.append(oid)
                         continue
                     if (st.local_refs > 0 or st.borrowers > 0
-                            or st.submitted_task_deps > 0):
+                            or st.submitted_task_deps > 0
+                            or st.container_pinned > 0):
                         st.free_after = None  # a borrow landed within grace
                         continue
                     self._objects.pop(oid, None)
+                    self._release_contained_pins(st)
                     due.append((oid, st))
                 self._deferred_frees = remaining
                 if not self._deferred_frees and not due:
@@ -1329,7 +1429,7 @@ class CoreWorker:
             task_type=TaskType.ACTOR_TASK,
             function_blob=None,
             method_name=method_name,
-            args=self._serialize_args(args),
+            args=self._serialize_args(args, task_id),
             kwargs_blob=serialization.dumps(kwargs) if kwargs else None,
             num_returns=num_returns,
             owner_address=self.address,
@@ -1416,6 +1516,7 @@ class CoreWorker:
                     st.inline_blob = blob
                     self._obj_cv.notify_all()
             self._notify_info_waiters(oid)
+        self._unpin_after_task(spec)
 
     def _log_print_queue(self) -> "queue.Queue":
         q = getattr(self, "_log_queue", None)
@@ -1452,6 +1553,10 @@ class CoreWorker:
             if self.log_to_driver:
                 channels.append("logs")
             raw.call("subscribe", {"channels": channels}, timeout=30)
+        with self._channel_cb_lock:
+            dynamic = [ch for ch, cbs in self._channel_callbacks.items() if cbs]
+        if dynamic:
+            raw.call("subscribe", {"channels": dynamic}, timeout=30)
         if self.actor_id is not None and self._actor_instance is not None:
             spec = self._actor_creation_spec
             raw.call("reregister_actor", {
@@ -1463,9 +1568,46 @@ class CoreWorker:
             logger.info("actor %s re-registered with restarted GCS",
                         self.actor_id)
 
+    # ---------------------------------------------------------- app pubsub
+    def subscribe_channel(self, channel: str, callback) -> None:
+        """Subscribe to an application pubsub channel; `callback(message)`
+        runs on the GCS push reader thread (keep it non-blocking). Survives
+        GCS restart: dynamic channels are replayed on re-subscribe."""
+        with self._channel_cb_lock:
+            cbs = self._channel_callbacks.setdefault(channel, [])
+            first = not cbs
+            cbs.append(callback)
+        if first:
+            self.gcs.call("subscribe", {"channels": [channel]}, timeout=30)
+
+    def unsubscribe_channel(self, channel: str, callback) -> None:
+        with self._channel_cb_lock:
+            cbs = self._channel_callbacks.get(channel, [])
+            if callback in cbs:
+                cbs.remove(callback)
+            empty = not cbs
+            if empty:
+                self._channel_callbacks.pop(channel, None)
+        if empty:
+            try:  # drop the GCS-side fan-out entry too
+                self.gcs.notify("unsubscribe", {"channels": [channel]})
+            except Exception:
+                pass
+
+    def publish(self, channel: str, message) -> None:
+        self.gcs.notify("publish", {"channel": channel, "message": message})
+
     def _on_gcs_push(self, method: str, payload) -> None:
         if method != "pubsub":
             return
+        with self._channel_cb_lock:
+            cbs = list(self._channel_callbacks.get(payload["channel"], ()))
+        for cb in cbs:
+            try:
+                cb(payload["message"])
+            except Exception:
+                logger.exception("pubsub callback failed on %s",
+                                 payload["channel"])
         if payload["channel"] == "logs":
             msg = payload["message"]
             # only this driver's job (unattributed lines pass through);
@@ -1694,13 +1836,20 @@ class CoreWorker:
             for oid, v in zip(spec.return_object_ids(), values):
                 s = serialization.serialize(v)
                 # Own refs nested in a return value (e.g. an actor handing out
-                # refs to objects it created) escape to the caller.
+                # refs to objects it created) escape to the caller. Their
+                # descriptors ship WITH the result so the caller — who owns
+                # the enclosing return object — can keep them alive for the
+                # container's lifetime (pin if caller-owned, borrow
+                # otherwise), mirroring put()'s container pins.
                 self._mark_shipped(s.contained_refs)
+                contained = list({(r.id, r.owner_address or self.address)
+                                  for r in (s.contained_refs or ())})
                 if s.total_bytes <= cfg.max_direct_call_object_size:
-                    results.append(("inline", oid, s.to_bytes()))
+                    results.append(("inline", oid, s.to_bytes(), contained))
                 else:
                     self._put_to_store(oid, s)
-                    results.append(("plasma", oid, self.raylet_address, s.total_bytes))
+                    results.append(("plasma", oid, self.raylet_address,
+                                    s.total_bytes, contained))
         except Exception as e:
             from ray_tpu.core.exceptions import ActorError
             cls = ActorError if spec.task_type == TaskType.ACTOR_TASK else TaskError

@@ -33,6 +33,7 @@ import ray_tpu
 logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "_serve_controller"
+SERVE_VERSIONS_CHANNEL = "serve_replica_versions"
 PROXY_NAME = "_serve_http_proxy"
 
 
@@ -45,15 +46,33 @@ class AutoscalingConfig:
     downscale_delay_s: float = 5.0
 
 
+def _replica_key(r) -> bytes:
+    """Stable identity for a replica handle: the ACTOR id, not id(handle) —
+    handle objects are recreated (and their id() reused by the allocator),
+    and controller-local maps die with the controller."""
+    aid = getattr(r, "_actor_id", None) or getattr(r, "actor_id", None)
+    return aid.binary() if hasattr(aid, "binary") else bytes(str(aid), "utf8")
+
+
 @ray_tpu.remote
 class _ReplicaActor:
-    def __init__(self, def_blob: bytes, init_args, init_kwargs):
+    def __init__(self, def_blob: bytes, init_args, init_kwargs,
+                 def_version: int = 0):
         target = cloudpickle.loads(def_blob)
         if isinstance(target, type):
             self._callable = target(*init_args, **(init_kwargs or {}))
         else:
             self._callable = target
         self._inflight = 0
+        # The deployment-definition version this replica was built from
+        # lives ON the replica: a restarted controller recovers it by
+        # asking, instead of defaulting every pre-restart replica to
+        # "current" and silently skipping their rollout (reference keeps
+        # the version in DeploymentReplica state, deployment_state.py).
+        self._def_version = def_version
+
+    def def_version(self) -> int:
+        return self._def_version
 
     def handle_request(self, method_name: str, args, kwargs):
         self._inflight += 1
@@ -74,21 +93,119 @@ class _ReplicaActor:
 class ServeController:
     """Reconciles deployment target state into replica actors."""
 
+    _KV_KEY = "controller_state"
+
     def __init__(self):
         self._deployments: Dict[str, dict] = {}
         self._replicas: Dict[str, List[Any]] = {}
-        self._replica_def_version: Dict[int, int] = {}  # id(handle) -> def ver
+        self._replica_def_version: Dict[bytes, int] = {}  # actor id -> def ver
+        self._version_queries: Dict[bytes, Any] = {}  # in-flight def_version asks
         self._versions: Dict[str, int] = {}
         self._version_cv = threading.Condition()
         self._probes: Dict[str, dict] = {}  # deployment -> {replica: ref}
         self._shutdown = False
+        import uuid
+
+        # distinguishes controller incarnations: a handle comparing versions
+        # across a controller restart (or a torn-down-and-rebooted cluster)
+        # must not mistake a coincidentally-equal version for "no change"
+        self._incarnation = uuid.uuid4().hex
+        self._restoring = True
+        try:
+            self._restore_state()
+        finally:
+            self._restoring = False
         self._thread = threading.Thread(target=self._reconcile_loop, daemon=True)
         self._thread.start()
 
+    # -------------------------------------------------------- fault tolerance
+    def _checkpoint(self) -> None:
+        """Persist deployment specs + live replica actor ids to the GCS KV
+        (reference serve checkpoints its state the same way,
+        serve/_private/storage/kv_store.py): a crashed controller's
+        replacement re-adopts running replicas instead of orphaning them."""
+        state = {
+            "deployments": {
+                name: {k: d[k] for k in (
+                    "def_blob", "init_args", "init_kwargs", "target",
+                    "actor_options", "autoscaling", "max_concurrency",
+                    "def_version")}
+                for name, d in self._deployments.items()},
+            "replicas": {name: [r.actor_id for r in rs]
+                         for name, rs in self._replicas.items()},
+        }
+        try:
+            from ray_tpu.core.api import _global_worker
+
+            _global_worker().gcs.call("kv_put", {
+                "namespace": "serve", "key": self._KV_KEY,
+                "value": cloudpickle.dumps(state)}, timeout=5)
+        except Exception:
+            logger.debug("serve controller checkpoint failed", exc_info=True)
+
+    def _restore_state(self) -> None:
+        """Fresh controller: re-adopt the previous incarnation's deployments
+        and still-alive replicas from the KV checkpoint. Replica definition
+        versions are NOT in the checkpoint — they are recovered from the
+        replicas themselves (_replica_version), so a redeploy right after a
+        controller crash still rolls pre-crash replicas."""
+        from ray_tpu.core.actor import ActorHandle
+        from ray_tpu.core.api import _global_worker
+
+        try:
+            blob = _global_worker().gcs.call(
+                "kv_get", {"namespace": "serve", "key": self._KV_KEY}, timeout=5)
+        except Exception:
+            return
+        if not blob:
+            return
+        try:
+            state = cloudpickle.loads(blob)
+        except Exception:
+            logger.exception("corrupt serve controller checkpoint; ignoring")
+            return
+        for name, d in state.get("deployments", {}).items():
+            self._deployments[name] = {
+                **d, "last_scale_up": 0.0, "last_scale_down": 0.0,
+                "_draining": []}
+        for name, aids in state.get("replicas", {}).items():
+            live = []
+            for aid in aids:
+                try:
+                    info = _global_worker().get_actor_info(actor_id=aid)
+                    if info and info.get("state") == "ALIVE":
+                        live.append(ActorHandle(aid, "_ReplicaActor"))
+                except Exception:
+                    pass
+            if live:
+                self._replicas[name] = live
+        for name in self._deployments:
+            self._bump_version(name)
+        if self._deployments:
+            logger.info("serve controller restored %d deployment(s), "
+                        "re-adopted %d replica(s) from checkpoint",
+                        len(self._deployments),
+                        sum(len(v) for v in self._replicas.values()))
+
     def _bump_version(self, name: str) -> None:
         with self._version_cv:
-            self._versions[name] = self._versions.get(name, 0) + 1
+            v = self._versions[name] = self._versions.get(name, 0) + 1
             self._version_cv.notify_all()
+        # version bumps mark every deployment/replica-set change: checkpoint
+        # here so the KV state trails live state by at most one change
+        if not getattr(self, "_restoring", False):
+            self._checkpoint()
+        # Push the bump to handles over GCS pubsub: handles fetch the new
+        # replica set with a NON-blocking get_replicas, so no controller
+        # exec thread is ever parked on a handle's long-poll (reference
+        # LongPollHost is async for the same reason, long_poll.py:186).
+        try:
+            from ray_tpu.core.api import _global_worker
+
+            _global_worker().publish(SERVE_VERSIONS_CHANNEL,
+                                     {"name": name, "version": v})
+        except Exception:
+            pass  # handles fall back to their periodic poll
 
     # -------------------------------------------------------------- deploy
     def deploy(self, name: str, def_blob: bytes, init_args, init_kwargs,
@@ -139,6 +256,13 @@ class ServeController:
         self._shutdown = True
         for name in list(self._deployments):
             self.delete_deployment(name)
+        try:
+            from ray_tpu.core.api import _global_worker
+
+            _global_worker().gcs.call("kv_del", {
+                "namespace": "serve", "key": self._KV_KEY}, timeout=5)
+        except Exception:
+            pass
         return True
 
     # ----------------------------------------------------------- discovery
@@ -155,6 +279,7 @@ class ServeController:
                 self._version_cv.wait(timeout=remaining)
         return {
             "version": self._versions.get(name, 0),
+            "incarnation": self._incarnation,
             "replicas": list(self._replicas.get(name, [])),
         }
 
@@ -229,13 +354,42 @@ class ServeController:
     def _new_replica(self, d: dict):
         opts = dict(d["actor_options"])
         opts["max_concurrency"] = max(d["max_concurrency"], 4)
+        ver = d.get("def_version", 0)
         replica = _ReplicaActor.options(**opts).remote(
-            d["def_blob"], d["init_args"], d["init_kwargs"])
-        self._replica_def_version[id(replica)] = d.get("def_version", 0)
+            d["def_blob"], d["init_args"], d["init_kwargs"], def_version=ver)
+        self._replica_def_version[_replica_key(replica)] = ver
         return replica
 
+    def _replica_version(self, r) -> Optional[int]:
+        """Definition version of a replica; None while unknown. Unknown
+        versions (controller restarted: the map is empty) are recovered
+        asynchronously from the replica itself so a redeploy after a
+        controller restart still rolls pre-restart replicas."""
+        key = _replica_key(r)
+        v = self._replica_def_version.get(key)
+        if v is not None:
+            return v
+        probe = self._version_queries.get(key)
+        if probe is None:
+            try:
+                probe = r.def_version.remote()
+            except Exception:
+                return None
+            self._version_queries[key] = probe
+        done, _ = ray_tpu.wait([probe], num_returns=1, timeout=0)
+        if not done:
+            return None
+        self._version_queries.pop(key, None)
+        try:
+            v = int(ray_tpu.get(probe, timeout=1))
+        except Exception:
+            return None  # health check handles dead replicas
+        self._replica_def_version[key] = v
+        return v
+
     def _kill_replica(self, name: str, r) -> None:
-        self._replica_def_version.pop(id(r), None)
+        self._replica_def_version.pop(_replica_key(r), None)
+        self._version_queries.pop(_replica_key(r), None)
         self._evict_stats_client(r)
         try:
             ray_tpu.kill(r)
@@ -285,7 +439,7 @@ class ServeController:
         d["_draining"] = still
 
         stale = [r for r in replicas
-                 if self._replica_def_version.get(id(r), ver) != ver]
+                 if self._replica_version(r) not in (None, ver)]
         roll = d.get("_rolling")
         if roll is None:
             if stale and len(replicas) >= d["target"]:
@@ -306,7 +460,7 @@ class ServeController:
             self._kill_replica(name, nr)  # failed rollout step; retried next pass
             return False
         victim = next((r for r in replicas
-                       if self._replica_def_version.get(id(r), ver) != ver), None)
+                       if self._replica_version(r) not in (None, ver)), None)
         if victim is None:
             # the stale replica disappeared meanwhile (health-check kill +
             # refill at the current version): the set is already current,
@@ -408,25 +562,28 @@ class DeploymentHandle:
         self._name = deployment_name
         self._method = method_name
         self._version = -1
+        self._incarnation = None  # controller incarnation the version is from
         self._replicas: List[Any] = []
         # keyed by replica actor id, NOT list index: a replica-set change
         # must not let stale completions decrement a new replica's count
         self._inflight: Dict[bytes, int] = {}
         self._lock = threading.Lock()
         self._refresher: Optional[threading.Thread] = None
+        self._bumped = threading.Event()  # set by the pubsub push
+        self._sub_cb = None
         self._closed = False
 
     def _controller(self):
         return ray_tpu.get_actor(CONTROLLER_NAME)
 
-    @staticmethod
-    def _rkey(replica) -> bytes:
-        aid = getattr(replica, "_actor_id", None) or getattr(
-            replica, "actor_id", None)
-        return aid.binary() if hasattr(aid, "binary") else bytes(str(aid), "utf8")
+    _rkey = staticmethod(_replica_key)
 
     def _apply(self, info: dict) -> None:
         with self._lock:
+            inc = info.get("incarnation")
+            if inc != getattr(self, "_incarnation", None):
+                self._incarnation = inc
+                self._version = -1  # new controller: any version is news
             if info["version"] != self._version:
                 self._version = info["version"]
                 self._replicas = info["replicas"]
@@ -436,48 +593,112 @@ class DeploymentHandle:
                                   if k in live}
 
     def _refresh(self, block: bool = True):
+        # Cold start only (a handle with no replica set yet): a bounded 2s
+        # server-side long-poll per round, NOT a busy poll — steady-state
+        # refresh is push-driven and non-blocking (_ensure_refresher).
         deadline = time.monotonic() + 30
         while True:
             info = ray_tpu.get(self._controller().get_replicas.remote(
-                self._name, self._version))
+                self._name, self._version, 0.0 if not block else 2.0))
             self._apply(info)
             with self._lock:
                 if self._replicas or not block or time.monotonic() > deadline:
                     return
-            time.sleep(0.1)
 
     def _ensure_refresher(self) -> None:
+        """Replica-set updates are PUSH-driven: the controller publishes
+        version bumps over GCS pubsub and this loop answers each with a
+        non-blocking get_replicas — no controller exec thread is parked per
+        handle (any number of handles costs the controller one fan-out
+        publish). A slow periodic poll backstops lost pushes. Both the loop
+        and the pubsub callback hold the handle WEAKLY, so a dropped handle
+        is collectable: its loop exits and its subscription self-removes."""
+        import weakref
+
         with self._lock:
             t = self._refresher
             if t is not None and t.is_alive():
                 return
 
-            def loop():
-                failures = 0
-                while not self._closed and failures < 5:
+            wself = weakref.ref(self)
+
+            def on_bump(msg):
+                s = wself()
+                if s is None:  # handle was GC'd: self-unsubscribe
                     try:
-                        info = ray_tpu.get(self._controller().get_replicas.remote(
-                            self._name, self._version), timeout=30)
-                        self._apply(info)
+                        from ray_tpu.core.api import _global_worker
+
+                        _global_worker().unsubscribe_channel(
+                            SERVE_VERSIONS_CHANNEL, on_bump)
+                    except Exception:
+                        pass
+                    return
+                if msg.get("name") == s._name:
+                    s._bumped.set()
+
+            def loop():
+                # Subscribe from the refresher thread, never the request
+                # path: a stalled GCS must not wedge remote() calls (and
+                # the handle lock is not held here).
+                s = wself()
+                if s is None:
+                    return
+                if s._sub_cb is None:
+                    try:
+                        from ray_tpu.core.api import _global_worker
+
+                        _global_worker().subscribe_channel(
+                            SERVE_VERSIONS_CHANNEL, on_bump)
+                        s._sub_cb = on_bump
+                    except Exception:
+                        pass  # poll-only fallback
+                # plain Event/str locals do not pin the handle
+                bumped, name = s._bumped, s._name
+                del s
+                failures = 0
+                while failures < 5:
+                    bumped.wait(timeout=5.0)
+                    bumped.clear()
+                    s = wself()
+                    if s is None or s._closed:
+                        return
+                    try:
+                        info = ray_tpu.get(s._controller().get_replicas.remote(
+                            name, s._version, 0.0), timeout=30)
+                        s._apply(info)
                         failures = 0
                     except Exception:
                         # Controller gone (serve.shutdown) or unreachable:
                         # exit after a few strikes rather than spinning
                         # forever; the next remote() restarts the loop.
                         failures += 1
+                    del s  # don't pin the handle across the wait
+                    if failures:
                         time.sleep(1.0)
-                with self._lock:
-                    if self._refresher is threading.current_thread():
-                        self._refresher = None
+                s = wself()
+                if s is not None:
+                    with s._lock:
+                        if s._refresher is threading.current_thread():
+                            s._refresher = None
 
             t = threading.Thread(target=loop,
-                                 name=f"serve-longpoll-{self._name}",
+                                 name=f"serve-refresh-{self._name}",
                                  daemon=True)
             self._refresher = t
             t.start()
 
     def close(self) -> None:
         self._closed = True
+        self._bumped.set()
+        if self._sub_cb is not None:
+            try:
+                from ray_tpu.core.api import _global_worker
+
+                _global_worker().unsubscribe_channel(
+                    SERVE_VERSIONS_CHANNEL, self._sub_cb)
+            except Exception:
+                pass
+            self._sub_cb = None
 
     def options(self, method_name: str = "__call__") -> "DeploymentHandle":
         h = DeploymentHandle(self._name, method_name)
@@ -575,9 +796,9 @@ def _get_or_create_controller():
         return ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:
         return ServeController.options(
-            # high concurrency: every handle parks a 2s get_replicas
-            # long-poll on an exec thread; deploy/metrics calls must never
-            # queue behind those CV waits
+            # handles are push-driven (pubsub bump -> non-blocking
+            # get_replicas), so concurrency only needs to cover bursts of
+            # deploy/status/refresh calls, not a parked poll per handle
             name=CONTROLLER_NAME, num_cpus=0, max_concurrency=64).remote()
 
 
@@ -596,6 +817,39 @@ def _collect_graph(root: Deployment, order: List[Deployment],
     visiting.discard(id(root))
     seen.add(id(root))
     order.append(root)
+
+
+_handle_cache: Dict[tuple, DeploymentHandle] = {}
+_handle_cache_lock = threading.Lock()
+
+
+def _cached_handle(name: str, method: str = "__call__") -> DeploymentHandle:
+    """One long-lived handle per (deployment, method) in this process:
+    repeated lookups reuse the replica set, in-flight accounting, and the
+    single pubsub refresher instead of growing a handle per call."""
+    from ray_tpu.core.api import _global_worker
+
+    try:
+        world = _global_worker().address
+    except Exception:
+        world = None
+    with _handle_cache_lock:
+        h = _handle_cache.get((name, method))
+        # a cached handle from a torn-down-and-rebooted cluster (its worker
+        # address differs) holds dead replicas — replace it
+        if h is None or h._closed or getattr(h, "_world", None) != world:
+            h = DeploymentHandle(name, method)
+            h._world = world
+            _handle_cache[(name, method)] = h
+        return h
+
+
+def _close_cached_handles() -> None:
+    with _handle_cache_lock:
+        handles = list(_handle_cache.values())
+        _handle_cache.clear()
+    for h in handles:
+        h.close()
 
 
 def _resolve_arg(a):
@@ -627,7 +881,7 @@ def run(target: Deployment, *, name: str = "default") -> DeploymentHandle:
             d.autoscaling_config,
             d.max_concurrent_queries,
         ))
-    handle = DeploymentHandle(target.name)
+    handle = _cached_handle(target.name)
     handle._refresh()
     return handle
 
@@ -713,10 +967,11 @@ def delete(name: str) -> bool:
 
 
 def get_deployment_handle(name: str) -> DeploymentHandle:
-    return DeploymentHandle(name)
+    return _cached_handle(name)
 
 
 def shutdown() -> None:
+    _close_cached_handles()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:

@@ -420,3 +420,49 @@ def test_rolling_redeploy_zero_downtime(ray_start_regular):
         assert seen_v2, "rollout never produced a v2 response"
     finally:
         serve.shutdown()
+
+
+def test_controller_crash_readopts_replicas_and_rolls(ray_start_regular):
+    """Controller fault tolerance: a replacement controller restores the
+    deployment table from its GCS-KV checkpoint and RE-ADOPTS still-running
+    replicas (reference serve checkpointing, _private/storage/kv_store.py);
+    because each replica carries its own def_version, a redeploy issued
+    after the crash still rolls the pre-crash replicas to the new code."""
+    import time as _time
+
+    from ray_tpu import serve
+
+    def make(version):
+        @serve.deployment(num_replicas=2, name="survivor")
+        def app(x):
+            return {"v": version, "x": x}
+
+        return app
+
+    try:
+        h = serve.run(make(1).bind(), name="crash")
+        assert ray_tpu.get(h.remote(0), timeout=60)["v"] == 1
+
+        controller = ray_tpu.get_actor(serve.api.CONTROLLER_NAME)
+        ray_tpu.kill(controller)
+        _time.sleep(1.0)
+
+        # a fresh controller must restore the deployment and keep serving
+        # through the SAME pre-crash replicas (they were never killed)
+        h2 = serve.run(make(2).bind(), name="crash")
+        deadline = _time.monotonic() + 90
+        settled = False
+        while _time.monotonic() < deadline:
+            out = ray_tpu.get(h2.remote(1), timeout=30)
+            assert out["v"] in (1, 2)
+            if out["v"] == 2:
+                votes = [ray_tpu.get(h2.remote(i), timeout=30)["v"]
+                         for i in range(6)]
+                if all(v == 2 for v in votes):
+                    settled = True
+                    break
+            _time.sleep(0.2)
+        assert settled, ("pre-crash replicas were never rolled to v2 "
+                         "after the controller restart")
+    finally:
+        serve.shutdown()
