@@ -39,7 +39,7 @@ from ray_tpu.core.api import (
     get_runtime_context,
     timeline,
 )
-from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.core.actor import ActorClass, ActorHandle
 from ray_tpu.core.exceptions import (
     RayTpuError,
@@ -71,6 +71,7 @@ __all__ = [
     "get_runtime_context",
     "timeline",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorClass",
     "ActorHandle",
     "RayTpuError",

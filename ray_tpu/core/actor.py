@@ -45,11 +45,16 @@ class ActorHandle:
     def actor_id(self) -> ActorID:
         return self._actor_id
 
-    def _invoke(self, method_name: str, args, kwargs, num_returns: int):
+    def _invoke(self, method_name: str, args, kwargs, num_returns):
         from ray_tpu.core.api import _global_worker
 
-        refs = _global_worker().submit_actor_task(
+        if num_returns in ("dynamic", "streaming"):
+            num_returns = -1  # generator method (reference num_returns="dynamic")
+        w = _global_worker()
+        refs = w.submit_actor_task(
             self._actor_id, method_name, args, kwargs, num_returns=num_returns)
+        if num_returns == -1:
+            return w.make_dynamic_generator(refs[0])
         return refs[0] if num_returns == 1 else refs
 
     def __getattr__(self, name: str):

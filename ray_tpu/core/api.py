@@ -184,6 +184,8 @@ class RemoteFunction:
                 scheduling.placement_group_id = pg.id
                 scheduling.bundle_index = o.get("placement_group_bundle_index", -1)
         num_returns = o.get("num_returns", 1)
+        if num_returns in ("dynamic", "streaming"):
+            num_returns = -1  # generator task (reference num_returns="dynamic")
         refs = w.submit_task(
             self._fn, args, kwargs,
             num_returns=num_returns,
@@ -193,6 +195,8 @@ class RemoteFunction:
             retry_exceptions=o.get("retry_exceptions", False),
             runtime_env=o.get("runtime_env"),
         )
+        if num_returns == -1:
+            return w.make_dynamic_generator(refs[0])
         return refs[0] if num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):
@@ -235,6 +239,12 @@ def put(value: Any) -> ObjectRef:
 
 
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):
+    from ray_tpu.core.object_ref import ObjectRefGenerator
+
+    if isinstance(refs, ObjectRefGenerator):
+        raise TypeError(
+            "got an ObjectRefGenerator (num_returns='dynamic' task); iterate "
+            "it for item refs — e.g. [ray_tpu.get(r) for r in gen]")
     w = _global_worker()
     if isinstance(refs, ObjectRef):
         return w.get([refs], timeout=timeout)[0]

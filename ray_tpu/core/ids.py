@@ -94,6 +94,7 @@ class ObjectID(BaseID):
 
     __slots__ = ()
     PUT_INDEX_BASE = 1 << 24
+    DYNAMIC_INDEX_BASE = 1 << 16  # dynamic (generator) returns, < PUT base
 
     @classmethod
     def for_task_return(cls, task_id: TaskID, index: int) -> "ObjectID":
@@ -102,6 +103,15 @@ class ObjectID(BaseID):
     @classmethod
     def for_put(cls, task_id: TaskID, put_index: int) -> "ObjectID":
         return cls.for_task_return(task_id, cls.PUT_INDEX_BASE + put_index)
+
+    @classmethod
+    def for_dynamic_return(cls, task_id: TaskID, item_index: int) -> "ObjectID":
+        """Id of the item_index-th object streamed out of a generator task
+        (num_returns='dynamic'). Deterministic in (task, index) so a
+        re-executed generator regenerates the SAME ids — lineage
+        reconstruction of dynamically-created objects falls out for free
+        (cf. reference ObjectID::FromIndex use in _raylet.pyx:997)."""
+        return cls.for_task_return(task_id, cls.DYNAMIC_INDEX_BASE + item_index)
 
     def task_id(self) -> TaskID:
         return TaskID(self._bytes[:-4])

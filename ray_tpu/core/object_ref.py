@@ -69,6 +69,70 @@ class ObjectRef:
         return _global_worker().get_async(self)
 
 
+class ObjectRefGenerator:
+    """Iterator over the ObjectRefs streamed out of a num_returns="dynamic"
+    task (reference ObjectRefGenerator, _raylet.pyx:178,997).
+
+    On the task's OWNER it streams: each __next__ blocks until the executor
+    reports the next yielded object (or the task finishes/fails), so items
+    are consumable while the task still runs. Serialized (e.g. nested in a
+    return value or fetched by a borrower) it carries the final ref list —
+    borrowers iterate the completed sequence."""
+
+    def __init__(self, refs=None, task_id=None, done: bool = True):
+        self._refs = list(refs or [])
+        self._task_id = task_id
+        self._done = done
+        self._i = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        if self._done:
+            if self._i >= len(self._refs):
+                raise StopIteration
+            r = self._refs[self._i]
+            self._i += 1
+            return r
+        from ray_tpu.core import worker as _worker_mod
+
+        w = _worker_mod.current_worker()
+        ref, done, err = w.next_dynamic_return(self._task_id, self._i)
+        if ref is not None:
+            self._refs.append(ref)
+            self._i += 1
+            return ref
+        self._done = True
+        if err is not None:
+            raise err
+        raise StopIteration
+
+    def __len__(self):
+        if not self._done:
+            raise TypeError("streaming generator has no length until consumed")
+        return len(self._refs)
+
+    def completed_refs(self):
+        """Refs yielded so far (all of them once done)."""
+        return list(self._refs)
+
+    def __reduce__(self):
+        if not self._done:
+            raise TypeError(
+                "a streaming ObjectRefGenerator can only be serialized "
+                "after the task completes; iterate it (or pass individual "
+                "item refs) instead")
+        # pickling the refs records the contained-ref borrows (ObjectRef
+        # __reduce__), so a generator nested in a stored object keeps its
+        # items alive for the container's lifetime
+        return (_rebuild_generator, (list(self._refs),))
+
+
+def _rebuild_generator(refs):
+    return ObjectRefGenerator(refs, done=True)
+
+
 def _rebuild_ref(object_id, owner_address, call_site):
     ref = ObjectRef(object_id, owner_address, call_site)
     # Register the materialized instance with the ownership layer: borrowed

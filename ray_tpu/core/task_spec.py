@@ -49,6 +49,9 @@ class TaskSpec:
     args: List[Tuple] = field(default_factory=list)
     kwargs_blob: Optional[bytes] = None
 
+    # -1 = dynamic (generator task, num_returns="dynamic"): one declared
+    # return (the generator object); item objects are created as the
+    # executor yields them (cf. reference _raylet.pyx:178 dynamic returns)
     num_returns: int = 1
     resources: Dict[str, float] = field(default_factory=dict)
     scheduling: SchedulingStrategy = field(default_factory=SchedulingStrategy)
@@ -69,7 +72,8 @@ class TaskSpec:
     runtime_env: Optional[dict] = None
 
     def return_object_ids(self) -> List[ObjectID]:
-        return [ObjectID.for_task_return(self.task_id, i + 1) for i in range(self.num_returns)]
+        n = 1 if self.num_returns == -1 else self.num_returns
+        return [ObjectID.for_task_return(self.task_id, i + 1) for i in range(n)]
 
 
 @dataclass

@@ -44,7 +44,7 @@ from ray_tpu.core.exceptions import (
 )
 from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID, WorkerID, _TaskIDCounter
 from ray_tpu.core.object_store import attach_object
-from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.core.serialization import SerializedObject
 from ray_tpu.core.task_spec import (
     ActorCreationSpec,
@@ -221,6 +221,12 @@ class CoreWorker:
         # application pubsub subscriptions (channel -> callbacks)
         self._channel_callbacks: Dict[str, List[Callable]] = {}
         self._channel_cb_lock = threading.Lock()
+        # streaming (num_returns="dynamic") tasks we own: task id ->
+        # {"refs": [ObjectRef...], "done": bool, "error": Exception|None}
+        # (guarded by _obj_lock; _obj_cv signals arrivals)
+        self._dynamic_returns: Dict[TaskID, dict] = {}
+        # dynamic return ids with lineage entries, for whole-task eviction
+        self._task_dynamic_ids: Dict[TaskID, List[ObjectID]] = {}
 
         # borrows keyed by the borrower's server connection (see
         # rpc_add_borrower): conn id -> {object_id: count}
@@ -450,11 +456,16 @@ class CoreWorker:
                 refs.append(r)
                 if spec.task_type == TaskType.NORMAL:
                     self._lineage[oid] = spec
+            if spec.num_returns == -1:
+                self._dynamic_returns[spec.task_id] = {
+                    "refs": [], "done": False, "error": None}
             while len(self._lineage) > cfg.lineage_table_max_entries:
                 # Evict a whole task's returns together and drop its retry
                 # counter so _lineage_attempts can't grow unboundedly.
                 old = self._lineage.pop(next(iter(self._lineage)))
                 for roid in old.return_object_ids():
+                    self._lineage.pop(roid, None)
+                for roid in self._task_dynamic_ids.pop(old.task_id, ()):
                     self._lineage.pop(roid, None)
                 self._lineage_attempts.pop(old.task_id, None)
         return refs
@@ -842,9 +853,11 @@ class CoreWorker:
                     self._lineage_attempts[spec.task_id] = attempts + 1
                     self._pending_tasks[spec.task_id] = [spec, 0]
                     submit = True
-            # All returns of the task are recomputed together; reset their
+            # All returns of the task are recomputed together (incl. any
+            # dynamic generator items — same deterministic ids); reset their
             # states so concurrent getters block until the re-run reports.
-            for roid in spec.return_object_ids():
+            for roid in (spec.return_object_ids()
+                         + list(self._task_dynamic_ids.get(spec.task_id, ()))):
                 st = self._objects.get(roid)
                 if st is None:
                     st = _ObjectState()
@@ -1071,9 +1084,121 @@ class CoreWorker:
                 st = self._objects.get(oid)
                 if st is not None:
                     self._maybe_free(oid, st)
+        self._finish_dynamic(task_id, payload["results"])
         if pend is not None:
             self._unpin_after_task(pend[0])
         return True
+
+    # -------------------------------------------------- dynamic returns
+    def rpc_report_dynamic_return(self, conn, req_id, payload):
+        """Executor push: the NEXT object streamed out of a generator task
+        we own (num_returns="dynamic", reference _raylet.pyx:997). The
+        object registers like a static return, gains a lineage entry (ids
+        are deterministic in (task, index), so re-executing the generator
+        recovers any lost item), and its ref is appended for the streaming
+        ObjectRefGenerator."""
+        task_id: TaskID = payload["task_id"]
+        entry = payload["entry"]
+        kind, oid = entry[0], entry[1]
+        contained = ()
+        with self._obj_lock:
+            st = self._objects.get(oid)
+            if st is None:
+                st = _ObjectState()
+                self._objects[oid] = st
+            if kind == "inline":
+                st.state = "inline"
+                st.inline_blob = entry[2]
+                st.size = len(entry[2])
+                contained = entry[3] if len(entry) > 3 else ()
+            else:
+                st.state = "plasma"
+                st.location = entry[2]
+                st.extra_locations = []
+                st.size = entry[3]
+                contained = entry[4] if len(entry) > 4 else ()
+            with self._pending_lock:
+                pend = self._pending_tasks.get(task_id)
+                spec = pend[0] if pend else None
+            if spec is not None and spec.task_type == TaskType.NORMAL:
+                self._lineage[oid] = spec
+                dyn = self._task_dynamic_ids.setdefault(task_id, [])
+                if oid not in dyn:
+                    dyn.append(oid)
+            rec = self._dynamic_returns.get(task_id)
+            if (rec is not None and not rec["done"]
+                    and oid not in rec.setdefault("seen", set())):
+                rec["seen"].add(oid)
+                # the record's ref holds one refcount unit until the app's
+                # ObjectRefGenerator (or the record itself) drops it
+                st.local_refs += 1
+                ref = ObjectRef(oid, owner_address=self.address)
+                ref._counted = True
+                rec["refs"].append(ref)
+            self._obj_cv.notify_all()
+        if contained:
+            self._adopt_contained_refs(oid, contained)
+        self._notify_info_waiters(oid)
+        return True
+
+    def next_dynamic_return(self, task_id: TaskID, i: int):
+        """Streaming accessor for ObjectRefGenerator on the owner: block
+        until the i-th dynamic return is reported. Returns (ref, done,
+        error); ref None means the stream ended."""
+        with self._obj_lock:
+            while True:
+                rec = self._dynamic_returns.get(task_id)
+                if rec is None:
+                    return None, True, None
+                if i < len(rec["refs"]):
+                    return rec["refs"][i], False, None
+                if rec["done"]:
+                    return None, True, rec["error"]
+                if self._shutdown.is_set():
+                    return None, True, None
+                self._obj_cv.wait(timeout=1.0)
+
+    def make_dynamic_generator(self, gen_ref: ObjectRef) -> ObjectRefGenerator:
+        """Owner-side streaming generator for a just-submitted dynamic task
+        (holds gen_ref so the record and items outlive the submit call)."""
+        g = ObjectRefGenerator([], task_id=gen_ref.id.task_id(), done=False)
+        g._gen_ref = gen_ref
+        return g
+
+    def _finish_dynamic(self, task_id: TaskID, results) -> None:
+        """Terminal report arrived for a (possibly) dynamic task: wake the
+        streaming iterator, carrying the task error if it failed."""
+        with self._obj_lock:
+            rec = self._dynamic_returns.get(task_id)
+            if rec is None or rec["done"]:
+                return
+            err = None
+            for e in results:
+                if e[0] == "error":
+                    try:
+                        err = serialization.loads(e[2])
+                    except Exception:
+                        err = TaskError("generator task failed")
+            rec["done"] = True
+            rec["error"] = err
+            self._obj_cv.notify_all()
+
+    def _report_dynamic(self, spec: TaskSpec, entry) -> None:
+        """Deliver one streamed item to the owner. Raises on failure (after
+        one reconnect retry): a silently-dropped item would leave a hole the
+        completed generator still references — failing the whole task (the
+        caller of this helper runs inside the executor's try) is the honest
+        outcome, and retries/lineage can then re-run the generator."""
+        payload = {"task_id": spec.task_id, "entry": entry}
+        if spec.owner_address == self.address:
+            self.rpc_report_dynamic_return(None, 0, payload)
+            return
+        try:
+            self.peer(spec.owner_address).notify("report_dynamic_return", payload)
+        except Exception:
+            with self._peers_lock:  # stale conn: retry on a fresh one
+                self._peers.pop(spec.owner_address, None)
+            self.peer(spec.owner_address).notify("report_dynamic_return", payload)
 
     _PROBE_METHODS = frozenset({"health", "__ray_ready__", "__ray_terminate__"})
 
@@ -1127,6 +1252,7 @@ class CoreWorker:
                     st.inline_blob = err_blob
                     self._obj_cv.notify_all()
             self._notify_info_waiters(oid)
+        self._finish_dynamic(task_id, [("error", None, err_blob)])
         self._unpin_after_task(spec)
         return True
 
@@ -1150,6 +1276,7 @@ class CoreWorker:
                     st.inline_blob = err_blob
                     self._obj_cv.notify_all()
             self._notify_info_waiters(oid)
+        self._finish_dynamic(task_id, [("error", None, err_blob)])
         self._unpin_after_task(spec)
         return True
 
@@ -1261,7 +1388,15 @@ class CoreWorker:
             return
         self._objects.pop(oid, None)
         self._release_contained_pins(st)
+        self._drop_dynamic_record(oid)
         self._delete_plasma(oid, st)
+
+    def _drop_dynamic_record(self, oid: ObjectID) -> None:
+        """Caller holds _obj_lock. The first return object of a task was
+        freed; if it was a generator's main object, drop the streaming
+        record (its counted item refs release on GC)."""
+        if oid.return_index() == 1:
+            self._dynamic_returns.pop(oid.task_id(), None)
 
     def _release_contained_pins(self, st: _ObjectState) -> None:
         """Caller holds _obj_lock. The container object is gone: drop the
@@ -1389,6 +1524,7 @@ class CoreWorker:
                         continue
                     self._objects.pop(oid, None)
                     self._release_contained_pins(st)
+                    self._drop_dynamic_record(oid)
                     due.append((oid, st))
                 self._deferred_frees = remaining
                 if not self._deferred_frees and not due:
@@ -1516,6 +1652,7 @@ class CoreWorker:
                     st.inline_blob = blob
                     self._obj_cv.notify_all()
             self._notify_info_waiters(oid)
+        self._finish_dynamic(spec.task_id, [("error", None, blob)])
         self._unpin_after_task(spec)
 
     def _log_print_queue(self) -> "queue.Queue":
@@ -1824,7 +1961,14 @@ class CoreWorker:
                                   "task_execution",
                                   task_id=spec.task_id.binary().hex()):
                     value = loop.run_until_complete(value)
-            if spec.num_returns == 1:
+            if spec.num_returns == -1:
+                # Generator task: stream each yielded object to the owner AS
+                # PRODUCED (reference streaming generators, _raylet.pyx:178);
+                # the main return materializes afterwards as a completed
+                # ObjectRefGenerator so borrowers get the full sequence.
+                value = self._stream_dynamic_returns(spec, value)
+                values = [value]
+            elif spec.num_returns == 1:
                 values = [value]
             else:
                 values = list(value)
@@ -1832,24 +1976,14 @@ class CoreWorker:
                     raise ValueError(
                         f"task declared num_returns={spec.num_returns} but returned "
                         f"{len(values)} values")
-            cfg = get_config()
+            # Own refs nested in a return value (e.g. an actor handing out
+            # refs to objects it created) escape to the caller. Their
+            # descriptors ship WITH the result so the caller — who owns the
+            # enclosing return object — can keep them alive for the
+            # container's lifetime (pin if caller-owned, borrow otherwise),
+            # mirroring put()'s container pins.
             for oid, v in zip(spec.return_object_ids(), values):
-                s = serialization.serialize(v)
-                # Own refs nested in a return value (e.g. an actor handing out
-                # refs to objects it created) escape to the caller. Their
-                # descriptors ship WITH the result so the caller — who owns
-                # the enclosing return object — can keep them alive for the
-                # container's lifetime (pin if caller-owned, borrow
-                # otherwise), mirroring put()'s container pins.
-                self._mark_shipped(s.contained_refs)
-                contained = list({(r.id, r.owner_address or self.address)
-                                  for r in (s.contained_refs or ())})
-                if s.total_bytes <= cfg.max_direct_call_object_size:
-                    results.append(("inline", oid, s.to_bytes(), contained))
-                else:
-                    self._put_to_store(oid, s)
-                    results.append(("plasma", oid, self.raylet_address,
-                                    s.total_bytes, contained))
+                results.append(self._build_result_entry(oid, v))
         except Exception as e:
             from ray_tpu.core.exceptions import ActorError
             cls = ActorError if spec.task_type == TaskType.ACTOR_TASK else TaskError
@@ -1884,6 +2018,39 @@ class CoreWorker:
                 self.raylet.notify("task_done", {"worker_id": self.worker_id})
             except Exception:
                 pass
+
+    def _stream_dynamic_returns(self, spec: TaskSpec, value) -> ObjectRefGenerator:
+        """Executor side of num_returns="dynamic": iterate the task's
+        generator, storing + reporting one object per yielded item (ids
+        deterministic in the item index, ids.py for_dynamic_return). Returns
+        the completed ObjectRefGenerator used as the task's main return."""
+        if not (inspect.isgenerator(value) or hasattr(value, "__next__")):
+            # iterATORs only, not iterABLEs: accepting any __iter__ would
+            # silently stream a mistakenly-returned str per character or a
+            # dict per key (the exact bug this error exists to catch)
+            raise TypeError(
+                "a num_returns='dynamic' task must return a generator or "
+                f"iterator, got {type(value).__name__}")
+        item_refs: List[ObjectRef] = []
+        for i, item in enumerate(value):
+            oid_i = ObjectID.for_dynamic_return(spec.task_id, i)
+            self._report_dynamic(spec, self._build_result_entry(oid_i, item))
+            item_refs.append(ObjectRef(oid_i, owner_address=spec.owner_address))
+        return ObjectRefGenerator(item_refs, done=True)
+
+    def _build_result_entry(self, oid: ObjectID, value) -> tuple:
+        """Serialize one return object into a result entry (shared by the
+        static return loop and dynamic item streaming): inline below the
+        direct-call threshold, plasma above, contained-ref descriptors
+        always attached for owner-side container protection."""
+        s = serialization.serialize(value)
+        self._mark_shipped(s.contained_refs)
+        contained = list({(r.id, r.owner_address or self.address)
+                          for r in (s.contained_refs or ())})
+        if s.total_bytes <= get_config().max_direct_call_object_size:
+            return ("inline", oid, s.to_bytes(), contained)
+        self._put_to_store(oid, s)
+        return ("plasma", oid, self.raylet_address, s.total_bytes, contained)
 
     def _deserialize_args(self, args: List[Tuple], kwargs_blob: Optional[bytes]):
         out = []
