@@ -28,6 +28,7 @@ from ray_tpu.core.api import (
     remote,
     get,
     put,
+    push,
     wait,
     kill,
     cancel,
@@ -72,6 +73,7 @@ __all__ = [
     "timeline",
     "ObjectRef",
     "ObjectRefGenerator",
+    "push",
     "ActorClass",
     "ActorHandle",
     "RayTpuError",

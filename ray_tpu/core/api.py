@@ -238,6 +238,14 @@ def put(value: Any) -> ObjectRef:
     return _global_worker().put(value)
 
 
+def push(ref: ObjectRef, node_ids=None) -> int:
+    """Proactively broadcast an owned plasma object to other nodes' object
+    stores (reference PushManager semantics, push_manager.h:29): downstream
+    consumers then read a local copy instead of serializing on one source.
+    Returns the number of nodes the push was dispatched to."""
+    return _global_worker().push_object(ref, node_ids)
+
+
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):
     from ray_tpu.core.object_ref import ObjectRefGenerator
 

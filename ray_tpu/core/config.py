@@ -90,6 +90,9 @@ class Config:
     object_transfer_chunk_size_bytes: int = 16 * 1024 * 1024
     object_transfer_inflight_chunks: int = 4
     object_transfer_chunk_timeout_s: float = 60.0
+    # striped raw-socket pulls over the dedicated data plane (data_plane.py);
+    # chunks interleave across this many persistent connections
+    object_transfer_parallel_streams: int = 4
     # total bytes of concurrently-admitted chunked pulls per raylet; pulls
     # beyond it queue rather than overcommitting store memory
     pull_admission_max_bytes: int = 2 * 1024 * 1024 * 1024

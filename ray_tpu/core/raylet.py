@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import logging
 import os
+import socket as _socket_mod
 import subprocess
 import sys
 import threading
@@ -114,6 +115,13 @@ class Raylet:
         self._server = rpc.RpcServer(host)
         self._server.register_all(self)
         self.store = SharedObjectStore(capacity=object_store_memory)
+        # bulk transfer side channel: raw sockets, shm->kernel->shm copies
+        # only (see data_plane.py; reference object_manager.h:117 keeps bulk
+        # chunk streams off the control plane the same way)
+        from ray_tpu.core.data_plane import DataPlanePool, DataPlaneServer
+
+        self._data_plane = DataPlaneServer(self.store, host=host)
+        self._data_pool = DataPlanePool()
 
         self._lock = threading.RLock()
         self._policy = SchedulingPolicy()
@@ -234,6 +242,8 @@ class Raylet:
             self._gcs.close()
         for c in self._raylet_clients.values():
             c.close()
+        self._data_pool.close()
+        self._data_plane.stop()
         self._server.stop()
         self.store.shutdown()
 
@@ -986,11 +996,16 @@ class Raylet:
         return data  # None if not here
 
     def rpc_fetch_object_meta(self, conn, req_id, payload):
-        """Size probe before a chunked pull (cf. reference object directory)."""
+        """Size probe before a chunked pull (cf. reference object directory);
+        carries the data-plane address so the puller can ride raw sockets."""
         loc = self.store.lookup(payload["object_id"])
         if loc is None:
             return None
-        return {"size": loc[1]}
+        return {"size": loc[1], "data_addr": self._data_plane.address,
+                "segment": loc[0], "hostname": _socket_mod.gethostname()}
+
+    def rpc_data_plane_addr(self, conn, req_id, payload):
+        return self._data_plane.address
 
     def rpc_fetch_object_chunk(self, conn, req_id, payload):
         """Serve one bounded slice of a sealed object, read straight out of
@@ -1038,7 +1053,12 @@ class Raylet:
                                  timeout=30)
                 if meta is None:
                     err = f"object {object_id} not found at {source}"
+                elif self._try_adopt_local(object_id, meta):
+                    pass  # same-host kernel-side copy succeeded
                 elif meta["size"] <= chunk:
+                    # small objects NEVER wait on the pull budget: a 2 MiB
+                    # fetch queuing FIFO behind a multi-GiB admission ticket
+                    # would turn milliseconds into tens of seconds
                     data = peer.call("fetch_object", {"object_id": object_id},
                                      timeout=cfg.object_transfer_chunk_timeout_s)
                     if data is not None:
@@ -1049,23 +1069,51 @@ class Raylet:
                     else:
                         err = f"object {object_id} not found at {source}"
                 else:
-                    err = self._pull_chunked(peer, object_id, meta["size"])
+                    err = self._pull_chunked(peer, object_id, meta["size"],
+                                             meta.get("data_addr"))
             else:
                 err = f"no source for object {object_id}"
         except Exception as e:
             err = f"pull failed: {e}"
         self._resolve_pulls(object_id, err)
 
+    def _try_adopt_local(self, object_id: ObjectID, meta: dict) -> bool:
+        """Same-host fast path: the source raylet shares this machine's
+        /dev/shm, so 'transfer' is a kernel-side copy_file_range of the
+        segment file (no sockets, no fault-zeroing). False → fall through
+        to the data-plane/RPC pull paths."""
+        seg = meta.get("segment")
+        if (not seg or seg.startswith("@")
+                or meta.get("hostname") != _socket_mod.gethostname()):
+            return False  # cheap rejections BEFORE touching the pull budget
+        size = meta["size"]
+        # small copies are instant — admission control only gates sizes that
+        # could meaningfully overcommit store memory
+        gate = size > get_config().object_transfer_chunk_size_bytes
+        if gate:
+            self._pull_budget.acquire(size)
+        try:
+            return self.store.adopt_local_copy(object_id, seg, size)
+        except FileExistsError:
+            return False  # concurrent materialization: chunked path waits on it
+        except Exception:
+            logger.warning("same-host adopt of %s failed; falling back",
+                           object_id, exc_info=True)
+            return False
+        finally:
+            if gate:
+                self._pull_budget.release(size)
+
     def _pull_chunked(self, peer: rpc.RpcClient, object_id: ObjectID,
-                      size: int) -> Optional[str]:
-        """Stream a big object in pipelined chunks directly into a
-        pre-created shm segment, sealing after the last chunk (reference
-        ObjectManager 64 MiB chunk pulls) — peak extra memory is
-        inflight_chunks * chunk_size, not 2x the object.
+                      size: int, data_addr: Optional[str] = None) -> Optional[str]:
+        """Materialize a big object directly into a pre-created shm segment,
+        sealing when complete (reference ObjectManager chunk pulls) — peak
+        extra memory is bounded, never 2x the object. Preferred path: striped
+        raw-socket fetch over the peer's data plane (shm->kernel->shm, no
+        serialization); fallback: pipelined RPC chunks.
 
         Returns an error string, or None on success."""
         cfg = get_config()
-        chunk = cfg.object_transfer_chunk_size_bytes
         self._pull_budget.acquire(size)
         try:
             try:
@@ -1081,33 +1129,155 @@ class Raylet:
                     time.sleep(0.05)
                 return f"local copy of {object_id} never sealed"
             ok = False
+            err = None
             try:
-                inflight: deque = deque()
-                offset = 0
-                while offset < size or inflight:
-                    while (offset < size
-                           and len(inflight) < cfg.object_transfer_inflight_chunks):
-                        ln = min(chunk, size - offset)
-                        inflight.append((offset, ln, peer.call_future(
-                            "fetch_object_chunk",
-                            {"object_id": object_id, "offset": offset,
-                             "length": ln})))
-                        offset += ln
-                    off, ln, fut = inflight.popleft()
-                    data = fut.result(timeout=cfg.object_transfer_chunk_timeout_s)
-                    if data is None or len(data) != ln:
-                        return (f"chunk at {off} of {object_id} unavailable "
-                                f"at {peer.address}")
-                    shm.buf[off:off + ln] = data
-                ok = True
+                if data_addr:
+                    err = self._pull_data_plane(data_addr, object_id, size, shm)
+                    ok = err is None
+                    if not ok:
+                        logger.warning(
+                            "data-plane pull of %s from %s failed (%s); "
+                            "falling back to RPC chunks", object_id,
+                            data_addr, err)
+                if not ok:
+                    err = self._pull_rpc_chunks(peer, object_id, size, shm)
+                    ok = err is None
             finally:
                 shm.close()
                 if not ok:
                     self.store.delete(object_id)  # discard partial segment
+            if not ok:
+                return err
             self.store.seal(object_id)
             return None
         finally:
             self._pull_budget.release(size)
+
+    def _pull_data_plane(self, data_addr: str, object_id: ObjectID,
+                         size: int, shm) -> Optional[str]:
+        """Parallel-range pull: the object splits into N CONTIGUOUS ranges,
+        one persistent raw socket streaming each straight into its slice of
+        the destination segment — a single request/response round trip per
+        stream, so the sender never idles between chunks (per-chunk RPCs
+        would stall a full RTT every 16 MiB). The GIL releases during the
+        kernel copies, so streams genuinely overlap; stream count adapts to
+        the host's cores (extra streams on one core just thrash the GIL)."""
+        cfg = get_config()
+        n_streams = max(1, min(cfg.object_transfer_parallel_streams,
+                               os.cpu_count() or 1,
+                               size // (8 << 20) or 1))
+        dest = memoryview(shm.buf)
+        # 1 MiB-aligned contiguous ranges
+        step = -(-size // n_streams)
+        step = (step + ((1 << 20) - 1)) & ~((1 << 20) - 1)
+        ranges = [(off, min(step, size - off))
+                  for off in range(0, size, step)]
+
+        def stripe(off: int, ln: int) -> None:
+            client = None
+            broken = False
+            try:
+                client = self._data_pool.acquire(data_addr)
+                if not client.fetch_into(object_id, off, ln,
+                                         dest[off:off + ln]):
+                    raise ConnectionError(f"object gone at {data_addr}")
+            except Exception:
+                broken = True
+                raise
+            finally:
+                if client is not None:
+                    self._data_pool.release(client, broken=broken)
+
+        from ray_tpu.core.data_plane import fan_out
+
+        errors = fan_out([lambda r=r: stripe(*r) for r in ranges],
+                         timeout=cfg.object_transfer_chunk_timeout_s * 2)
+        return errors[0] if errors else None
+
+    def _pull_rpc_chunks(self, peer: rpc.RpcClient, object_id: ObjectID,
+                         size: int, shm) -> Optional[str]:
+        """Fallback: pipelined chunk fetch over the control RPC channel."""
+        cfg = get_config()
+        chunk = cfg.object_transfer_chunk_size_bytes
+        inflight: deque = deque()
+        offset = 0
+        while offset < size or inflight:
+            while (offset < size
+                   and len(inflight) < cfg.object_transfer_inflight_chunks):
+                ln = min(chunk, size - offset)
+                inflight.append((offset, ln, peer.call_future(
+                    "fetch_object_chunk",
+                    {"object_id": object_id, "offset": offset,
+                     "length": ln})))
+                offset += ln
+            off, ln, fut = inflight.popleft()
+            data = fut.result(timeout=cfg.object_transfer_chunk_timeout_s)
+            if data is None or len(data) != ln:
+                return (f"chunk at {off} of {object_id} unavailable "
+                        f"at {peer.address}")
+            shm.buf[off:off + ln] = data
+        return None
+
+    def rpc_push_object(self, conn, req_id, payload):
+        """Owner-directed push (reference push_manager.h:29): stream a
+        locally-held object into target raylets' stores so N readers don't
+        all serialize on one source copy. Each completed delivery registers
+        the new location with the owner, making it immediately pullable."""
+        threading.Thread(
+            target=self._push_to_targets,
+            args=(payload["object_id"], list(payload.get("targets", ())),
+                  payload.get("owner_address", "")),
+            name="obj-push", daemon=True).start()
+        return True
+
+    def _push_to_targets(self, object_id: ObjectID, targets: List[str],
+                         owner: str) -> None:
+        buf = self.store.get_buffer(object_id)
+        if buf is None:
+            logger.warning("push of %s requested but object not local",
+                           object_id)
+            return
+        try:
+            src = memoryview(buf.view)
+
+            def push_one(target: str) -> None:
+                client = None
+                broken = False
+                try:
+                    data_addr = self._peer(target).call(
+                        "data_plane_addr", {}, timeout=10)
+                    client = self._data_pool.acquire(data_addr)
+                    try:
+                        outcome = client.push_from(object_id, src)
+                    except Exception:
+                        broken = True
+                        raise
+                    finally:
+                        self._data_pool.release(client, broken=broken)
+                    # register ONLY delivered copies: a SKIP may mean a
+                    # concurrent unsealed create that later fails — the
+                    # target's own pull registers itself when it seals
+                    if owner and outcome == "ok":
+                        # one-shot notify; owner-side registration is
+                        # idempotent and best-effort (pull still works
+                        # through the primary copy if this is lost)
+                        c = rpc.connect_with_retry(owner, timeout=5)
+                        try:
+                            c.notify("add_object_location",
+                                     {"object_id": object_id,
+                                      "raylet": target})
+                        finally:
+                            c.close()
+                except Exception as e:
+                    logger.warning("push of %s to %s failed: %s",
+                                   object_id, target, e)
+
+            from ray_tpu.core.data_plane import fan_out
+
+            fan_out([lambda t=t: push_one(t) for t in targets],
+                    timeout=get_config().object_transfer_chunk_timeout_s * 4)
+        finally:
+            buf.close()
 
     def _resolve_pulls(self, object_id: ObjectID, err: Optional[str] = None) -> None:
         with self._lock:

@@ -1463,6 +1463,48 @@ class CoreWorker:
             except Exception:
                 pass
 
+    # ------------------------------------------------------------- push
+    def push_object(self, ref: ObjectRef, node_ids=None) -> int:
+        """Owner-directed broadcast (reference push_manager.h:29): stream an
+        owned, sealed plasma object into other nodes' stores AHEAD of
+        demand, so N downstream readers hit a local copy instead of all
+        pulling from one source. node_ids: restrict targets (hex or bytes
+        node ids); None = every other alive node. Returns the number of
+        push targets. Fire-and-forget: delivery registers new locations
+        with this owner as copies land."""
+        if ref.owner_address not in ("", self.address):
+            raise ValueError("push() requires a ref owned by this process")
+        with self._obj_lock:
+            st = self._objects.get(ref.id)
+            if st is None or st.state != "plasma" or not st.location:
+                raise ValueError(
+                    "push() needs a sealed plasma object (small objects are "
+                    "inlined and need no push)")
+            location = st.location
+            have = {location, *st.extra_locations}
+        if node_ids is not None:
+            wanted = {n.hex() if isinstance(n, (bytes, bytearray)) else str(n)
+                      for n in node_ids}
+        targets = []
+        for n in self.gcs.call("get_all_nodes", {}):
+            if not n.get("alive") or n["address"] in have:
+                continue
+            if node_ids is not None:
+                nid = n["node_id"]
+                nid_hex = nid.hex() if isinstance(nid, (bytes, bytearray)) else str(nid)
+                if nid_hex not in wanted:
+                    continue
+            targets.append(n["address"])
+        if not targets:
+            return 0
+        payload = {"object_id": ref.id, "targets": targets,
+                   "owner_address": self.address}
+        if location == self.raylet_address:
+            self.raylet.notify("push_object", payload)
+        else:
+            self.peer(location).notify("push_object", payload)
+        return len(targets)
+
     def _notify_owner_async(self, owner: str, method: str, payload: dict) -> None:
         self._owner_notify_q.put((owner, method, payload))
         # The lock pairs with the loop's exit decision: either the live
