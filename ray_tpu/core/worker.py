@@ -601,6 +601,22 @@ class CoreWorker:
         deadline = None if timeout is None else time.monotonic() + timeout
         return [self._get_one(r, deadline) for r in refs]
 
+    def try_get_local(self, ref: ObjectRef):
+        """(value, True) when the owned object is terminal AND resolvable
+        without blocking (inline or error blob in the local table) — the
+        post-completion fast path for event-loop callers (serve's HTTP
+        edge). (None, False) means call get() on a thread that may block."""
+        if ref.owner_address not in ("", self.address):
+            return None, False
+        with self._obj_lock:
+            st = self._objects.get(ref.id)
+            if st is None or st.state != "inline":
+                # plasma needs a fetch; errors go through get() so exception
+                # rewrapping semantics stay in one place
+                return None, False
+            blob = st.inline_blob
+        return serialization.loads(blob), True
+
     def get_async(self, ref: ObjectRef) -> Future:
         fut: Future = Future()
 

@@ -211,6 +211,23 @@ class ContinuousBatchingEngine:
                 toks = toks[:-1]
             return toks
 
+    def progress(self, request_id: int):
+        """(tokens generated so far, done) — readable while decoding, for
+        token streaming. Mirrors result(): a trailing EOS is stripped, so
+        streamed output always equals the non-streamed suffix."""
+        with self._lock:
+            req = self._finished.get(request_id)
+            if req is not None:
+                toks = list(req.generated)
+                if (self.eos_token is not None and toks
+                        and toks[-1] == self.eos_token):
+                    toks.pop()
+                return toks, True
+            for req in list(self._active.values()) + self._waiting:
+                if req.request_id == request_id:
+                    return list(req.generated), req.done
+        return [], True  # unknown id
+
     def generate(self, prompt: List[int], *, max_new_tokens: int = 32
                  ) -> List[int]:
         rid = self.submit(prompt, max_new_tokens=max_new_tokens)
@@ -219,6 +236,24 @@ class ContinuousBatchingEngine:
                     not self._waiting:
                 break
         return self.result(rid) or []
+
+    def generate_stream(self, prompt: List[int], *,
+                        max_new_tokens: int = 32):
+        """Generator yielding tokens AS DECODED (continuous batching keeps
+        serving other slots between yields) — the engine half of
+        Serve token streaming (reference vLLM-style streaming generate)."""
+        rid = self.submit(prompt, max_new_tokens=max_new_tokens)
+        emitted = 0
+        while True:
+            active = self.step()
+            toks, done = self.progress(rid)
+            while emitted < len(toks):
+                yield int(toks[emitted])
+                emitted += 1
+            if done:
+                return
+            if active == 0:
+                return  # nothing left anywhere; request never finished
 
 
 def LLMDeployment(params, cfg: ModelConfig, *, num_slots: int = 4,
@@ -242,6 +277,14 @@ def LLMDeployment(params, cfg: ModelConfig, *, num_slots: int = 4,
             prompt = list(payload["prompt"])
             n = int(payload.get("max_new_tokens", 32))
             return self.engine.generate(prompt, max_new_tokens=n)
+
+        def stream(self, payload):
+            """Streaming entry: call through a stream handle
+            (`handle.options(method_name='stream', stream=True)`) or HTTP
+            `POST /<name>/stream?stream=1` — tokens arrive as generated."""
+            prompt = list(payload["prompt"])
+            n = int(payload.get("max_new_tokens", 32))
+            yield from self.engine.generate_stream(prompt, max_new_tokens=n)
 
     _LLM.__name__ = "LLMDeployment"
     return _LLM

@@ -563,6 +563,7 @@ class DeploymentHandle:
         self._method = method_name
         self._version = -1
         self._incarnation = None  # controller incarnation the version is from
+        self._stream = False
         self._replicas: List[Any] = []
         # keyed by replica actor id, NOT list index: a replica-set change
         # must not let stale completions decrement a new replica's count
@@ -700,8 +701,10 @@ class DeploymentHandle:
                 pass
             self._sub_cb = None
 
-    def options(self, method_name: str = "__call__") -> "DeploymentHandle":
+    def options(self, method_name: str = "__call__",
+                stream: bool = False) -> "DeploymentHandle":
         h = DeploymentHandle(self._name, method_name)
+        h._stream = stream
         return h
 
     def remote(self, *args, **kwargs):
@@ -728,7 +731,6 @@ class DeploymentHandle:
         key = self._rkey(replica)
         with self._lock:
             self._inflight[key] = self._inflight.get(key, 0) + 1
-        ref = replica.handle_request.remote(self._method, args, kwargs)
 
         def _dec():
             with self._lock:
@@ -736,11 +738,29 @@ class DeploymentHandle:
 
         from ray_tpu.core.api import _global_worker
 
+        if getattr(self, "_stream", False):
+            # streaming call (reference handle.options(stream=True)): the
+            # replica method returns a generator; its items arrive as a
+            # dynamic-return stream consumable while the replica still runs
+            gen = replica.handle_request.options(
+                num_returns="dynamic").remote(self._method, args, kwargs)
+            _global_worker().add_done_callback(gen._gen_ref, _dec)
+            return gen
+        ref = replica.handle_request.remote(self._method, args, kwargs)
         _global_worker().add_done_callback(ref, _dec)
         return ref
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._name, self._method))
+        # the stream flag must survive serialization: a stream handle passed
+        # into another deployment keeps streaming after deserialization
+        return (_rebuild_handle,
+                (self._name, self._method, getattr(self, "_stream", False)))
+
+
+def _rebuild_handle(name: str, method: str, stream: bool) -> "DeploymentHandle":
+    h = DeploymentHandle(name, method)
+    h._stream = stream
+    return h
 
 
 # ------------------------------------------------------------------ public
@@ -823,10 +843,11 @@ _handle_cache: Dict[tuple, DeploymentHandle] = {}
 _handle_cache_lock = threading.Lock()
 
 
-def _cached_handle(name: str, method: str = "__call__") -> DeploymentHandle:
-    """One long-lived handle per (deployment, method) in this process:
-    repeated lookups reuse the replica set, in-flight accounting, and the
-    single pubsub refresher instead of growing a handle per call."""
+def _cached_handle(name: str, method: str = "__call__",
+                   stream: bool = False) -> DeploymentHandle:
+    """One long-lived handle per (deployment, method, stream) in this
+    process: repeated lookups reuse the replica set, in-flight accounting,
+    and the single pubsub refresher instead of growing a handle per call."""
     from ray_tpu.core.api import _global_worker
 
     try:
@@ -834,13 +855,14 @@ def _cached_handle(name: str, method: str = "__call__") -> DeploymentHandle:
     except Exception:
         world = None
     with _handle_cache_lock:
-        h = _handle_cache.get((name, method))
+        h = _handle_cache.get((name, method, stream))
         # a cached handle from a torn-down-and-rebooted cluster (its worker
         # address differs) holds dead replicas — replace it
         if h is None or h._closed or getattr(h, "_world", None) != world:
             h = DeploymentHandle(name, method)
+            h._stream = stream
             h._world = world
-            _handle_cache[(name, method)] = h
+            _handle_cache[(name, method, stream)] = h
         return h
 
 
@@ -988,67 +1010,21 @@ def shutdown() -> None:
 
 @ray_tpu.remote
 class _HTTPProxyActor:
-    """HTTP ingress: POST /<deployment> with a JSON body -> handle call
-    (reference HTTPProxyActor, _private/http_proxy.py:250,434)."""
+    """HTTP ingress (reference HTTPProxyActor, _private/http_proxy.py:250,
+    434): an asyncio HTTP/1.1 edge whose request lifecycle is event-driven
+    (completion via add_done_callback — no thread parked per request), with
+    raw/binary bodies and chunked streaming responses. Implementation:
+    serve/http_proxy.py."""
 
     def __init__(self, port: int, host: str = "127.0.0.1"):
-        import http.server
+        from ray_tpu.serve.http_proxy import AsyncHTTPProxy
 
-        self._host = host
-        proxy = self
-
-        class Handler(http.server.BaseHTTPRequestHandler):
-            def _serve(self, payload):
-                from urllib.parse import urlparse
-
-                name = urlparse(self.path).path.strip("/")
-                t0 = time.monotonic()
-                try:
-                    handle = proxy._handles.setdefault(
-                        name, DeploymentHandle(name))
-                    out = ray_tpu.get(handle.remote(payload), timeout=60)
-                    data = json.dumps({"result": out}).encode()
-                    self.send_response(200)
-                except Exception as e:
-                    _serve_metrics()["errors"].inc(
-                        tags={"deployment": name})
-                    data = json.dumps({"error": str(e)}).encode()
-                    self.send_response(500)
-                _serve_metrics()["latency"].observe(
-                    time.monotonic() - t0, tags={"deployment": name})
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-            def do_POST(self):
-                length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length) if length else b"{}"
-                try:
-                    payload = json.loads(body) if body else {}
-                except ValueError as e:
-                    data = json.dumps({"error": f"bad JSON body: {e}"}).encode()
-                    self.send_response(400)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(data)))
-                    self.end_headers()
-                    self.wfile.write(data)
-                    return
-                self._serve(payload)
-
-            def do_GET(self):
-                from urllib.parse import parse_qsl, urlparse
-
-                query = dict(parse_qsl(urlparse(self.path).query))
-                self._serve(query)
-
-            def log_message(self, *a):
-                pass
-
-        self._handles: Dict[str, DeploymentHandle] = {}
-        self._server = http.server.ThreadingHTTPServer((self._host, port), Handler)
-        self.port = self._server.server_address[1]
-        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        self._server = AsyncHTTPProxy(
+            host, port,
+            get_handle=_cached_handle,
+            get_stream_handle=lambda name, method="__call__": _cached_handle(
+                name, method, stream=True))
+        self.port = self._server.port
 
     def get_port(self) -> int:
         return self.port

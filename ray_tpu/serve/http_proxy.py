@@ -1,0 +1,323 @@
+"""Asyncio HTTP ingress for Serve (reference `serve/_private/http_proxy.py:250`).
+
+The previous edge was a ThreadingHTTPServer parking one OS thread per
+in-flight request on a blocking 60 s `ray_tpu.get`. This proxy is a
+stdlib-only asyncio HTTP/1.1 server whose request lifecycle is event-driven
+end to end: submission runs on a small executor pool (it can touch sockets),
+completion rides the ownership layer's `add_done_callback` (thread-free, the
+same mechanism the handle router uses for in-flight accounting), and only
+the final value fetch — instant once the object is terminal — touches the
+pool again.
+
+Features the reference edge has that the old one lacked:
+- raw/binary request bodies (any content type; JSON stays convenient)
+- binary/text responses (bytes -> octet-stream, str -> text/plain)
+- STREAMING responses: `POST /<deployment>/stream` (or `?stream=1`) iterates
+  a num_returns="dynamic" replica generator and relays each item as an HTTP
+  chunk as it is produced — token streaming for the LLM engine
+  (reference streaming HTTP responses, http_proxy.py + serve handles'
+  `options(stream=True)`).
+- keep-alive connections.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qsl, urlparse
+
+logger = logging.getLogger(__name__)
+
+_MAX_HEADER_BYTES = 64 * 1024
+_MAX_BODY_BYTES = 512 * 1024 * 1024
+_REQUEST_TIMEOUT_S = 60.0
+
+
+class _BadRequest(Exception):
+    pass
+
+
+class AsyncHTTPProxy:
+    """HTTP/1.1 server on a dedicated asyncio loop thread."""
+
+    def __init__(self, host: str, port: int, get_handle, get_stream_handle):
+        """`get_handle(name)` / `get_stream_handle(name)` return Serve
+        deployment handles (injected so this module stays import-light)."""
+        self._get_handle = get_handle
+        self._get_stream_handle = get_stream_handle
+        # submissions + ready-object fetches; sized generously because every
+        # operation on it is short (submit) or instant (terminal-state get)
+        self._pool = ThreadPoolExecutor(max_workers=32,
+                                        thread_name_prefix="serve-http")
+        # streaming iterations park a worker per LIVE stream (next() blocks
+        # on the owner's arrival condition); bounded separately so streams
+        # can't starve request submission
+        self._stream_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="serve-http-stream")
+        self._loop = asyncio.new_event_loop()
+        self.port: int = 0
+        started = threading.Event()
+
+        def run() -> None:
+            asyncio.set_event_loop(self._loop)
+
+            async def serve() -> None:
+                server = await asyncio.start_server(
+                    self._handle_conn, host, port)
+                self.port = server.sockets[0].getsockname()[1]
+                started.set()
+
+            self._loop.run_until_complete(serve())
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=run, name="serve-http-loop",
+                                        daemon=True)
+        self._thread.start()
+        if not started.wait(timeout=10):
+            raise RuntimeError("HTTP proxy failed to start")
+
+    # ------------------------------------------------------------ request IO
+    async def _read_request(self, reader) -> Optional[dict]:
+        try:
+            line = await reader.readline()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            return None
+        if not line:
+            return None
+        try:
+            method, target, _version = line.decode("latin1").split(None, 2)
+        except ValueError:
+            raise _BadRequest("malformed request line")
+        headers: Dict[str, str] = {}
+        total = len(line)
+        while True:
+            line = await reader.readline()
+            total += len(line)
+            if total > _MAX_HEADER_BYTES:
+                raise _BadRequest("headers too large")
+            if line in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = line.decode("latin1").partition(":")
+            headers[k.strip().lower()] = v.strip()
+        length = int(headers.get("content-length", 0) or 0)
+        if length > _MAX_BODY_BYTES:
+            raise _BadRequest("body too large")
+        try:
+            body = await reader.readexactly(length) if length else b""
+        except (ConnectionError, asyncio.IncompleteReadError):
+            return None  # client aborted mid-body: routine disconnect
+        return {"method": method.upper(), "target": target,
+                "headers": headers, "body": body,
+                "close": headers.get("connection", "").lower() == "close"}
+
+    @staticmethod
+    def _response(status: int, body: bytes, content_type: str,
+                  close: bool) -> bytes:
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  500: "Internal Server Error"}.get(status, "")
+        return (f"HTTP/1.1 {status} {reason}\r\n"
+                f"Content-Type: {content_type}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"Connection: {'close' if close else 'keep-alive'}\r\n"
+                "\r\n").encode("latin1") + body
+
+    @staticmethod
+    def _encode_result(out: Any) -> Tuple[bytes, str]:
+        if isinstance(out, (bytes, bytearray, memoryview)):
+            return bytes(out), "application/octet-stream"
+        if isinstance(out, str):
+            return out.encode(), "text/plain; charset=utf-8"
+        return json.dumps({"result": out}).encode(), "application/json"
+
+    # ------------------------------------------------------------- lifecycle
+    async def _handle_conn(self, reader, writer) -> None:
+        try:
+            while True:
+                try:
+                    req = await self._read_request(reader)
+                except _BadRequest as e:
+                    writer.write(self._response(
+                        400, json.dumps({"error": str(e)}).encode(),
+                        "application/json", True))
+                    await writer.drain()
+                    break
+                if req is None:
+                    break
+                try:
+                    await self._dispatch(req, writer)
+                except (ConnectionError, asyncio.IncompleteReadError):
+                    break
+                if req["close"]:
+                    break
+        except Exception:
+            logger.exception("http connection failed")
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _parse_target(self, req: dict):
+        """Route `/<deployment>[/<method>]` with `?stream=1` selecting the
+        chunked streaming path (the method must return a generator)."""
+        parsed = urlparse(req["target"])
+        parts = [p for p in parsed.path.split("/") if p]
+        query = dict(parse_qsl(parsed.query))
+        stream = query.pop("stream", "0") in ("1", "true")
+        if not parts:
+            raise _BadRequest("no deployment in path")
+        name = parts[0]
+        method = parts[1] if len(parts) > 1 else "__call__"
+        if req["method"] == "GET":
+            payload: Any = query
+        else:
+            ctype = req["headers"].get("content-type", "application/json")
+            if "json" in ctype:
+                try:
+                    payload = json.loads(req["body"]) if req["body"] else {}
+                except ValueError as e:
+                    raise _BadRequest(f"bad JSON body: {e}")
+            elif ("form-urlencoded" in ctype or ctype.startswith("text/")):
+                # clients (urllib!) that omit an explicit JSON content type
+                # still overwhelmingly send JSON; fall back to raw on parse
+                # failure instead of rejecting
+                try:
+                    payload = json.loads(req["body"]) if req["body"] else {}
+                except ValueError:
+                    payload = req["body"]
+            else:
+                payload = req["body"]  # raw/binary passthrough
+        return name, method, payload, stream
+
+    async def _await_ref(self, ref) -> None:
+        """Thread-free completion: resolves when the ownership layer reports
+        the object terminal (no parked thread, no polling)."""
+        from ray_tpu.core.api import _global_worker
+
+        fut = self._loop.create_future()
+
+        def done() -> None:
+            self._loop.call_soon_threadsafe(
+                lambda: fut.done() or fut.set_result(None))
+
+        _global_worker().add_done_callback(ref, done)
+        await asyncio.wait_for(fut, timeout=_REQUEST_TIMEOUT_S)
+
+    async def _dispatch(self, req: dict, writer) -> None:
+        from ray_tpu.serve.api import _serve_metrics
+
+        t0 = time.monotonic()
+        try:
+            name, method, payload, stream = self._parse_target(req)
+        except _BadRequest as e:
+            writer.write(self._response(
+                400, json.dumps({"error": str(e)}).encode(),
+                "application/json", req["close"]))
+            await writer.drain()
+            return
+        # no requests.inc here: the handle's remote() counts it (this
+        # process), exactly as the edge always has
+        try:
+            if stream:
+                await self._dispatch_stream(name, method, payload, req, writer)
+            else:
+                handle = self._get_handle(name, method)
+                if getattr(handle, "_replicas", None):
+                    # warm handle: submission is sample + one socket send —
+                    # cheaper than a thread hop
+                    ref = handle.remote(payload)
+                else:
+                    ref = await self._loop.run_in_executor(
+                        self._pool, handle.remote, payload)
+                await self._await_ref(ref)
+                import ray_tpu
+                from ray_tpu.core.api import _global_worker
+
+                # terminal inline results resolve without leaving the loop;
+                # plasma results (a blocking fetch) hop to the pool
+                out, ok = _global_worker().try_get_local(ref)
+                if not ok:
+                    # plasma result: the pull gets the full request budget
+                    out = await self._loop.run_in_executor(
+                        self._pool, lambda: ray_tpu.get(
+                            ref, timeout=_REQUEST_TIMEOUT_S))
+                body, ctype = self._encode_result(out)
+                writer.write(self._response(200, body, ctype, req["close"]))
+                await writer.drain()
+        except Exception as e:
+            _serve_metrics()["errors"].inc(tags={"deployment": name})
+            writer.write(self._response(
+                500, json.dumps({"error": str(e)}).encode(),
+                "application/json", req["close"]))
+            await writer.drain()
+        finally:
+            _serve_metrics()["latency"].observe(
+                time.monotonic() - t0, tags={"deployment": name})
+
+    async def _dispatch_stream(self, name: str, method: str, payload: Any,
+                               req: dict, writer) -> None:
+        """Chunked-encoding relay of a streaming deployment: each object the
+        replica's generator yields becomes one HTTP chunk as soon as it is
+        reported — tokens reach the client while the model still decodes."""
+        import ray_tpu
+
+        # submit BEFORE the 200 goes out: submission failures (no replicas,
+        # unknown deployment) still produce a clean 500 via the caller
+        handle = self._get_stream_handle(name, method)
+        gen = await self._loop.run_in_executor(
+            self._stream_pool, handle.remote, payload)
+        writer.write((
+            "HTTP/1.1 200 OK\r\n"
+            "Content-Type: application/x-ndjson\r\n"
+            "Transfer-Encoding: chunked\r\n"
+            f"Connection: {'close' if req['close'] else 'keep-alive'}\r\n"
+            "\r\n").encode("latin1"))
+        await writer.drain()
+
+        _done = object()
+
+        def next_item() -> Any:
+            try:
+                ref = next(gen)
+            except StopIteration:
+                return _done
+            return ray_tpu.get(ref, timeout=_REQUEST_TIMEOUT_S)
+
+        # Once chunked 200 headers are out, an HTTP 500 can never follow —
+        # writing one mid-body would corrupt framing and desync keep-alive.
+        # Errors become a final error chunk + a CLEAN chunk terminator.
+        try:
+            while True:
+                item = await self._loop.run_in_executor(
+                    self._stream_pool, next_item)
+                if item is _done:
+                    break
+                if isinstance(item, (bytes, bytearray, memoryview)):
+                    chunk = bytes(item)
+                elif isinstance(item, str):
+                    chunk = item.encode()
+                else:
+                    chunk = json.dumps(item).encode() + b"\n"
+                writer.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+                await writer.drain()
+        except Exception as e:
+            from ray_tpu.serve.api import _serve_metrics
+
+            _serve_metrics()["errors"].inc(tags={"deployment": name})
+            err = json.dumps({"error": str(e)}).encode() + b"\n"
+            writer.write(f"{len(err):x}\r\n".encode() + err + b"\r\n")
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()
+
+    def stop(self) -> None:
+        try:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        except Exception:
+            pass
+        self._pool.shutdown(wait=False)
+        self._stream_pool.shutdown(wait=False)

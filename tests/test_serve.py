@@ -466,3 +466,151 @@ def test_controller_crash_readopts_replicas_and_rolls(ray_start_regular):
                          "after the controller restart")
     finally:
         serve.shutdown()
+
+
+def test_http_binary_body_and_response(serve_cluster):
+    """Raw (non-JSON) request bodies pass through untouched, and bytes
+    results come back as octet-stream (reference raw-request support the
+    old thread-per-request edge lacked)."""
+
+    @serve.deployment
+    def mirror(data):
+        assert isinstance(data, bytes)
+        return data[::-1]
+
+    serve.run(mirror.bind())
+    _, port = serve.start_http_proxy()
+    blob = bytes(range(256)) * 4
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/mirror", data=blob,
+        headers={"Content-Type": "application/octet-stream"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert resp.headers["Content-Type"] == "application/octet-stream"
+        assert resp.read() == blob[::-1]
+
+
+def test_http_streaming_chunks_arrive_incrementally(serve_cluster):
+    """?stream=1 relays a generator deployment as HTTP chunks while the
+    replica is still producing: the first token must arrive well before
+    the stream completes."""
+    import http.client
+
+    @serve.deployment
+    def ticker(payload):
+        for i in range(5):
+            time.sleep(0.4)
+            yield {"tok": i}
+
+    serve.run(ticker.bind())
+    _, port = serve.start_http_proxy()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    t0 = time.monotonic()
+    conn.request("POST", "/ticker?stream=1", body=json.dumps({}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    items, stamps = [], []
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        line = line.strip()
+        if line:
+            items.append(json.loads(line))
+            stamps.append(time.monotonic() - t0)
+    conn.close()
+    assert items == [{"tok": i} for i in range(5)]
+    # first chunk must land well before the last (streaming, not buffering)
+    assert stamps[0] < stamps[-1] - 0.5, stamps
+
+
+def test_llm_deployment_streams_tokens_over_http(serve_cluster):
+    """VERDICT done-criterion: the continuous-batching LLM engine streams
+    tokens over chunked HTTP as they are decoded."""
+    import http.client
+
+    import jax
+
+    from ray_tpu.models import ModelConfig, init_params
+    from ray_tpu.models.serving import LLMDeployment
+
+    cfg = ModelConfig.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    D = serve.deployment(LLMDeployment(params, cfg, num_slots=2, max_len=64))
+    handle = serve.run(D.bind())
+    # non-streaming baseline through the handle
+    full = ray_tpu.get(handle.remote(
+        {"prompt": [5, 17, 400, 3], "max_new_tokens": 6}), timeout=120)
+
+    _, port = serve.start_http_proxy()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", "/LLMDeployment/stream?stream=1",
+                 body=json.dumps({"prompt": [5, 17, 400, 3],
+                                  "max_new_tokens": 6}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    toks = []
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        if line.strip():
+            toks.append(json.loads(line))
+    conn.close()
+    assert [5, 17, 400, 3] + toks == full, (toks, full)
+
+
+@pytest.mark.slow
+def test_http_closed_loop_throughput(ray_start_regular):
+    """The asyncio edge must sustain >=1k req/s closed-loop on one CPU
+    (VERDICT done-criterion; the old thread-per-request edge could not).
+    Keep-alive connections, 8 client threads, best of 3 windows."""
+    import http.client
+    import threading as _threading
+
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2, max_concurrent_queries=32)
+    def noop(x):
+        return x
+
+    serve.run(noop.bind())
+    _, port = serve.start_http_proxy()
+    body = json.dumps(1).encode()
+    stop = _threading.Event()
+    counts = []
+
+    def client():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        n = 0
+        while not stop.is_set():
+            conn.request("POST", "/noop", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200
+            n += 1
+        conn.close()
+        counts.append(n)
+
+    best = 0.0
+    try:
+        for _ in range(3):
+            counts.clear()
+            stop.clear()
+            threads = [_threading.Thread(target=client) for _ in range(8)]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            time.sleep(3.0)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            rate = sum(counts) / (time.monotonic() - t0)
+            best = max(best, rate)
+            if best >= 1000:
+                break
+    finally:
+        serve.shutdown()
+    assert best >= 1000, f"HTTP throughput {best:.0f} req/s < 1000"

@@ -73,3 +73,13 @@ def test_bucketed_prefill_and_validation():
         eng.submit(list(range(MAX_LEN)))
     with pytest.raises(ValueError, match="empty"):
         eng.submit([])
+
+
+def test_generate_stream_matches_generate():
+    """Streaming yields exactly the generated suffix, token by token."""
+    eng = ContinuousBatchingEngine(PARAMS, CFG, num_slots=2, max_len=MAX_LEN)
+    prompt = [5, 17, 400, 3]
+    full = eng.generate(prompt, max_new_tokens=8)
+    eng2 = ContinuousBatchingEngine(PARAMS, CFG, num_slots=2, max_len=MAX_LEN)
+    streamed = list(eng2.generate_stream(prompt, max_new_tokens=8))
+    assert prompt + streamed == full
