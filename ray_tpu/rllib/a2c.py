@@ -16,61 +16,43 @@ import numpy as np
 import ray_tpu
 from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.env import CartPoleEnv
-from ray_tpu.rllib.ppo import RolloutWorker, compute_gae, init_policy_params, policy_apply
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.ppo import RolloutWorker, compute_gae
 
 
-class A2CLearner:
-    """Single jitted pg + vf + entropy update (no clipping, no epochs)."""
+class A2CLearner(Learner):
+    """Single pg + vf + entropy update (no clipping, no epochs) on the
+    Learner stack (reference A2C via core/learner); the network is a
+    swappable RLModule. Pass `mesh=` to dp-shard batches."""
 
     def __init__(self, obs_dim: int, num_actions: int, lr: float,
                  vf_coeff: float = 0.5, entropy_coeff: float = 0.01,
-                 seed: int = 0):
-        import jax
-        import jax.numpy as jnp
-        import optax
+                 seed: int = 0, mesh=None, module=None):
+        from ray_tpu.rllib.rl_module import DiscreteActorCriticModule
 
-        self.params = init_policy_params(seed, obs_dim, num_actions)
-        self.optimizer = optax.adam(lr)
-        self.opt_state = self.optimizer.init(self.params)
+        self.module = module or DiscreteActorCriticModule(obs_dim, num_actions)
+        self._vf_coeff = vf_coeff
+        self._entropy_coeff = entropy_coeff
+        super().__init__(lr=lr, mesh=mesh, seed=seed)
 
-        def loss_fn(params, batch):
-            logits, value = policy_apply(params, batch["obs"])
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, batch["actions"][:, None], axis=-1)[:, 0]
-            pg = -(logp * batch["advantages"]).mean()
-            vf = 0.5 * ((value - batch["returns"]) ** 2).mean()
-            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-            total = pg + vf_coeff * vf - entropy_coeff * entropy
-            return total, {"policy_loss": pg, "vf_loss": vf, "entropy": entropy}
+    def init_params(self, seed: int):
+        return self.module.init_params(seed)
 
-        def update(params, opt_state, batch):
-            (loss, aux), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, batch)
-            updates, opt_state = self.optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            aux["total_loss"] = loss
-            return params, opt_state, aux
-
-        self._update = jax.jit(update)
+    def loss(self, params, batch, extra, rng):
+        out = self.module.forward_train(params, batch)
+        dist = self.module.action_dist(out)
+        logp = dist.logp(batch["actions"])
+        pg = -(logp * batch["advantages"]).mean()
+        vf = 0.5 * ((out["vf"] - batch["returns"]) ** 2).mean()
+        entropy = dist.entropy().mean()
+        total = pg + self._vf_coeff * vf - self._entropy_coeff * entropy
+        return total, {"policy_loss": pg, "vf_loss": vf, "entropy": entropy}
 
     def update_once(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         import jax
 
-        self.params, self.opt_state, aux = self._update(
-            self.params, self.opt_state, batch)
+        aux = self.update(batch)
         return {k: float(v) for k, v in jax.device_get(aux).items()}
-
-    def get_weights(self):
-        import jax
-
-        return {k: np.asarray(v) for k, v in jax.device_get(self.params).items()}
-
-    def set_weights(self, weights):
-        import jax.numpy as jnp
-
-        self.params = {k: jnp.asarray(v) for k, v in weights.items()}
-        self.opt_state = self.optimizer.init(self.params)
 
 
 class A2CConfig:

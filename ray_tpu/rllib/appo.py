@@ -15,69 +15,36 @@ import numpy as np
 import ray_tpu
 from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.env import CartPoleEnv
-from ray_tpu.rllib.impala import vtrace_targets
-from ray_tpu.rllib.ppo import RolloutWorker, init_policy_params, policy_apply
+from ray_tpu.rllib.impala import _VTraceLearner
+from ray_tpu.rllib.ppo import RolloutWorker
 
 
-class APPOLearner:
+class APPOLearner(_VTraceLearner):
+    """Clipped-surrogate loss on v-trace advantages (reference
+    rllib/algorithms/appo)."""
+
     def __init__(self, obs_dim: int, num_actions: int, lr: float,
                  gamma: float, clip: float, vf_coeff: float,
-                 entropy_coeff: float, seed: int = 0):
+                 entropy_coeff: float, seed: int = 0, mesh=None, module=None):
+        self._clip = clip
+        super().__init__(obs_dim, num_actions, lr, gamma, vf_coeff,
+                         entropy_coeff, seed=seed, mesh=mesh, module=module)
+
+    def loss(self, params, batch, extra, rng):
         import jax
         import jax.numpy as jnp
-        import optax
 
-        self.params = init_policy_params(seed, obs_dim, num_actions)
-        self.optimizer = optax.adam(lr)
-        self.opt_state = self.optimizer.init(self.params)
-
-        def loss_fn(params, batch):
-            logits, values = policy_apply(params, batch["obs"])  # [T,N,A],[T,N]
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
-            vs, pg_adv = vtrace_targets(
-                batch["logp"], jax.lax.stop_gradient(logp), batch["rewards"],
-                jax.lax.stop_gradient(values), batch["last_value"],
-                batch["dones"], gamma)
-            adv = jax.lax.stop_gradient(pg_adv)
-            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-            ratio = jnp.exp(logp - batch["logp"])
-            pg = -jnp.minimum(
-                ratio * adv,
-                jnp.clip(ratio, 1 - clip, 1 + clip) * adv).mean()
-            vf = 0.5 * ((values - jax.lax.stop_gradient(vs)) ** 2).mean()
-            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-            total = pg + vf_coeff * vf - entropy_coeff * entropy
-            return total, {"policy_loss": pg, "vf_loss": vf, "entropy": entropy}
-
-        def update(params, opt_state, batch):
-            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, batch)
-            updates, opt_state = self.optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            aux["total_loss"] = loss
-            return params, opt_state, aux
-
-        self._update = jax.jit(update)
-
-    def update_batch(self, batch) -> Dict[str, float]:
-        import jax
-
-        self.params, self.opt_state, aux = self._update(
-            self.params, self.opt_state, batch)
-        return {k: float(v) for k, v in jax.device_get(aux).items()}
-
-    def get_weights(self):
-        import jax
-
-        return {k: np.asarray(v) for k, v in jax.device_get(self.params).items()}
-
-    def set_weights(self, weights):
-        import jax.numpy as jnp
-
-        self.params = {k: jnp.asarray(v) for k, v in weights.items()}
-        self.opt_state = self.optimizer.init(self.params)
+        tm, dist, logp, values, vs, pg_adv = self._policy_terms(params, batch)
+        adv = jax.lax.stop_gradient(pg_adv)
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        ratio = jnp.exp(logp - tm["logp"])
+        pg = -jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - self._clip, 1 + self._clip) * adv).mean()
+        vf = 0.5 * ((values - jax.lax.stop_gradient(vs)) ** 2).mean()
+        entropy = dist.entropy().mean()
+        total = pg + self._vf_coeff * vf - self._entropy_coeff * entropy
+        return total, {"policy_loss": pg, "vf_loss": vf, "entropy": entropy}
 
 
 class APPOConfig:

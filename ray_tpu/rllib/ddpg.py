@@ -18,6 +18,7 @@ from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.env import PendulumEnv
 from ray_tpu.rllib.models import init_mlp, mlp_forward, mlp_forward_np
 from ray_tpu.rllib.replay_buffers import ReplayBuffer
+from ray_tpu.rllib.learner import Learner, delayed
 from ray_tpu.rllib.sac import ContinuousWorkerBase, q_value
 
 
@@ -59,113 +60,109 @@ class NoisyActorWorker(ContinuousWorkerBase):
         return np.clip(mean + noise, -self.max_action, self.max_action)
 
 
-class DDPGLearner:
-    """Jitted critic + (optionally delayed) actor update with polyak sync."""
+class DDPGLearner(Learner):
+    """Critic + (optionally delayed) actor update with polyak sync, on the
+    Learner stack: ONE combined loss whose per-term stop_gradients route
+    gradients (critic <- TD, actor <- Q through FROZEN critic), per-group
+    optimizers via optax.multi_transform (the reference's
+    configure_optimizers_for_module), the TD3 actor delay as a `delayed`
+    transform with frozen inner state, and the polyak target sync as the
+    jitted post_update hook."""
 
     def __init__(self, obs_dim: int, action_dim: int, max_action: float,
                  actor_lr: float, critic_lr: float, gamma: float, tau: float,
                  twin_q: bool, smooth_target_policy: bool,
                  target_noise: float, target_noise_clip: float,
-                 seed: int = 0):
-        import jax
-        import jax.numpy as jnp
+                 seed: int = 0, policy_delay: int = 1, mesh=None):
+        self._obs_dim = obs_dim
+        self._action_dim = action_dim
+        self._max_action = max_action
+        self._actor_lr = actor_lr
+        self._critic_lr = critic_lr
+        self._gamma = gamma
+        self._tau = tau
+        self.twin_q = twin_q
+        self._smooth = smooth_target_policy
+        self._tnoise = target_noise
+        self._tclip = target_noise_clip
+        self._policy_delay = max(1, policy_delay)
+        super().__init__(mesh=mesh, seed=seed)
+
+    def init_params(self, seed: int):
+        return init_ddpg_params(seed, self._obs_dim, self._action_dim,
+                                self.twin_q)
+
+    def make_optimizer(self):
         import optax
 
-        self.twin_q = twin_q
-        self.params = init_ddpg_params(seed, obs_dim, action_dim, twin_q)
-        self.target = jax.tree.map(lambda v: v.copy(), self.params)
-        self.actor_opt = optax.adam(actor_lr)
-        self.critic_opt = optax.adam(critic_lr)
-        critic_keys = ["q1"] + (["q2"] if twin_q else [])
-        self.actor_opt_state = self.actor_opt.init(self.params["actor"])
-        self.critic_opt_state = self.critic_opt.init(
-            {k: self.params[k] for k in critic_keys})
-        self._key = jax.random.PRNGKey(seed)
+        actor_tx = optax.adam(self._actor_lr)
+        if self._policy_delay > 1:
+            actor_tx = delayed(actor_tx, self._policy_delay)
 
-        def critic_loss(critics, target, batch, key):
-            next_a = actor_apply(target["actor"], batch["next_obs"], max_action)
-            if smooth_target_policy:
-                noise = jnp.clip(
-                    jax.random.normal(key, next_a.shape) * target_noise,
-                    -target_noise_clip, target_noise_clip)
-                next_a = jnp.clip(next_a + noise, -max_action, max_action)
-            tq = q_value(target["q1"], batch["next_obs"], next_a)
-            if twin_q:
-                tq = jnp.minimum(
-                    tq, q_value(target["q2"], batch["next_obs"], next_a))
-            backup = jax.lax.stop_gradient(
-                batch["rewards"] + gamma * (1 - batch["dones"]) * tq)
-            loss = ((q_value(critics["q1"], batch["obs"], batch["actions"])
-                     - backup) ** 2).mean()
-            if twin_q:
-                loss += ((q_value(critics["q2"], batch["obs"], batch["actions"])
-                          - backup) ** 2).mean()
-            return loss
+        def labeler(params):
+            import jax
 
-        def actor_loss(actor, params, batch):
-            a = actor_apply(actor, batch["obs"], max_action)
-            return -q_value(params["q1"], batch["obs"], a).mean()
+            return {k: jax.tree_util.tree_map(
+                        lambda _, lbl=("actor" if k == "actor" else "critic"):
+                        lbl, v)
+                    for k, v in params.items()}
 
-        def update(params, target, actor_opt_state, critic_opt_state, batch,
-                   key, do_actor_update):
-            critics = {k: params[k] for k in critic_keys}
-            c_loss, c_grads = jax.value_and_grad(critic_loss)(
-                critics, target, batch, key)
-            c_up, critic_opt_state = self.critic_opt.update(
-                c_grads, critic_opt_state, critics)
-            critics = optax.apply_updates(critics, c_up)
-            params = {**params, **critics}
+        return optax.multi_transform(
+            {"actor": actor_tx, "critic": optax.adam(self._critic_lr)},
+            labeler)
 
-            def run_actor(operand):
-                params, actor_opt_state = operand
-                a_loss, a_grads = jax.value_and_grad(actor_loss)(
-                    params["actor"], params, batch)
-                a_up, actor_opt_state = self.actor_opt.update(
-                    a_grads, actor_opt_state, params["actor"])
-                return ({**params,
-                         "actor": optax.apply_updates(params["actor"], a_up)},
-                        actor_opt_state, a_loss)
+    def make_extra(self):
+        import jax
 
-            def skip_actor(operand):
-                params, actor_opt_state = operand
-                return params, actor_opt_state, jnp.zeros(())
+        return jax.tree_util.tree_map(lambda v: np.asarray(v).copy(),
+                                      self.params)
 
-            params, actor_opt_state, a_loss = jax.lax.cond(
-                do_actor_update, run_actor, skip_actor,
-                (params, actor_opt_state))
-            target = jax.tree.map(
-                lambda t, p: (1 - tau) * t + tau * p, target, params)
-            return (params, target, actor_opt_state, critic_opt_state,
-                    {"critic_loss": c_loss, "actor_loss": a_loss})
+    def post_update(self, params, extra):
+        import jax
 
-        self._update = jax.jit(update)
+        return jax.tree_util.tree_map(
+            lambda t, p: (1 - self._tau) * t + self._tau * p, extra, params)
 
-    def update_batch(self, batch, do_actor_update: bool) -> Dict[str, float]:
+    def loss(self, params, batch, extra, rng):
         import jax
         import jax.numpy as jnp
 
-        self._key, sub = jax.random.split(self._key)
-        (self.params, self.target, self.actor_opt_state,
-         self.critic_opt_state, aux) = self._update(
-            self.params, self.target, self.actor_opt_state,
-            self.critic_opt_state, batch, sub, jnp.asarray(do_actor_update))
+        sg = jax.lax.stop_gradient
+        next_a = actor_apply(extra["actor"], batch["next_obs"],
+                             self._max_action)
+        if self._smooth:
+            noise = jnp.clip(
+                jax.random.normal(rng, next_a.shape) * self._tnoise,
+                -self._tclip, self._tclip)
+            next_a = jnp.clip(next_a + noise,
+                              -self._max_action, self._max_action)
+        tq = q_value(extra["q1"], batch["next_obs"], next_a)
+        if self.twin_q:
+            tq = jnp.minimum(
+                tq, q_value(extra["q2"], batch["next_obs"], next_a))
+        backup = sg(batch["rewards"] + self._gamma
+                    * (1 - batch["dones"]) * tq)
+        c_loss = ((q_value(params["q1"], batch["obs"], batch["actions"])
+                   - backup) ** 2).mean()
+        if self.twin_q:
+            c_loss += ((q_value(params["q2"], batch["obs"], batch["actions"])
+                        - backup) ** 2).mean()
+
+        a = actor_apply(params["actor"], batch["obs"], self._max_action)
+        a_loss = -q_value(sg(params["q1"]), batch["obs"], a).mean()
+
+        total = c_loss + a_loss
+        return total, {"critic_loss": c_loss, "actor_loss": a_loss}
+
+    def update_batch(self, batch) -> Dict[str, float]:
+        import jax
+
+        aux = self.update(batch)
         return {k: float(v) for k, v in jax.device_get(aux).items()}
 
-    def get_weights(self):
-        import jax
-
-        return jax.tree.map(np.asarray, jax.device_get(self.params))
-
     def set_weights(self, weights):
-        import jax
-        import jax.numpy as jnp
-
-        self.params = jax.tree.map(jnp.asarray, weights)
-        self.target = jax.tree.map(lambda v: v.copy(), self.params)
-        critic_keys = ["q1"] + (["q2"] if self.twin_q else [])
-        self.actor_opt_state = self.actor_opt.init(self.params["actor"])
-        self.critic_opt_state = self.critic_opt.init(
-            {k: self.params[k] for k in critic_keys})
+        super().set_weights(weights)
+        self.extra = self.make_extra()
 
 
 class DDPGConfig:
@@ -251,7 +248,7 @@ class DDPG(Algorithm):
             cfg.obs_dim, cfg.action_dim, cfg.max_action, cfg.actor_lr,
             cfg.critic_lr, cfg.gamma, cfg.tau, cfg.twin_q,
             cfg.smooth_target_policy, cfg.target_noise,
-            cfg.target_noise_clip, cfg.seed)
+            cfg.target_noise_clip, cfg.seed, policy_delay=cfg.policy_delay)
         self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
         self.workers = [
             NoisyActorWorker.options(num_cpus=1).remote(
@@ -262,7 +259,6 @@ class DDPG(Algorithm):
         self._broadcast_weights()
         self._reward_history: List[float] = []
         self._total_steps = 0
-        self._update_count = 0
 
     def _broadcast_weights(self) -> None:
         actor = self.learner.get_weights()["actor"]
@@ -284,12 +280,12 @@ class DDPG(Algorithm):
         stats: Dict[str, float] = {}
         if len(self.buffer) >= cfg.train_batch_size:
             for _ in range(cfg.num_updates_per_step):
-                self._update_count += 1
                 mb = self.buffer.sample(cfg.train_batch_size)
+                # the actor's update period lives INSIDE the optimizer (a
+                # `delayed` transform), so every call is the same jitted step
                 stats = self.learner.update_batch(
                     {k: mb[k] for k in
-                     ("obs", "actions", "rewards", "next_obs", "dones")},
-                    self._update_count % cfg.policy_delay == 0)
+                     ("obs", "actions", "rewards", "next_obs", "dones")})
             self._broadcast_weights()
         return {
             "episode_reward_mean": (float(np.mean(self._reward_history))

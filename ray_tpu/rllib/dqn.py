@@ -102,7 +102,7 @@ class DQNLearner(Learner):
         # target net can alias the online params at sync points
         return self.params
 
-    def loss(self, params, batch, extra):
+    def loss(self, params, batch, extra, rng):
         import jax
         import jax.numpy as jnp
 

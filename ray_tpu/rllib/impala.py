@@ -21,7 +21,8 @@ import numpy as np
 import ray_tpu
 from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.env import CartPoleEnv
-from ray_tpu.rllib.ppo import RolloutWorker, init_policy_params, policy_apply
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.ppo import RolloutWorker
 
 
 def vtrace_targets(behavior_logp, target_logp, rewards, values, last_value,
@@ -54,62 +55,84 @@ def vtrace_targets(behavior_logp, target_logp, rewards, values, last_value,
     return vs, pg_adv
 
 
-class ImpalaLearner:
+class _VTraceLearner(Learner):
+    """Shared base for the v-trace family (APPO/IMPALA) on the Learner
+    stack. Batches are stored BATCH-MAJOR [N, T, ...] so a mesh dp-shard of
+    the leading axis splits ENV TRAJECTORIES, never the time axis the
+    v-trace scan runs over; the loss transposes back to time-major
+    internally (a free relayout under XLA)."""
+
     def __init__(self, obs_dim: int, num_actions: int, lr: float,
                  gamma: float, vf_coeff: float, entropy_coeff: float,
-                 seed: int = 0):
+                 seed: int = 0, mesh=None, module=None):
+        from ray_tpu.rllib.rl_module import DiscreteActorCriticModule
+
+        self.module = module or DiscreteActorCriticModule(obs_dim, num_actions)
+        self._gamma = gamma
+        self._vf_coeff = vf_coeff
+        self._entropy_coeff = entropy_coeff
+        super().__init__(lr=lr, mesh=mesh, seed=seed)
+
+    def init_params(self, seed: int):
+        return self.module.init_params(seed)
+
+    def _policy_terms(self, params, batch):
+        """Time-major logp/values/entropy + v-trace targets; unmeshed
+        batches arrive time-major already (no relayout round trip)."""
         import jax
         import jax.numpy as jnp
-        import optax
 
-        self.params = init_policy_params(seed, obs_dim, num_actions)
-        self.optimizer = optax.rmsprop(lr, decay=0.99, eps=0.1)
-        self.opt_state = self.optimizer.init(self.params)
+        keys = ("obs", "actions", "logp", "rewards", "dones")
+        if self.mesh is None:
+            tm = {k: batch[k] for k in keys}
+        else:
+            tm = {k: jnp.moveaxis(batch[k], 0, 1) for k in keys}
+        out = self.module.forward_train(params, {"obs": tm["obs"]})
+        dist = self.module.action_dist(out)
+        logp = dist.logp(tm["actions"])
+        values = out["vf"]
+        vs, pg_adv = vtrace_targets(
+            tm["logp"], jax.lax.stop_gradient(logp), tm["rewards"],
+            jax.lax.stop_gradient(values), batch["last_value"],
+            tm["dones"], self._gamma)
+        return tm, dist, logp, values, vs, pg_adv
 
-        def loss_fn(params, batch):
-            T, N = batch["actions"].shape
-            logits, values = policy_apply(params, batch["obs"])  # [T,N,A],[T,N]
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
-            vs, pg_adv = vtrace_targets(
-                batch["logp"], jax.lax.stop_gradient(logp), batch["rewards"],
-                jax.lax.stop_gradient(values), batch["last_value"],
-                batch["dones"], gamma)
-            pg_loss = -(logp * jax.lax.stop_gradient(pg_adv)).mean()
-            vf_loss = 0.5 * ((values - jax.lax.stop_gradient(vs)) ** 2).mean()
-            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-            total = pg_loss + vf_coeff * vf_loss - entropy_coeff * entropy
-            return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
-                           "entropy": entropy}
-
-        def update(params, opt_state, batch):
-            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, batch)
-            updates, opt_state = self.optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            aux["total_loss"] = loss
-            return params, opt_state, aux
-
-        self._update = jax.jit(update)
-
-    def update_batch(self, batch) -> Dict[str, float]:
+    def update_batch(self, batch_tn: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """Accepts the rollout layout [T, N, ...]; relayouts batch-major
+        ONLY when meshed (the dp shard must split env trajectories)."""
         import jax
+        import jax.numpy as jnp
 
-        self.params, self.opt_state, aux = self._update(
-            self.params, self.opt_state, batch)
+        if self.mesh is None:
+            batch = batch_tn
+        else:
+            batch = {k: (jnp.moveaxis(v, 0, 1) if np.ndim(v) >= 2 else v)
+                     for k, v in batch_tn.items()}
+        aux = self.update(batch)
         return {k: float(v) for k, v in jax.device_get(aux).items()}
 
-    def get_weights(self):
+
+class ImpalaLearner(_VTraceLearner):
+    """Plain v-trace policy gradient (no surrogate clipping) with the
+    paper's RMSProp, on the Learner stack (reference
+    rllib/algorithms/impala via core/learner)."""
+
+    def make_optimizer(self):
+        import optax
+
+        return optax.rmsprop(self._lr, decay=0.99, eps=0.1)
+
+    def loss(self, params, batch, extra, rng):
         import jax
 
-        return {k: np.asarray(v) for k, v in jax.device_get(self.params).items()}
-
-    def set_weights(self, weights):
-        import jax.numpy as jnp
-
-        self.params = {k: jnp.asarray(v) for k, v in weights.items()}
-        self.opt_state = self.optimizer.init(self.params)
+        tm, dist, logp, values, vs, pg_adv = self._policy_terms(params, batch)
+        pg_loss = -(logp * jax.lax.stop_gradient(pg_adv)).mean()
+        vf_loss = 0.5 * ((values - jax.lax.stop_gradient(vs)) ** 2).mean()
+        entropy = dist.entropy().mean()
+        total = (pg_loss + self._vf_coeff * vf_loss
+                 - self._entropy_coeff * entropy)
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
 
 
 class ImpalaConfig:

@@ -17,8 +17,12 @@ TPU-first instead of DDP-first:
   of the reference's gloo/NCCL learner workers for envs without a mesh.
 
 Subclass contract: implement `init_params(seed)` and
-`loss(params, batch, extra) -> (loss, aux_metrics_dict)`; optionally
-maintain `extra` state (e.g. a DQN target network) passed through jit.
+`loss(params, batch, extra, rng) -> (loss, aux_metrics_dict)` (`rng` is a
+fresh PRNG key per update for stochastic losses); optionally maintain
+`extra` state (e.g. a target network) via `make_extra()` and the jitted
+`post_update(params, extra)` hook (polyak syncs), and override
+`make_optimizer()` for per-submodule optimizers (`optax.multi_transform`,
+`delayed` for TD3-style update periods).
 """
 
 from __future__ import annotations
@@ -29,7 +33,35 @@ import numpy as np
 
 import ray_tpu
 
-__all__ = ["Learner", "LearnerGroup"]
+__all__ = ["Learner", "LearnerGroup", "delayed"]
+
+
+def delayed(tx, period: int):
+    """Wrap an optax transform so it applies only every `period`-th step,
+    with its inner state FROZEN on skipped steps (true delayed updates —
+    zeroing gradients instead would still decay Adam's moments). This is how
+    TD3's delayed actor rides a single jitted update: compose under
+    `optax.multi_transform({"actor": delayed(adam, d), ...})`."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def init(params):
+        return (tx.init(params), jnp.zeros((), jnp.int32))
+
+    def update(grads, state, params=None):
+        inner, count = state
+
+        def run(_):
+            return tx.update(grads, inner, params)
+
+        def skip(_):
+            return jax.tree_util.tree_map(jnp.zeros_like, grads), inner
+
+        updates, inner2 = jax.lax.cond(count % period == 0, run, skip, None)
+        return updates, (inner2, count + 1)
+
+    return optax.GradientTransformation(init, update)
 
 
 class Learner:
@@ -39,56 +71,82 @@ class Learner:
         import optax
 
         self.mesh = mesh
-        self.optimizer = optimizer if optimizer is not None else optax.adam(lr)
+        self._lr = lr
+        self.optimizer = (optimizer if optimizer is not None
+                          else self.make_optimizer())
         self.params = self.init_params(seed)
         self.opt_state = self.optimizer.init(self.params)
+        self._rng_key = jax.random.PRNGKey(seed)
         self._build(jax, optax)
 
     # ------------------------------------------------------ subclass hooks
     def init_params(self, seed: int):
         raise NotImplementedError
 
-    def loss(self, params, batch, extra):
-        """Return (scalar_loss, aux_metrics_dict)."""
+    def loss(self, params, batch, extra, rng):
+        """Return (scalar_loss, aux_metrics_dict). `rng` is a fresh PRNG key
+        per update (stochastic losses: target smoothing, reparameterized
+        sampling); deterministic losses just ignore it."""
         raise NotImplementedError
+
+    def make_optimizer(self):
+        """Optax transform for the whole params pytree. Override for
+        per-submodule optimizers via `optax.multi_transform` (the moral
+        equivalent of the reference's configure_optimizers_for_module,
+        learner.py:253) — see `delayed()` for TD3-style update periods."""
+        import optax
+
+        return optax.adam(self._lr)
 
     def make_extra(self):
         """Extra (non-optimized) pytree threaded through the update, e.g. a
         target network. None by default."""
         return None
 
+    def post_update(self, params, extra):
+        """Jitted hook after the optimizer step: return the next `extra`
+        (e.g. polyak target sync — the reference's
+        additional_update_for_module). Default: unchanged."""
+        return extra
+
     # ------------------------------------------------------------- compile
     def _build(self, jax, optax) -> None:
-        def grad_fn(params, extra, batch):
+        def grad_fn(params, extra, rng, batch):
             (l, aux), grads = jax.value_and_grad(
-                self.loss, has_aux=True)(params, batch, extra)
+                self.loss, has_aux=True)(params, batch, extra, rng)
             aux = dict(aux)
             aux["total_loss"] = l
             return grads, aux
 
-        def update_fn(params, opt_state, extra, batch):
-            grads, aux = grad_fn(params, extra, batch)
+        def update_fn(params, opt_state, extra, rng, batch):
+            grads, aux = grad_fn(params, extra, rng, batch)
             updates, opt_state = self.optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, opt_state, aux
+            extra = self.post_update(params, extra)
+            return params, opt_state, extra, aux
 
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             repl = NamedSharding(self.mesh, P())
-            batch_sh = NamedSharding(self.mesh, P("dp"))
+            batch_sh = NamedSharding(self.mesh, P(self.batch_shard_axis))
             self._update_fn = jax.jit(
                 update_fn,
-                in_shardings=(repl, repl, repl, batch_sh),
-                out_shardings=(repl, repl, repl))
+                in_shardings=(repl, repl, repl, repl, batch_sh),
+                out_shardings=(repl, repl, repl, repl))
             self._grad_fn = jax.jit(
                 grad_fn,
-                in_shardings=(repl, repl, batch_sh),
+                in_shardings=(repl, repl, repl, batch_sh),
                 out_shardings=(repl, repl))
         else:
             self._update_fn = jax.jit(update_fn)
             self._grad_fn = jax.jit(grad_fn)
         self.extra = self.make_extra()
+
+    # sharded batch layout: leading axis splits over this mesh axis —
+    # sample-major losses use "dp" on axis 0; sequence losses (vtrace)
+    # store batches batch-major [N, T] so dp still splits SAMPLES
+    batch_shard_axis = "dp"
 
     def _fit_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """Meshed updates need the leading dim divisible by dp: trim the
@@ -110,18 +168,25 @@ class Learner:
             return {k: v[idx] for k, v in batch.items()}
         return {k: v[:n - r] for k, v in batch.items()}
 
+    def _next_rng(self):
+        import jax
+
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return sub
+
     # -------------------------------------------------------------- update
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
         """One optimizer step on `batch` (sharded over dp when meshed);
         returns aux metrics (reference Learner.update:773)."""
         batch = self._fit_batch(batch)
-        self.params, self.opt_state, aux = self._update_fn(
-            self.params, self.opt_state, self.extra, batch)
+        self.params, self.opt_state, self.extra, aux = self._update_fn(
+            self.params, self.opt_state, self.extra, self._next_rng(), batch)
         return aux
 
     def compute_gradients(self, batch: Dict[str, np.ndarray]):
         """(grads, aux) without applying (reference compute_gradients:409)."""
-        return self._grad_fn(self.params, self.extra, self._fit_batch(batch))
+        return self._grad_fn(self.params, self.extra, self._next_rng(),
+                             self._fit_batch(batch))
 
     def apply_gradients(self, grads) -> None:
         import optax

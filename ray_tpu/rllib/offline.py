@@ -26,7 +26,7 @@ import numpy as np
 from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.env import CartPoleEnv
 from ray_tpu.rllib.models import init_mlp, mlp_forward
-from ray_tpu.rllib.ppo import init_policy_params, policy_apply
+from ray_tpu.rllib.learner import Learner
 
 
 # ------------------------------------------------------------- data layer
@@ -102,6 +102,159 @@ def discounted_returns_to_go(rewards: np.ndarray, dones: np.ndarray,
     return out
 
 
+class MARWILLearner(Learner):
+    """Advantage-weighted BC on the Learner stack (reference marwil.py via
+    core/learner); beta=0 reduces to plain BC. The policy is a swappable
+    RLModule."""
+
+    def __init__(self, obs_dim: int, num_actions: int, lr: float,
+                 beta: float, vf_coeff: float, seed: int = 0, mesh=None,
+                 module=None):
+        from ray_tpu.rllib.rl_module import DiscreteActorCriticModule
+
+        self.module = module or DiscreteActorCriticModule(obs_dim, num_actions)
+        self._beta = beta
+        self._vf_coeff = vf_coeff
+        super().__init__(lr=lr, mesh=mesh, seed=seed)
+
+    def init_params(self, seed: int):
+        return self.module.init_params(seed)
+
+    def loss(self, params, batch, extra, rng):
+        import jax
+        import jax.numpy as jnp
+
+        out = self.module.forward_train(params, batch)
+        dist = self.module.action_dist(out)
+        logp = dist.logp(batch["actions"])
+        value = out["vf"]
+        adv = batch["mc_returns"] - jax.lax.stop_gradient(value)
+        # normalize advantage scale (moving-average-free variant of the
+        # reference's `update_averaged_advantage_norm`)
+        adv_norm = adv / (jnp.sqrt(jnp.mean(adv ** 2)) + 1e-8)
+        weight = jnp.where(self._beta > 0.0,
+                           jnp.exp(self._beta * jnp.clip(adv_norm, -10, 10)),
+                           jnp.ones_like(adv_norm))
+        bc = -(jax.lax.stop_gradient(weight) * logp).mean()
+        vf = ((value - batch["mc_returns"]) ** 2).mean()
+        total = bc + self._vf_coeff * vf
+        return total, {"bc_loss": bc, "vf_loss": vf}
+
+
+class CQLLearner(Learner):
+    """Discrete conservative Q-learning on the Learner stack: double-DQN TD
+    target + alpha * (logsumexp_a Q - Q(s, a_logged)); the target net rides
+    `extra` like DQN's."""
+
+    def __init__(self, obs_dim: int, num_actions: int, lr: float,
+                 gamma: float, cql_alpha: float, seed: int = 0, mesh=None,
+                 module=None):
+        from ray_tpu.rllib.rl_module import QModule
+
+        self.module = module or QModule(obs_dim, num_actions)
+        self._gamma = gamma
+        self._alpha = cql_alpha
+        super().__init__(lr=lr, mesh=mesh, seed=seed)
+
+    def init_params(self, seed: int):
+        return self.module.init_params(seed)
+
+    def make_extra(self):
+        return self.params  # immutable pytrees: target aliases online
+
+    def sync_target(self) -> None:
+        self.extra = self.params
+
+    def set_weights(self, weights):
+        super().set_weights(weights)
+        self.sync_target()  # a restored net must not TD against a stale target
+
+    def loss(self, params, batch, extra, rng):
+        import jax
+        import jax.numpy as jnp
+
+        out = self.module.forward_train(params, batch)
+        q, next_online = out["q"], out["q_next"]
+        acts = batch["actions"][:, None].astype(jnp.int32)
+        q_taken = jnp.take_along_axis(q, acts, axis=-1)[:, 0]
+        next_a = jnp.argmax(next_online, axis=-1)
+        next_target = self.module.forward_train(extra, batch)["q_next"]
+        next_q = jnp.take_along_axis(next_target, next_a[:, None], axis=-1)[:, 0]
+        backup = jax.lax.stop_gradient(
+            batch["rewards"] + self._gamma * (1 - batch["dones"]) * next_q)
+        td = ((q_taken - backup) ** 2).mean()
+        conservative = (jax.scipy.special.logsumexp(q, axis=-1)
+                        - q_taken).mean()
+        total = td + self._alpha * conservative
+        return total, {"td_loss": td, "cql_penalty": conservative}
+
+
+class CRRLearner(Learner):
+    """Critic-Regularized Regression on the Learner stack: expected-SARSA
+    critic + advantage-weighted BC policy in one combined loss; target Q in
+    `extra` with periodic hard sync."""
+
+    def __init__(self, obs_dim: int, num_actions: int, lr: float,
+                 gamma: float, beta: float, weight_type: str,
+                 seed: int = 0, mesh=None):
+        self._obs_dim = obs_dim
+        self._num_actions = num_actions
+        self._gamma = gamma
+        self._beta = beta
+        self._wtype = weight_type
+        super().__init__(lr=lr, mesh=mesh, seed=seed)
+
+    def init_params(self, seed: int):
+        rng = np.random.default_rng(seed)
+        hidden = (64, 64)
+        return {
+            "pi": init_mlp(rng, (self._obs_dim, *hidden, self._num_actions),
+                           final_scale=0.01),
+            "q": init_mlp(rng, (self._obs_dim, *hidden, self._num_actions),
+                          final_scale=np.sqrt(2.0 / hidden[-1])),
+        }
+
+    def make_extra(self):
+        return self.params["q"]
+
+    def sync_target(self) -> None:
+        self.extra = self.params["q"]
+
+    def set_weights(self, weights):
+        super().set_weights(weights)
+        self.sync_target()  # a restored net must not TD against a stale target
+
+    def loss(self, params, batch, extra, rng):
+        import jax
+        import jax.numpy as jnp
+
+        target_q = extra
+        acts = batch["actions"][:, None].astype(jnp.int32)
+        q = mlp_forward(params["q"], batch["obs"], 3)
+        q_taken = jnp.take_along_axis(q, acts, axis=-1)[:, 0]
+        # expected-SARSA backup under the current policy
+        next_logits = mlp_forward(params["pi"], batch["next_obs"], 3)
+        next_pi = jax.nn.softmax(jax.lax.stop_gradient(next_logits))
+        next_q = mlp_forward(target_q, batch["next_obs"], 3)
+        backup = jax.lax.stop_gradient(
+            batch["rewards"] + self._gamma * (1 - batch["dones"])
+            * (next_pi * next_q).sum(-1))
+        td = ((q_taken - backup) ** 2).mean()
+
+        logits = mlp_forward(params["pi"], batch["obs"], 3)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, acts, axis=-1)[:, 0]
+        pi = jax.nn.softmax(jax.lax.stop_gradient(logits))
+        adv = jax.lax.stop_gradient(
+            q_taken - (pi * jax.lax.stop_gradient(q)).sum(-1))
+        weight = (jnp.where(adv > 0, 1.0, 0.0) if self._wtype == "binary"
+                  else jnp.minimum(jnp.exp(adv / self._beta), 20.0))
+        bc = -(weight * logp).mean()
+        total = td + bc
+        return total, {"td_loss": td, "crr_bc_loss": bc,
+                       "mean_weight": weight.mean()}
+
+
 class _OfflineBase(Algorithm):
     """Shared setup: dataset + minibatch iterator."""
 
@@ -132,15 +285,10 @@ class _OfflineBase(Algorithm):
             yield {k: v[sel] for k, v in self.dataset.items()}
 
     def get_weights(self):
-        import jax
-
-        return jax.tree.map(np.asarray, jax.device_get(self.params))
+        return self.learner.get_weights()
 
     def set_weights(self, weights) -> None:
-        import jax
-        import jax.numpy as jnp
-
-        self.params = jax.tree.map(jnp.asarray, weights)
+        self.learner.set_weights(weights)
 
 
 class BCConfig:
@@ -189,42 +337,10 @@ class MARWIL(_OfflineBase):
         return MARWILConfig()
 
     def _build_learner(self) -> None:
-        import jax
-        import jax.numpy as jnp
-        import optax
-
         cfg = self.cfg
-        self.params = init_policy_params(cfg.seed, cfg.obs_dim, cfg.num_actions)
-        self.optimizer = optax.adam(cfg.lr)
-        self.opt_state = self.optimizer.init(self.params)
-        beta, vf_coeff = cfg.beta, cfg.vf_coeff
-
-        def loss_fn(params, batch):
-            logits, value = policy_apply(params, batch["obs"])
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, batch["actions"][:, None], axis=-1)[:, 0]
-            adv = batch["mc_returns"] - jax.lax.stop_gradient(value)
-            # normalize advantage scale (moving-average-free variant of the
-            # reference's `update_averaged_advantage_norm`)
-            adv_norm = adv / (jnp.sqrt(jnp.mean(adv ** 2)) + 1e-8)
-            weight = jnp.where(beta > 0.0,
-                               jnp.exp(beta * jnp.clip(adv_norm, -10, 10)),
-                               jnp.ones_like(adv_norm))
-            bc = -(jax.lax.stop_gradient(weight) * logp).mean()
-            vf = ((value - batch["mc_returns"]) ** 2).mean()
-            total = bc + vf_coeff * vf
-            return total, {"bc_loss": bc, "vf_loss": vf}
-
-        def update(params, opt_state, batch):
-            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, batch)
-            updates, opt_state = self.optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            aux["total_loss"] = loss
-            return params, opt_state, aux
-
-        self._update = jax.jit(update)
+        self.learner = MARWILLearner(
+            cfg.obs_dim, cfg.num_actions, cfg.lr, cfg.beta, cfg.vf_coeff,
+            seed=cfg.seed)
 
     def training_step(self) -> Dict[str, Any]:
         import jax
@@ -232,8 +348,7 @@ class MARWIL(_OfflineBase):
         aux = {}
         n = 0
         for mb in self._minibatches():
-            self.params, self.opt_state, aux = self._update(
-                self.params, self.opt_state, mb)
+            aux = self.learner.update(mb)
             n += len(mb["obs"])
         out = {k: float(v) for k, v in jax.device_get(aux).items()}
         out["num_samples_trained"] = n
@@ -241,11 +356,9 @@ class MARWIL(_OfflineBase):
 
     def compute_actions(self, obs: np.ndarray) -> np.ndarray:
         """Greedy policy eval for offline-trained policies."""
-        import jax
-
-        logits, _ = policy_apply(
-            jax.tree.map(np.asarray, jax.device_get(self.params)), obs)
-        return np.asarray(logits).argmax(-1)
+        fwd = self.learner.module.forward_inference(
+            self.learner.get_weights(), np.asarray(obs, np.float32))
+        return self.learner.module.action_dist(fwd).argmax()
 
 
 class BC(MARWIL):
@@ -293,46 +406,9 @@ class CQL(_OfflineBase):
         return CQLConfig()
 
     def _build_learner(self) -> None:
-        import jax
-        import jax.numpy as jnp
-        import optax
-
         cfg = self.cfg
-        rng = np.random.default_rng(cfg.seed)
-        hidden = (64, 64)
-        self.params = init_mlp(rng, (cfg.obs_dim, *hidden, cfg.num_actions),
-                               final_scale=np.sqrt(2.0 / hidden[-1]))
-        self.target_params = {k: v.copy() for k, v in self.params.items()}
-        self.optimizer = optax.adam(cfg.lr)
-        self.opt_state = self.optimizer.init(self.params)
-        gamma, alpha = cfg.gamma, cfg.cql_alpha
-
-        def loss_fn(params, target_params, batch):
-            q = mlp_forward(params, batch["obs"], 3)
-            q_taken = jnp.take_along_axis(
-                q, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
-            next_online = mlp_forward(params, batch["next_obs"], 3)
-            next_a = jnp.argmax(next_online, axis=-1)
-            next_target = mlp_forward(target_params, batch["next_obs"], 3)
-            next_q = jnp.take_along_axis(
-                next_target, next_a[:, None], axis=-1)[:, 0]
-            backup = jax.lax.stop_gradient(
-                batch["rewards"] + gamma * (1 - batch["dones"]) * next_q)
-            td = ((q_taken - backup) ** 2).mean()
-            conservative = (jax.scipy.special.logsumexp(q, axis=-1)
-                            - q_taken).mean()
-            total = td + alpha * conservative
-            return total, {"td_loss": td, "cql_penalty": conservative}
-
-        def update(params, opt_state, target_params, batch):
-            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, target_params, batch)
-            updates, opt_state = self.optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            aux["total_loss"] = loss
-            return params, opt_state, aux
-
-        self._update = jax.jit(update)
+        self.learner = CQLLearner(cfg.obs_dim, cfg.num_actions, cfg.lr,
+                                  cfg.gamma, cfg.cql_alpha, seed=cfg.seed)
         self._step_count = 0
 
     def training_step(self) -> Dict[str, Any]:
@@ -341,37 +417,19 @@ class CQL(_OfflineBase):
         aux = {}
         n = 0
         for mb in self._minibatches():
-            self.params, self.opt_state, aux = self._update(
-                self.params, self.opt_state, self.target_params, mb)
+            aux = self.learner.update(mb)
             self._step_count += 1
             if self._step_count % self.cfg.target_update_freq == 0:
-                self.target_params = jax.tree.map(
-                    lambda v: v.copy(), self.params)
+                self.learner.sync_target()
             n += len(mb["obs"])
         out = {k: float(v) for k, v in jax.device_get(aux).items()}
         out["num_samples_trained"] = n
         return out
 
     def compute_actions(self, obs: np.ndarray) -> np.ndarray:
-        import jax
-
-        p = jax.tree.map(np.asarray, jax.device_get(self.params))
-        q = mlp_forward(p, obs, 3)
-        return np.asarray(q).argmax(-1)
-
-    def get_weights(self):
-        import jax
-
-        return {"params": jax.tree.map(np.asarray, jax.device_get(self.params)),
-                "target": jax.tree.map(np.asarray,
-                                       jax.device_get(self.target_params))}
-
-    def set_weights(self, weights) -> None:
-        import jax
-        import jax.numpy as jnp
-
-        self.params = jax.tree.map(jnp.asarray, weights["params"])
-        self.target_params = jax.tree.map(jnp.asarray, weights["target"])
+        fwd = self.learner.module.forward_inference(
+            self.learner.get_weights(), np.asarray(obs, np.float32))
+        return self.learner.module.action_dist(fwd).argmax()
 
 
 class CRRConfig:
@@ -414,60 +472,10 @@ class CRR(_OfflineBase):
         return CRRConfig()
 
     def _build_learner(self) -> None:
-        import jax
-        import jax.numpy as jnp
-        import optax
-
         cfg = self.cfg
-        rng = np.random.default_rng(cfg.seed)
-        hidden = (64, 64)
-        self.params = {
-            "pi": init_mlp(rng, (cfg.obs_dim, *hidden, cfg.num_actions),
-                           final_scale=0.01),
-            "q": init_mlp(rng, (cfg.obs_dim, *hidden, cfg.num_actions),
-                          final_scale=np.sqrt(2.0 / hidden[-1])),
-        }
-        self.target_q = jax.tree.map(np.copy, self.params["q"])
-        self.optimizer = optax.adam(cfg.lr)
-        self.opt_state = self.optimizer.init(self.params)
-        gamma, beta, wtype = cfg.gamma, cfg.beta, cfg.weight_type
-
-        def loss_fn(params, target_q, batch):
-            acts = batch["actions"][:, None].astype(jnp.int32)
-            q = mlp_forward(params["q"], batch["obs"], 3)
-            q_taken = jnp.take_along_axis(q, acts, axis=-1)[:, 0]
-            # expected-SARSA backup under the current policy
-            next_logits = mlp_forward(params["pi"], batch["next_obs"], 3)
-            next_pi = jax.nn.softmax(jax.lax.stop_gradient(next_logits))
-            next_q = mlp_forward(target_q, batch["next_obs"], 3)
-            backup = jax.lax.stop_gradient(
-                batch["rewards"] + gamma * (1 - batch["dones"])
-                * (next_pi * next_q).sum(-1))
-            td = ((q_taken - backup) ** 2).mean()
-
-            logits = mlp_forward(params["pi"], batch["obs"], 3)
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(logp_all, acts, axis=-1)[:, 0]
-            pi = jax.nn.softmax(jax.lax.stop_gradient(logits))
-            adv = jax.lax.stop_gradient(
-                q_taken - (pi * jax.lax.stop_gradient(q)).sum(-1))
-            weight = (jnp.where(adv > 0, 1.0, 0.0) if wtype == "binary"
-                      else jnp.minimum(jnp.exp(adv / beta), 20.0))
-            bc = -(weight * logp).mean()
-            total = td + bc
-            return total, {"td_loss": td, "crr_bc_loss": bc,
-                           "mean_weight": weight.mean()}
-
-        def update(params, opt_state, target_q, batch):
-            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, target_q, batch)
-            updates, opt_state = self.optimizer.update(
-                grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            aux["total_loss"] = loss
-            return params, opt_state, aux
-
-        self._update = jax.jit(update)
+        self.learner = CRRLearner(cfg.obs_dim, cfg.num_actions, cfg.lr,
+                                  cfg.gamma, cfg.beta, cfg.weight_type,
+                                  seed=cfg.seed)
         self._step_count = 0
 
     def training_step(self) -> Dict[str, Any]:
@@ -476,34 +484,16 @@ class CRR(_OfflineBase):
         aux = {}
         n = 0
         for mb in self._minibatches():
-            self.params, self.opt_state, aux = self._update(
-                self.params, self.opt_state, self.target_q, mb)
+            aux = self.learner.update(mb)
             self._step_count += 1
             if self._step_count % self.cfg.target_update_freq == 0:
-                self.target_q = jax.tree.map(
-                    lambda v: v.copy(), self.params["q"])
+                self.learner.sync_target()
             n += len(mb["obs"])
         out = {k: float(v) for k, v in jax.device_get(aux).items()}
         out["num_samples_trained"] = n
         return out
 
     def compute_actions(self, obs: np.ndarray) -> np.ndarray:
-        import jax
-
-        p = jax.tree.map(np.asarray, jax.device_get(self.params["pi"]))
-        return np.asarray(mlp_forward(p, obs, 3)).argmax(-1)
-
-    def get_weights(self):
-        import jax
-
-        return {"params": jax.tree.map(np.asarray,
-                                       jax.device_get(self.params)),
-                "target_q": jax.tree.map(np.asarray,
-                                         jax.device_get(self.target_q))}
-
-    def set_weights(self, weights) -> None:
-        import jax
-        import jax.numpy as jnp
-
-        self.params = jax.tree.map(jnp.asarray, weights["params"])
-        self.target_q = jax.tree.map(jnp.asarray, weights["target_q"])
+        p = self.learner.get_weights()["pi"]
+        return np.asarray(mlp_forward(p, np.asarray(obs, np.float32),
+                                      3)).argmax(-1)

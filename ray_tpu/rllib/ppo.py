@@ -53,16 +53,33 @@ def policy_apply(params, obs, n_hidden: int = 2):
 
 @ray_tpu.remote
 class RolloutWorker:
-    """Env-stepping actor (reference rollout_worker.py:166; `sample:879`)."""
+    """Env-stepping actor (reference rollout_worker.py:166; `sample:879`).
+
+    Acting is MODULE + CONNECTORS (reference EnvRunner + connector
+    pipelines): the worker owns an RLModule and two pipelines —
+    env_to_module preprocesses observations, module_to_env turns forward
+    outputs into env actions. Exploration/postprocessing changes are
+    pipeline edits, not worker forks."""
 
     def __init__(self, env_maker, num_envs: int, seed: int,
-                 obs_dim: int, num_actions: int):
+                 obs_dim: int, num_actions: int,
+                 module=None, env_to_module=None, module_to_env=None):
+        from ray_tpu.rllib.connectors import (CastObsFloat32,
+                                              ConnectorPipeline, SampleAction)
+        from ray_tpu.rllib.rl_module import DiscreteActorCriticModule
+
         self.vec = VectorEnv(env_maker, num_envs, seed)
         self.obs = self.vec.reset()
         self.rng = np.random.default_rng(seed)
         self.params: Optional[dict] = None
         self.obs_dim = obs_dim
         self.num_actions = num_actions
+        self.module = module or DiscreteActorCriticModule(obs_dim, num_actions)
+        self.env_to_module = env_to_module or ConnectorPipeline(
+            [CastObsFloat32()])
+        self.module_to_env = module_to_env or ConnectorPipeline(
+            [SampleAction()])
+        self._timestep = 0
         # per-env running episode returns for metrics
         self._ep_returns = np.zeros(num_envs, np.float32)
         self._completed: List[float] = []
@@ -70,6 +87,14 @@ class RolloutWorker:
     def set_weights(self, params: dict) -> bool:
         self.params = {k: np.asarray(v) for k, v in params.items()}
         return True
+
+    def _act(self) -> Dict[str, Any]:
+        data = {"obs": self.obs, "rng": self.rng, "module": self.module,
+                "params": self.params, "timestep": self._timestep}
+        data = self.env_to_module(data)
+        data["fwd_out"] = self.module.forward_inference(self.params,
+                                                        data["obs"])
+        return self.module_to_env(data)
 
     def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
         """Collect num_steps transitions per env; returns flat arrays plus
@@ -83,18 +108,13 @@ class RolloutWorker:
         rew_buf = np.zeros((T, N), np.float32)
         done_buf = np.zeros((T, N), np.float32)
         for t in range(T):
-            logits, value = policy_apply(self.params, self.obs)
-            logits = np.asarray(logits)
-            value = np.asarray(value)
-            z = logits - logits.max(-1, keepdims=True)
-            probs = np.exp(z) / np.exp(z).sum(-1, keepdims=True)
-            actions = np.array([self.rng.choice(self.num_actions, p=p) for p in probs])
-            logp = np.log(probs[np.arange(N), actions] + 1e-10)
-            obs_buf[t] = self.obs
-            act_buf[t] = actions
-            logp_buf[t] = logp
-            val_buf[t] = value
-            self.obs, rewards, dones, _ = self.vec.step(actions)
+            data = self._act()
+            obs_buf[t] = data["obs"]
+            act_buf[t] = data["actions"]
+            logp_buf[t] = data.get("logp", 0.0)
+            val_buf[t] = np.asarray(data["fwd_out"]["vf"], np.float32)
+            self.obs, rewards, dones, _ = self.vec.step(data["actions"])
+            self._timestep += N
             rew_buf[t] = rewards
             done_buf[t] = dones
             self._ep_returns += rewards
@@ -102,7 +122,8 @@ class RolloutWorker:
                 if d:
                     self._completed.append(float(self._ep_returns[i]))
                     self._ep_returns[i] = 0.0
-        _, last_value = policy_apply(self.params, self.obs)
+        last_value = self.module.forward_inference(
+            self.params, np.asarray(self.obs, np.float32))["vf"]
         episode_returns, self._completed = self._completed, []
         return {
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
@@ -134,37 +155,39 @@ def compute_gae(batch: Dict[str, np.ndarray], gamma: float, lam: float):
 
 class PPOLearner(Learner):
     """Jitted clipped-surrogate update on the Learner stack (reference
-    core/learner/learner.py); pass `mesh=` to shard minibatches over the dp
-    axis with XLA-inserted gradient all-reduce (LearnerGroup mesh backend)."""
+    core/learner/learner.py); the network is a swappable RLModule
+    (reference PPOTorchRLModule). Pass `mesh=` to shard minibatches over
+    the dp axis with XLA-inserted gradient all-reduce (LearnerGroup mesh
+    backend)."""
 
     def __init__(self, obs_dim: int, num_actions: int, lr: float,
                  clip: float = 0.2, vf_coeff: float = 0.5,
-                 entropy_coeff: float = 0.01, seed: int = 0, mesh=None):
-        self._obs_dim = obs_dim
-        self._num_actions = num_actions
+                 entropy_coeff: float = 0.01, seed: int = 0, mesh=None,
+                 module=None):
+        from ray_tpu.rllib.rl_module import DiscreteActorCriticModule
+
+        self.module = module or DiscreteActorCriticModule(obs_dim, num_actions)
         self._clip = clip
         self._vf_coeff = vf_coeff
         self._entropy_coeff = entropy_coeff
         super().__init__(lr=lr, mesh=mesh, seed=seed)
 
     def init_params(self, seed: int):
-        return init_policy_params(seed, self._obs_dim, self._num_actions)
+        return self.module.init_params(seed)
 
-    def loss(self, params, batch, extra):
-        import jax
+    def loss(self, params, batch, extra, rng):
         import jax.numpy as jnp
 
-        logits, value = policy_apply(params, batch["obs"])
-        logp_all = jax.nn.log_softmax(logits)
-        logp = jnp.take_along_axis(
-            logp_all, batch["actions"][:, None], axis=-1)[:, 0]
+        out = self.module.forward_train(params, batch)
+        dist = self.module.action_dist(out)
+        logp = dist.logp(batch["actions"])
         ratio = jnp.exp(logp - batch["logp"])
         adv = batch["advantages"]
         pg = -jnp.minimum(
             ratio * adv,
             jnp.clip(ratio, 1 - self._clip, 1 + self._clip) * adv).mean()
-        vf = 0.5 * ((value - batch["returns"]) ** 2).mean()
-        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        vf = 0.5 * ((out["vf"] - batch["returns"]) ** 2).mean()
+        entropy = dist.entropy().mean()
         total = pg + self._vf_coeff * vf - self._entropy_coeff * entropy
         return total, {"policy_loss": pg, "vf_loss": vf, "entropy": entropy}
 

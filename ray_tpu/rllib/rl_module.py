@@ -1,0 +1,166 @@
+"""RLModule: the swappable params + forward-functions unit of RLlib.
+
+Mirrors the reference's `rllib/core/rl_module/rl_module.py`: an algorithm's
+neural network is a MODULE — parameter initialization, the train/inference
+forward passes, and the action distribution — separable from the update rule
+(Learner) and from env plumbing (connectors). Swapping the architecture
+means swapping the module; the learner's loss and the rollout loop don't
+change.
+
+TPU-first shape: modules are PURE-FUNCTION bundles over pytrees (init ->
+params pytree; forwards are `f(params, obs)` usable under jit/grad/vmap AND
+under plain numpy for CPU env-stepping actors), not stateful nn.Module
+objects — the same functional seam `jax.jit` needs anyway.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.models import init_mlp, mlp_forward, mlp_hidden
+
+__all__ = [
+    "Categorical", "RLModule", "DiscreteActorCriticModule", "QModule",
+]
+
+
+# ------------------------------------------------------------ distributions
+
+
+def _xp(arr):
+    """numpy for numpy inputs, jax.numpy for traced/jax inputs — modules
+    and distributions run in BOTH worlds (CPU rollout actors / jitted
+    losses)."""
+    if isinstance(arr, np.ndarray):
+        return np
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class Categorical:
+    """Action distribution over logits (reference TorchCategorical,
+    rllib/models/distributions.py) — numpy-or-jax depending on input."""
+
+    def __init__(self, logits):
+        self.logits = logits
+
+    def _log_probs(self):
+        xp = _xp(self.logits)
+        z = self.logits - self.logits.max(-1, keepdims=True)
+        return z - xp.log(xp.exp(z).sum(-1, keepdims=True))
+
+    def sample(self, rng: np.random.Generator) -> np.ndarray:
+        """Host-side sampling for rollout actors (gumbel trick: one vector
+        op per step instead of a per-env np.choice loop)."""
+        logp = np.asarray(self._log_probs())
+        g = rng.gumbel(size=logp.shape)
+        return (logp + g).argmax(-1).astype(np.int32)
+
+    def logp(self, actions):
+        logp_all = self._log_probs()
+        xp = _xp(logp_all)
+        return xp.take_along_axis(
+            logp_all, xp.asarray(actions)[..., None], axis=-1)[..., 0]
+
+    def entropy(self):
+        logp_all = self._log_probs()
+        xp = _xp(logp_all)
+        return -(xp.exp(logp_all) * logp_all).sum(-1)
+
+    def argmax(self) -> np.ndarray:
+        return np.asarray(self.logits).argmax(-1).astype(np.int32)
+
+
+# ----------------------------------------------------------------- modules
+
+
+class RLModule:
+    """Base module contract (reference rl_module.py: `_forward_inference`,
+    `_forward_train`, `get_initial_state`)."""
+
+    def init_params(self, seed: int):
+        raise NotImplementedError
+
+    def forward_inference(self, params, obs) -> Dict[str, Any]:
+        """Outputs needed to ACT (runs in rollout workers; must accept
+        numpy params + obs and stay numpy)."""
+        raise NotImplementedError
+
+    def forward_train(self, params, batch) -> Dict[str, Any]:
+        """Outputs needed by the learner's loss (jax, under jit/grad)."""
+        raise NotImplementedError
+
+    def action_dist(self, fwd_out: Dict[str, Any]):
+        """Distribution over actions from forward outputs."""
+        raise NotImplementedError
+
+
+class DiscreteActorCriticModule(RLModule):
+    """Two-head MLP: categorical policy + value baseline — the module under
+    PPO / A2C / APPO / IMPALA / MARWIL (reference PPOTorchRLModule)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Tuple[int, ...] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init_params(self, seed: int) -> Dict[str, Any]:
+        rng = np.random.default_rng(seed)
+        params = init_mlp(rng, (self.obs_dim, *self.hidden))
+        h = self.hidden[-1]
+        params["w_pi"] = (rng.standard_normal((h, self.num_actions))
+                          * 0.01).astype(np.float32)
+        params["b_pi"] = np.zeros(self.num_actions, np.float32)
+        params["w_v"] = rng.standard_normal((h, 1)).astype(np.float32)
+        params["b_v"] = np.zeros(1, np.float32)
+        return params
+
+    def _apply(self, params, obs):
+        x = mlp_hidden(params, obs, len(self.hidden))
+        logits = x @ params["w_pi"] + params["b_pi"]
+        value = (x @ params["w_v"] + params["b_v"])[..., 0]
+        return logits, value
+
+    def forward_inference(self, params, obs) -> Dict[str, Any]:
+        logits, value = self._apply(params, obs)
+        return {"action_dist_inputs": logits, "vf": value}
+
+    def forward_train(self, params, batch) -> Dict[str, Any]:
+        logits, value = self._apply(params, batch["obs"])
+        return {"action_dist_inputs": logits, "vf": value}
+
+    def action_dist(self, fwd_out) -> Categorical:
+        return Categorical(fwd_out["action_dist_inputs"])
+
+
+class QModule(RLModule):
+    """Q-value MLP — the module under DQN / CQL (greedy/eps-greedy action
+    selection lives in connectors, not here)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Tuple[int, ...] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init_params(self, seed: int) -> Dict[str, Any]:
+        rng = np.random.default_rng(seed)
+        return init_mlp(rng, (self.obs_dim, *self.hidden, self.num_actions),
+                        final_scale=np.sqrt(2.0 / self.hidden[-1]))
+
+    def _apply(self, params, obs):
+        return mlp_forward(params, obs, len(self.hidden) + 1)
+
+    def forward_inference(self, params, obs) -> Dict[str, Any]:
+        return {"action_dist_inputs": self._apply(params, obs)}
+
+    def forward_train(self, params, batch) -> Dict[str, Any]:
+        return {"q": self._apply(params, batch["obs"]),
+                "q_next": self._apply(params, batch["next_obs"])}
+
+    def action_dist(self, fwd_out) -> Categorical:
+        return Categorical(fwd_out["action_dist_inputs"])

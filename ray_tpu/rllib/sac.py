@@ -17,6 +17,7 @@ import ray_tpu
 from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.env import ContinuousVectorEnv, PendulumEnv
 from ray_tpu.rllib.models import init_mlp, mlp_forward, mlp_forward_np
+from ray_tpu.rllib.learner import Learner
 from ray_tpu.rllib.replay_buffers import ReplayBuffer
 
 LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
@@ -130,111 +131,89 @@ class ContinuousSampleWorker(ContinuousWorkerBase):
         return np.tanh(pre) * self.max_action
 
 
-class SACLearner:
-    """Jitted twin-Q soft policy iteration with auto-alpha."""
+class SACLearner(Learner):
+    """Twin-Q soft policy iteration with auto-alpha, as ONE combined loss
+    on the Learner stack: per-term stop_gradients give each parameter
+    group exactly its own gradients (critic <- TD, actor <- reparameterized
+    Q through FROZEN critics, log_alpha <- entropy temperature), and the
+    polyak target sync is the jitted post_update hook (reference SAC via
+    core/learner + additional_update_for_module)."""
 
     def __init__(self, obs_dim: int, action_dim: int, max_action: float,
                  lr: float, gamma: float, tau: float,
-                 target_entropy: float, seed: int = 0):
+                 target_entropy: float, seed: int = 0, mesh=None):
+        self._obs_dim = obs_dim
+        self._action_dim = action_dim
+        self._max_action = max_action
+        self._gamma = gamma
+        self._tau = tau
+        self._target_entropy = target_entropy
+        super().__init__(lr=lr, mesh=mesh, seed=seed)
+
+    def init_params(self, seed: int):
+        import jax.numpy as jnp
+
+        p = init_sac_params(seed, self._obs_dim, self._action_dim)
+        p["log_alpha"] = jnp.zeros(())
+        return p
+
+    def make_extra(self):
+        return {"q1": {k: np.asarray(v).copy()
+                       for k, v in self.params["q1"].items()},
+                "q2": {k: np.asarray(v).copy()
+                       for k, v in self.params["q2"].items()}}
+
+    def post_update(self, params, extra):
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda t, p: (1 - self._tau) * t + self._tau * p,
+            extra, {"q1": params["q1"], "q2": params["q2"]})
+
+    def loss(self, params, batch, extra, rng):
         import jax
         import jax.numpy as jnp
-        import optax
 
-        self.params = init_sac_params(seed, obs_dim, action_dim)
-        self.target = {"q1": {k: v.copy() for k, v in self.params["q1"].items()},
-                       "q2": {k: v.copy() for k, v in self.params["q2"].items()}}
-        self.log_alpha = jnp.zeros(())
-        self.optimizer = optax.adam(lr)
-        self.opt_state = self.optimizer.init(self.params)
-        self.alpha_opt = optax.adam(lr)
-        self.alpha_opt_state = self.alpha_opt.init(self.log_alpha)
-        self._key = jax.random.PRNGKey(seed)
+        sg = jax.lax.stop_gradient
+        k1, k2 = jax.random.split(rng)
+        alpha = jnp.exp(params["log_alpha"])
 
-        def critic_loss(params, target, log_alpha, batch, key):
-            next_a, next_logp = sample_action(
-                params["actor"], batch["next_obs"], key, action_dim, max_action)
-            tq = jnp.minimum(
-                q_value(target["q1"], batch["next_obs"], next_a),
-                q_value(target["q2"], batch["next_obs"], next_a))
-            alpha = jnp.exp(log_alpha)
-            backup = batch["rewards"] + gamma * (1 - batch["dones"]) * (
-                tq - alpha * next_logp)
-            backup = jax.lax.stop_gradient(backup)
-            q1 = q_value(params["q1"], batch["obs"], batch["actions"])
-            q2 = q_value(params["q2"], batch["obs"], batch["actions"])
-            return ((q1 - backup) ** 2).mean() + ((q2 - backup) ** 2).mean()
+        # critic: TD toward entropy-regularized target-Q backup
+        next_a, next_logp = sample_action(
+            params["actor"], batch["next_obs"], k1,
+            self._action_dim, self._max_action)
+        tq = jnp.minimum(q_value(extra["q1"], batch["next_obs"], next_a),
+                         q_value(extra["q2"], batch["next_obs"], next_a))
+        backup = sg(batch["rewards"] + self._gamma * (1 - batch["dones"])
+                    * (tq - alpha * next_logp))
+        q1 = q_value(params["q1"], batch["obs"], batch["actions"])
+        q2 = q_value(params["q2"], batch["obs"], batch["actions"])
+        c_loss = ((q1 - backup) ** 2).mean() + ((q2 - backup) ** 2).mean()
 
-        def actor_loss(params, log_alpha, batch, key):
-            a, logp = sample_action(
-                params["actor"], batch["obs"], key, action_dim, max_action)
-            q = jnp.minimum(q_value(params["q1"], batch["obs"], a),
-                            q_value(params["q2"], batch["obs"], a))
-            alpha = jnp.exp(log_alpha)
-            return (alpha * logp - q).mean(), logp
+        # actor: reparameterized sample through FROZEN critics
+        a, logp = sample_action(params["actor"], batch["obs"], k2,
+                                self._action_dim, self._max_action)
+        q_pi = jnp.minimum(q_value(sg(params["q1"]), batch["obs"], a),
+                           q_value(sg(params["q2"]), batch["obs"], a))
+        a_loss = (sg(alpha) * logp - q_pi).mean()
 
-        def update(params, target, log_alpha, opt_state, alpha_opt_state,
-                   batch, key):
-            k1, k2 = jax.random.split(key)
-            c_loss, c_grads = jax.value_and_grad(critic_loss)(
-                params, target, log_alpha, batch, k1)
+        # temperature toward the target entropy
+        alpha_loss = (-jnp.exp(params["log_alpha"])
+                      * sg(logp + self._target_entropy)).mean()
 
-            def a_loss_fn(p):
-                l, logp = actor_loss(
-                    {**params, "actor": p["actor"]}, log_alpha, batch, k2)
-                return l, logp
-
-            (a_loss, logp), a_grads = jax.value_and_grad(
-                a_loss_fn, has_aux=True)({"actor": params["actor"]})
-            grads = {"actor": a_grads["actor"],
-                     "q1": c_grads["q1"], "q2": c_grads["q2"]}
-            updates, opt_state = self.optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            # alpha update toward target entropy
-            al_grad = jax.grad(
-                lambda la: (-jnp.exp(la) * jax.lax.stop_gradient(
-                    logp + target_entropy)).mean())(log_alpha)
-            al_up, alpha_opt_state = self.alpha_opt.update(
-                al_grad, alpha_opt_state, log_alpha)
-            log_alpha = optax.apply_updates(log_alpha, al_up)
-            target_new = jax.tree.map(
-                lambda t, p: (1 - tau) * t + tau * p,
-                target, {"q1": params["q1"], "q2": params["q2"]})
-            aux = {"critic_loss": c_loss, "actor_loss": a_loss,
-                   "alpha": jnp.exp(log_alpha), "entropy": -logp.mean()}
-            return params, target_new, log_alpha, opt_state, alpha_opt_state, aux
-
-        self._update = jax.jit(update)
+        total = c_loss + a_loss + alpha_loss
+        return total, {"critic_loss": c_loss, "actor_loss": a_loss,
+                       "alpha": sg(alpha), "entropy": -sg(logp).mean()}
 
     def update_batch(self, batch) -> Dict[str, float]:
         import jax
 
-        self._key, sub = jax.random.split(self._key)
-        (self.params, self.target, self.log_alpha, self.opt_state,
-         self.alpha_opt_state, aux) = self._update(
-            self.params, self.target, self.log_alpha, self.opt_state,
-            self.alpha_opt_state, batch, sub)
+        aux = self.update(batch)
         return {k: float(v) for k, v in jax.device_get(aux).items()}
 
-    def get_weights(self):
-        import jax
-
-        out = jax.tree.map(np.asarray, jax.device_get(self.params))
-        out["log_alpha"] = float(jax.device_get(self.log_alpha))
-        return out
-
     def set_weights(self, weights):
-        import jax
-        import jax.numpy as jnp
-
-        weights = dict(weights)
-        log_alpha = weights.pop("log_alpha", 0.0)
-        self.log_alpha = jnp.asarray(log_alpha)
-        self.params = jax.tree.map(jnp.asarray, weights)
-        self.target = {
-            "q1": {k: np.asarray(v).copy() for k, v in weights["q1"].items()},
-            "q2": {k: np.asarray(v).copy() for k, v in weights["q2"].items()}}
-        self.opt_state = self.optimizer.init(self.params)
-        self.alpha_opt_state = self.alpha_opt.init(self.log_alpha)
+        super().set_weights(weights)
+        self.extra = self.make_extra()
 
 
 class SACConfig:
