@@ -38,12 +38,16 @@ class _TrialActor:
     calls interleave with the blocking poll)."""
 
     def __init__(self, fn: Callable, config: Dict[str, Any],
-                 checkpoint: Optional[Checkpoint]):
+                 checkpoint: Optional[Checkpoint],
+                 start_iteration: int = 0):
         self._fn = fn
         self._config = config
         self._reports: "_queue.Queue" = _queue.Queue()
         self._last_checkpoint = checkpoint
-        self._iteration = 0
+        # retried/restored trials CONTINUE the iteration clock: resetting it
+        # would corrupt time-based scheduler decisions (ASHA max_t, PBT
+        # perturbation intervals) and collide history entries
+        self._iteration = start_iteration
         self._done = False
         self._error: Optional[str] = None
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -56,6 +60,10 @@ class _TrialActor:
             self._iteration += 1
             m = dict(metrics)
             m["training_iteration"] = self._iteration
+            if ckpt is not None:
+                # the runner needs mid-flight checkpoints for trial retries
+                # and durable experiment snapshots, not just at trial end
+                m["__checkpoint__"] = ckpt
             self._reports.put(m)
 
         tune_session._set(report_fn, self._last_checkpoint)
@@ -91,9 +99,40 @@ class Trial:
     last_result: Dict[str, Any] = field(default_factory=dict)
     last_checkpoint: Optional[Checkpoint] = None
     error: Optional[str] = None
+    num_failures: int = 0             # FailureConfig retry accounting
     rung_values: Dict[int, float] = field(default_factory=dict)  # ASHA bookkeeping
     last_perturb: int = 0                               # PBT bookkeeping
     history: List[Dict[str, Any]] = field(default_factory=list)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Durable view (no actor handles / refs)."""
+        return {
+            "trial_id": self.trial_id, "config": self.config,
+            "state": self.state, "last_result": self.last_result,
+            "last_checkpoint": self.last_checkpoint, "error": self.error,
+            "num_failures": self.num_failures,
+            "rung_values": self.rung_values,
+            "last_perturb": self.last_perturb, "history": self.history,
+        }
+
+    @classmethod
+    def from_snapshot(cls, snap: Dict[str, Any],
+                      resume_errored: bool = False) -> "Trial":
+        t = cls(trial_id=snap["trial_id"], config=snap["config"])
+        t.last_result = snap.get("last_result", {})
+        t.last_checkpoint = snap.get("last_checkpoint")
+        t.error = snap.get("error")
+        t.num_failures = snap.get("num_failures", 0)
+        t.rung_values = snap.get("rung_values", {})
+        t.last_perturb = snap.get("last_perturb", 0)
+        t.history = snap.get("history", [])
+        state = snap["state"]
+        if state == "RUNNING":
+            state = "PENDING"  # the crashed driver's in-flight trials re-run
+        elif state == "ERROR" and resume_errored:
+            state, t.error = "PENDING", None
+        t.state = state
+        return t
 
 
 @dataclass
@@ -147,16 +186,118 @@ def _trials_running_gauge():
 
 class TrialRunner:
     def __init__(self, fn: Callable, configs: List[Dict[str, Any]],
-                 tune_config: TuneConfig):
+                 tune_config: TuneConfig,
+                 experiment_dir: Optional[str] = None,
+                 failure_config=None,
+                 restored_trials: Optional[List[Trial]] = None):
         self.fn = fn
-        self.trials = [Trial(trial_id=f"trial_{i:05d}", config=c)
-                       for i, c in enumerate(configs)]
+        if restored_trials is not None:
+            self.trials = restored_trials
+        else:
+            self.trials = [Trial(trial_id=f"trial_{i:05d}", config=c)
+                           for i, c in enumerate(configs)]
         self.cfg = tune_config
         self.scheduler = tune_config.scheduler or FIFOScheduler()
         self.searcher = tune_config.search_alg
         # with a searcher, trials are created adaptively up to num_samples
         self._target = (tune_config.num_samples if self.searcher is not None
                         else len(self.trials))
+        self.experiment_dir = experiment_dir
+        self.failure_config = failure_config
+        self._last_snapshot = 0.0
+        # persisted-checkpoint cache: trial_id -> (id of in-memory ckpt,
+        # directory-backed Checkpoint written under the experiment dir)
+        self._persisted_ckpts: Dict[str, Any] = {}
+
+    # -------------------------------------------------- experiment state
+    SNAPSHOT_FILE = "experiment_state.pkl"
+    _SNAPSHOT_PERIOD_S = 1.0
+
+    def _snapshot(self, force: bool = False) -> None:
+        """Durable experiment state (reference
+        tune/execution/experiment_state.py): trial table + searcher +
+        scheduler, written atomically so a driver crash at any instant
+        leaves a loadable file. Restore completes the sweep without
+        re-running finished trials (Tuner.restore)."""
+        if self.experiment_dir is None:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_snapshot < self._SNAPSHOT_PERIOD_S:
+            return
+        self._last_snapshot = now
+        import os
+        import cloudpickle
+
+        trials = []
+        for t in self.trials:
+            snap = t.snapshot()
+            # snapshots reference checkpoint DIRECTORIES, not payloads: a
+            # sweep checkpointing large model states must not rewrite every
+            # byte of every trial's checkpoint into the state file each
+            # second (reference persists paths the same way)
+            snap["last_checkpoint"] = self._persist_checkpoint(t)
+            trials.append(snap)
+        state = {
+            "trials": trials,
+            # the whole TuneConfig rides along (scheduler + searcher state
+            # included), so restore resumes mid-sweep search/scheduling
+            "tune_config": self.cfg,
+            "scheduler": self.scheduler,
+            "failure_config": self.failure_config,
+            "target": self._target,
+        }
+        os.makedirs(self.experiment_dir, exist_ok=True)
+        path = os.path.join(self.experiment_dir, self.SNAPSHOT_FILE)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                cloudpickle.dump(state, f)
+            os.replace(tmp, path)
+        except Exception:
+            logger.exception("experiment snapshot failed")
+
+    def _persist_checkpoint(self, trial: Trial):
+        """Write a trial's in-memory checkpoint under the experiment dir
+        once per distinct checkpoint; return the directory-backed handle
+        for the snapshot (already-on-disk checkpoints pass through)."""
+        import os
+        import shutil
+
+        ck = trial.last_checkpoint
+        if ck is None:
+            return None
+        if getattr(ck, "_directory", None):
+            return ck  # already durable
+        cached = self._persisted_ckpts.get(trial.trial_id)
+        if cached is not None and cached[0] == id(ck):
+            return cached[1]
+        path = os.path.join(self.experiment_dir, "checkpoints",
+                            trial.trial_id)
+        tmp = path + ".tmp"
+        try:
+            shutil.rmtree(tmp, ignore_errors=True)
+            ck.to_directory(tmp)
+            old = path + ".old"
+            shutil.rmtree(old, ignore_errors=True)
+            if os.path.exists(path):
+                os.rename(path, old)
+            os.rename(tmp, path)
+            shutil.rmtree(old, ignore_errors=True)
+        except Exception:
+            logger.exception("checkpoint persist failed for %s",
+                             trial.trial_id)
+            return ck  # fall back to pickling the payload
+        persisted = Checkpoint.from_directory(path)
+        self._persisted_ckpts[trial.trial_id] = (id(ck), persisted)
+        return persisted
+
+    @classmethod
+    def load_snapshot(cls, experiment_dir: str) -> Dict[str, Any]:
+        import os
+        import cloudpickle
+
+        with open(os.path.join(experiment_dir, cls.SNAPSHOT_FILE), "rb") as f:
+            return cloudpickle.load(f)
 
     def _maybe_suggest_trials(self) -> None:
         """Ask the searcher for new configs while slots are free."""
@@ -182,7 +323,8 @@ class TrialRunner:
         else:
             opts["num_cpus"] = 1
         trial.actor = _TrialActor.options(**opts).remote(
-            self.fn, trial.config, checkpoint or trial.last_checkpoint)
+            self.fn, trial.config, checkpoint or trial.last_checkpoint,
+            trial.last_result.get("training_iteration", 0))
         trial.state = "RUNNING"
         trial.pending = trial.actor.next_result.remote()
 
@@ -226,6 +368,7 @@ class TrialRunner:
                     idle_retries += 1
                     time.sleep(0.02)
                     continue
+                self._snapshot(force=True)
                 return
             idle_retries = 0
             while pending and len(running) < self.cfg.max_concurrent_trials:
@@ -240,24 +383,42 @@ class TrialRunner:
             for ref in done:
                 trial = next(t for t in running if t.pending == ref)
                 self._process(trial, ref)
+            self._snapshot()
+
+    def _fail_or_retry(self, trial: Trial, error: str) -> None:
+        """FailureConfig(max_failures): a failed trial restarts from its
+        last checkpoint while retry budget remains (reference
+        tune trial-level fault tolerance, tune/tuner.py FailureConfig)."""
+        budget = getattr(self.failure_config, "max_failures", 0) \
+            if self.failure_config is not None else 0
+        if trial.num_failures < budget:
+            trial.num_failures += 1
+            logger.warning("trial %s failed (%d/%d retries); restarting "
+                           "from last checkpoint", trial.trial_id,
+                           trial.num_failures, budget)
+            self._stop_trial(trial, state="PENDING")
+            return
+        trial.error = error
+        self._stop_trial(trial, "ERROR")
+        self._notify_searcher(trial)
 
     def _process(self, trial: Trial, ref) -> None:
         try:
             result = ray_tpu.get(ref)
         except Exception as e:
-            trial.error = str(e)
-            self._stop_trial(trial, "ERROR")
-            self._notify_searcher(trial)
+            self._fail_or_retry(trial, str(e))
             return
         if result.get("__done__"):
             if result.get("__error__"):
-                trial.error = result["__error__"]
-                self._stop_trial(trial, "ERROR")
+                self._fail_or_retry(trial, result["__error__"])
             else:
                 self._finalize_checkpoint(trial)
                 self._stop_trial(trial, "TERMINATED")
-            self._notify_searcher(trial)
+                self._notify_searcher(trial)
             return
+        ckpt = result.pop("__checkpoint__", None)
+        if ckpt is not None:
+            trial.last_checkpoint = ckpt
         trial.last_result = result
         trial.history.append(result)
         decision = self.scheduler.on_trial_result(self, trial, result)
@@ -290,7 +451,15 @@ class TrialRunner:
 
 class Tuner:
     """`Tuner(trainable, param_space=..., tune_config=...).fit()`
-    (reference `python/ray/tune/tuner.py:53`)."""
+    (reference `python/ray/tune/tuner.py:53`).
+
+    Experiment-level fault tolerance: with a `run_config`
+    (`air.RunConfig(name=..., storage_path=...)`) the runner snapshots
+    durable experiment state continuously, and `Tuner.restore(path,
+    trainable)` resumes a crashed driver's sweep — finished trials keep
+    their results without re-running, interrupted trials restart from
+    their last checkpoints, and `FailureConfig(max_failures)` gives each
+    trial a retry budget."""
 
     def __init__(self, trainable: Callable, *,
                  param_space: Optional[Dict[str, Any]] = None,
@@ -300,15 +469,56 @@ class Tuner:
         self._space = dict(param_space or {})
         self._cfg = tune_config or TuneConfig()
         self._run_config = run_config
+        self._restored_trials: Optional[List[Trial]] = None
+
+    def experiment_dir(self) -> Optional[str]:
+        import os
+
+        rc = self._run_config
+        if rc is None:
+            return None
+        root = getattr(rc, "storage_path", None) or "/tmp/ray_tpu_results"
+        name = getattr(rc, "name", None) or "tune_experiment"
+        return os.path.join(os.path.expanduser(root), name)
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable, *,
+                resume_errored: bool = False) -> "Tuner":
+        """Resume a sweep from its experiment directory (reference
+        `Tuner.restore`, tuner.py:53): finished trials are NOT re-run;
+        PENDING/RUNNING (and, opted-in, ERRORED) trials resume from their
+        last checkpoints; searcher and scheduler state carry over."""
+        import os
+
+        state = TrialRunner.load_snapshot(path)
+        t = cls(trainable)
+        t._cfg = state["tune_config"]
+        t._cfg.scheduler = state["scheduler"]  # mid-sweep scheduler state
+        t._cfg.num_samples = state.get("target", 1)
+        from ray_tpu.air.config import FailureConfig, RunConfig
+
+        t._run_config = RunConfig(
+            name=os.path.basename(path.rstrip("/")),
+            storage_path=os.path.dirname(path.rstrip("/")),
+            # the retry budget must survive the crash it exists for
+            failure_config=state.get("failure_config") or FailureConfig())
+        t._restored_trials = [Trial.from_snapshot(s, resume_errored)
+                              for s in state["trials"]]
+        return t
 
     def fit(self) -> ResultGrid:
-        if self._cfg.search_alg is not None:
-            # adaptive search: every config comes from the searcher
+        if self._restored_trials is not None or self._cfg.search_alg is not None:
+            # restored sweeps carry their trial table; adaptive search
+            # creates every config through the searcher
             configs: List[Dict[str, Any]] = []
         else:
             configs = generate_configs(self._space, self._cfg.num_samples,
                                        self._cfg.seed)
-        runner = TrialRunner(self._fn, configs, self._cfg)
+        runner = TrialRunner(
+            self._fn, configs, self._cfg,
+            experiment_dir=self.experiment_dir(),
+            failure_config=getattr(self._run_config, "failure_config", None),
+            restored_trials=self._restored_trials)
         runner.run()
         results = []
         for t in runner.trials:
