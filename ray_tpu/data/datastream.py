@@ -118,6 +118,8 @@ def _apply_ops(block: Block, ops: List[tuple]) -> Block:
         elif kind == "filter":
             fn = op[1]
             block = _rows_to_block([r for r in _block_rows(block) if fn(r)])
+        elif kind == "limit":
+            block = _slice_block(block, 0, op[1])
     return block
 
 
@@ -151,7 +153,28 @@ class Datastream:
 
     def __init__(self, block_refs: List[ObjectRef], ops: Optional[List[tuple]] = None):
         self._block_refs = list(block_refs)
+        # LOGICAL operator chain (data/plan.py); execution sites lower it
+        # through the optimizer passes via _physical_ops
         self._ops: List[tuple] = list(ops or [])
+
+
+    @property
+    def _physical_ops(self) -> List[tuple]:
+        """Optimizer passes + lowering over the logical chain (reference
+        _internal/logical optimizer -> physical plan)."""
+        from ray_tpu.data.plan import lower, optimize
+
+        ops, _ = optimize(self._ops)
+        return lower(ops)
+
+    def explain(self) -> str:
+        """Printable logical plan, applied rules, optimized plan, and
+        physical op list (reference Dataset.explain)."""
+        from ray_tpu.data.plan import explain_ops
+
+        text = explain_ops(len(self._block_refs), self._ops)
+        print(text)
+        return text
 
     # ---------------------------------------------------------- transforms
     def map(self, fn: Callable[[Any], Any]) -> "Datastream":
@@ -206,7 +229,7 @@ class Datastream:
                 return self._udf(block)
 
         actors = [_MapWorker.options(**compute.actor_options).remote(
-            self._ops, ctor_args) for _ in builtins.range(n_actors)]
+            self._physical_ops, ctor_args) for _ in builtins.range(n_actors)]
         refs = [actors[i % n_actors].apply.remote(r)
                 for i, r in enumerate(self._block_refs)]
         # block until all results are in the store (the driver owns them and
@@ -225,21 +248,44 @@ class Datastream:
     def filter(self, fn: Callable[[Any], bool]) -> "Datastream":
         return Datastream(self._block_refs, self._ops + [("filter", fn)])
 
-    def repartition(self, num_blocks: int) -> "Datastream":
-        """Task-based all-to-all repartition (round-robin rows)."""
+    # stats-aware partitioning: target rows per output block when the
+    # caller doesn't pick a count (reference streaming executor's
+    # resource-budgeted partitioning)
+    TARGET_ROWS_PER_BLOCK = 8192
+
+    def _auto_num_blocks(self) -> int:
+        """Estimate output partitions from a one-block row-count sample:
+        total_rows ~= rows(first block) * num_blocks, sized to
+        TARGET_ROWS_PER_BLOCK."""
+        if not self._block_refs:
+            return 1
+        sample = ray_tpu.get(_count_rows_after_ops.remote(
+            self._block_refs[0], self._physical_ops))
+        est_total = sample * len(self._block_refs)
+        return builtins.max(
+            1, builtins.min(4 * len(self._block_refs),
+                            -(-est_total // self.TARGET_ROWS_PER_BLOCK)))
+
+    def repartition(self, num_blocks: Optional[int] = None) -> "Datastream":
+        """Task-based all-to-all repartition (round-robin rows);
+        num_blocks=None sizes partitions from sampled row stats."""
         from ray_tpu.data.shuffle import shuffle_refs
 
         return Datastream(shuffle_refs(
-            self._block_refs, self._ops, mode="random",
-            num_partitions=num_blocks, seed=0))
+            self._block_refs, self._physical_ops, mode="random",
+            num_partitions=num_blocks or self._auto_num_blocks(), seed=0))
 
-    def random_shuffle(self, *, seed: Optional[int] = None) -> "Datastream":
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       num_blocks: Optional[int] = None) -> "Datastream":
         """Distributed two-stage shuffle; the driver never sees the rows
-        (cf. reference `_internal/push_based_shuffle.py`)."""
+        (cf. reference `_internal/push_based_shuffle.py`). num_blocks=None
+        keeps the input partitioning (or pass a count; see repartition for
+        the stats-aware sizing)."""
         from ray_tpu.data.shuffle import shuffle_refs
 
         return Datastream(shuffle_refs(
-            self._block_refs, self._ops, mode="random", seed=seed))
+            self._block_refs, self._physical_ops, mode="random", seed=seed,
+            num_partitions=num_blocks))
 
     def sort(self, key: Union[str, Callable[[Any], Any]],
              descending: bool = False) -> "Datastream":
@@ -248,7 +294,7 @@ class Datastream:
         from ray_tpu.data.shuffle import shuffle_refs
 
         out = Datastream(shuffle_refs(
-            self._block_refs, self._ops, mode="sort", key=key))
+            self._block_refs, self._physical_ops, mode="sort", key=key))
         if descending:
             refs = out._block_refs[::-1]
             rev = ray_tpu.remote(_reverse_block)
@@ -260,7 +306,7 @@ class Datastream:
         per partition (cf. reference `grouped_data.py`)."""
         from ray_tpu.data.shuffle import shuffle_refs
 
-        refs = shuffle_refs(self._block_refs, self._ops, mode="hash", key=key)
+        refs = shuffle_refs(self._block_refs, self._physical_ops, mode="hash", key=key)
         return GroupedData(refs, key)
 
     def union(self, other: "Datastream") -> "Datastream":
@@ -296,13 +342,20 @@ class Datastream:
 
     def limit(self, n: int) -> "Datastream":
         """First n rows. Executes blocks incrementally and stops as soon as
-        n rows are covered — pending ops never run on the untouched tail."""
-        take = ray_tpu.remote(_limit_exec_block)
+        n rows are covered — pending ops never run on the untouched tail,
+        and the LimitPushdown pass hops the limit over row-preserving ops
+        so their UDFs touch at most n rows of each block."""
+        from ray_tpu.data.plan import lower, optimize
+
+        optimized, _ = optimize(self._ops + [("limit", n)])
         out_refs, seen = [], 0
         for ref in self._block_refs:
             if seen >= n:
                 break
-            out = take.remote(ref, self._ops, n - seen)
+            # per-block remaining budget: rewrite every limit op's n
+            ops = lower([("limit", n - seen) if op[0] == "limit" else op
+                         for op in optimized])
+            out = _exec_block.remote(ref, ops)
             out_refs.append(out)
             seen += _block_len(ray_tpu.get(out))
         return Datastream(out_refs)
@@ -321,23 +374,22 @@ class Datastream:
         return self.map_batches(add)
 
     def drop_columns(self, cols: List[str]) -> "Datastream":
-        drop = set(cols)
-        return self.map_batches(
-            lambda b: {k: v for k, v in b.items() if k not in drop})
+        return Datastream(self._block_refs,
+                          self._ops + [("project", {"drop": list(cols)})])
 
     def select_columns(self, cols: List[str]) -> "Datastream":
-        keep = list(cols)
-        return self.map_batches(lambda b: {k: b[k] for k in keep})
+        return Datastream(self._block_refs,
+                          self._ops + [("project", {"select": list(cols)})])
 
     def rename_columns(self, mapping: Dict[str, str]) -> "Datastream":
-        return self.map_batches(
-            lambda b: {mapping.get(k, k): v for k, v in b.items()})
+        return Datastream(self._block_refs,
+                          self._ops + [("project", {"rename": dict(mapping)})])
 
     # ----------------------------------------------------------- execution
     def materialize(self) -> "Datastream":
         if not self._ops:
             return self
-        refs = [_exec_block.remote(r, self._ops) for r in self._block_refs]
+        refs = [_exec_block.remote(r, self._physical_ops) for r in self._block_refs]
         return Datastream(refs)
 
     def _executed_refs(self) -> List[ObjectRef]:
@@ -361,20 +413,20 @@ class Datastream:
         for r in self._block_refs:
             if len(inflight) >= max_inflight:
                 yield inflight.popleft()
-            inflight.append(_exec_block.remote(r, self._ops))
+            inflight.append(_exec_block.remote(r, self._physical_ops))
         while inflight:
             yield inflight.popleft()
 
     # ----------------------------------------------------------- consumers
     def count(self) -> int:
-        # Logical-plan rules (reference _internal/logical optimizer):
-        # `map` preserves row counts, so a map-only chain counts SOURCE
-        # blocks without running any UDF; and counting ships per-block row
-        # COUNTS, never block data.
-        if all(op[0] == "map" for op in self._ops):
-            ops: List[tuple] = []
-        else:
-            ops = self._ops
+        # CountProjection pass (reference _internal/logical optimizer):
+        # trailing row-preserving ops (map / project) are dropped — a
+        # map-only chain counts SOURCE blocks without running any UDF —
+        # and counting ships per-block row COUNTS, never block data.
+        from ray_tpu.data.plan import lower, ops_for_count, optimize
+
+        ops, _ = ops_for_count(optimize(self._ops)[0])
+        ops = lower(ops)
         return sum(ray_tpu.get(
             [_count_rows_after_ops.remote(r, ops) for r in self._block_refs]))
 
@@ -382,7 +434,7 @@ class Datastream:
         task = ray_tpu.remote(
             lambda b, ops: block_fn(_apply_ops(b, ops), col))
         parts = [p for p in ray_tpu.get(
-            [task.remote(r, self._ops) for r in self._block_refs])
+            [task.remote(r, self._physical_ops) for r in self._block_refs])
             if p is not None]
         if not parts:
             raise ValueError(f"no rows with column {col!r}")
@@ -415,7 +467,7 @@ class Datastream:
     def _column_values(self, col: str) -> List[np.ndarray]:
         task = ray_tpu.remote(lambda b, ops: _block_col(_apply_ops(b, ops), col))
         return [v for v in ray_tpu.get(
-            [task.remote(r, self._ops) for r in self._block_refs]) if v is not None]
+            [task.remote(r, self._physical_ops) for r in self._block_refs]) if v is not None]
 
     # ------------------------------------------------------------- writers
     def _write(self, path_prefix: str, ext: str, write_block) -> List[str]:
@@ -426,7 +478,7 @@ class Datastream:
             lambda b, ops, p: write_block(_apply_ops(b, ops), p))
         paths = [os.path.join(path_prefix, f"part-{i:05d}.{ext}")
                  for i in builtins.range(len(self._block_refs))]
-        ray_tpu.get([task.remote(r, self._ops, p)
+        ray_tpu.get([task.remote(r, self._physical_ops, p)
                      for r, p in zip(self._block_refs, paths)])
         return paths
 
@@ -548,7 +600,7 @@ class Datastream:
         a summary (reference `Dataset.stats()`): per op kind — total wall
         time across blocks, min/max per block, rows out."""
         timed = ray_tpu.remote(_apply_ops_timed)
-        outs = ray_tpu.get([timed.remote(r, self._ops)
+        outs = ray_tpu.get([timed.remote(r, self._physical_ops)
                             for r in self._block_refs])
         per_op: Dict[int, List[float]] = {}
         total_rows = 0
@@ -558,7 +610,7 @@ class Datastream:
                 per_op.setdefault(idx, []).append(seconds)
         lines = [f"Datastream stats: {len(self._block_refs)} blocks, "
                  f"{total_rows} rows out"]
-        for i, op in enumerate(self._ops):
+        for i, op in enumerate(self._physical_ops):
             kind = op[0]
             times = per_op.get(i, [])
             if not times:
@@ -591,7 +643,7 @@ class Datastream:
         (one block of prefetch per consumer) — the full pipeline output is
         never resident at once."""
         coord = _SplitCoordinator.options(num_cpus=0).remote(
-            list(self._block_refs), n, list(self._ops))
+            list(self._block_refs), n, list(self._physical_ops))
         return [DataIterator(coord, i) for i in builtins.range(n)]
 
     def __repr__(self):
@@ -729,9 +781,6 @@ def _zip_merge(a_block: Block, ranges: List[tuple], *b_blocks: Block) -> Block:
     return _rows_to_block(merged)
 
 
-def _limit_exec_block(block: Block, ops: List[tuple], n: int) -> Block:
-    block = _apply_ops(block, ops)
-    return _slice_block(block, 0, min(n, _block_len(block)))
 
 
 class GroupedData:

@@ -269,7 +269,9 @@ class TrialRunner:
         if getattr(ck, "_directory", None):
             return ck  # already durable
         cached = self._persisted_ckpts.get(trial.trial_id)
-        if cached is not None and cached[0] == id(ck):
+        # identity via a STRONG reference, not id(): a freed checkpoint's
+        # address can be reused by its successor, which must not cache-hit
+        if cached is not None and cached[0] is ck:
             return cached[1]
         path = os.path.join(self.experiment_dir, "checkpoints",
                             trial.trial_id)
@@ -288,7 +290,7 @@ class TrialRunner:
                              trial.trial_id)
             return ck  # fall back to pickling the payload
         persisted = Checkpoint.from_directory(path)
-        self._persisted_ckpts[trial.trial_id] = (id(ck), persisted)
+        self._persisted_ckpts[trial.trial_id] = (ck, persisted)
         return persisted
 
     @classmethod

@@ -1,0 +1,115 @@
+"""Logical plan + optimizer passes (reference `python/ray/data/_internal/
+logical/`): explicit rule rewrites over the op chain, verified down to
+which UDFs actually run on how many rows."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.data import from_items
+from ray_tpu.data.plan import (explain_ops, lower, ops_for_count, optimize)
+
+
+def test_projection_fusion_rule_unit():
+    ops = [("map", lambda r: r),
+           ("project", {"select": ["a", "b"]}),
+           ("project", {"rename": {"a": "x"}}),
+           ("project", {"drop": ["b"]})]
+    out, applied = optimize(ops)
+    assert applied == ["ProjectionFusion"]
+    assert [op[0] for op in out] == ["map", "project"]
+    # the fused projection pipeline behaves like the chain
+    fn = lower(out)[-1][1]
+    block = {"a": np.arange(3), "b": np.arange(3), "c": np.arange(3)}
+    got = fn(block)
+    assert set(got) == {"x"}
+    np.testing.assert_array_equal(got["x"], np.arange(3))
+
+
+def test_limit_pushdown_rule_unit():
+    fn = lambda r: r
+    ops = [("map", fn), ("project", {"select": ["a"]}), ("limit", 5)]
+    out, applied = optimize(ops)
+    assert "LimitPushdown" in applied
+    assert out[0][0] == "limit", out  # hopped over both 1:1 ops
+    # but never over row-changing ops
+    ops2 = [("filter", fn), ("limit", 5)]
+    out2, _ = optimize(ops2)
+    assert [op[0] for op in out2] == ["filter", "limit"]
+
+
+def test_count_projection_rule_unit():
+    fn = lambda r: r
+    ops = [("map", fn), ("project", {"drop": ["a"]})]
+    out, applied = ops_for_count(ops)
+    assert applied and out == []
+    ops2 = [("map", fn), ("filter", fn), ("map", fn)]
+    out2, applied2 = ops_for_count(ops2)
+    assert applied2
+    assert [op[0] for op in out2] == ["map", "filter"]
+
+
+def test_explain_shows_rules_and_physical_plan():
+    ops = [("map", lambda r: r), ("project", {"select": ["a"]}),
+           ("project", {"drop": ["b"]}), ("limit", 3)]
+    text = explain_ops(4, ops)
+    assert "Source[4 blocks]" in text
+    assert "ProjectionFusion" in text and "LimitPushdown" in text
+    assert "Physical ops:" in text
+
+
+def test_count_pushdown_skips_udfs(ray_start_regular, tmp_path):
+    """count() over a map+project chain must not run a single UDF call."""
+    marker = str(tmp_path / "calls.log")
+
+    def spy(row):
+        with open(marker, "a") as f:
+            f.write("x\n")
+        return row
+
+    ds = from_items([{"a": i} for i in range(100)], parallelism=4)
+    n = ds.map(spy).select_columns(["a"]).count()
+    assert n == 100
+    assert not os.path.exists(marker), "count() ran the map UDF"
+
+
+def test_limit_pushdown_bounds_udf_rows(ray_start_regular, tmp_path):
+    """limit(5) over a map chain: the UDF runs on at most 5 rows per
+    touched block instead of whole blocks."""
+    marker = str(tmp_path / "rows.log")
+
+    def spy(row):
+        with open(marker, "a") as f:
+            f.write("x\n")
+        return {"a": row["a"] * 10}
+
+    ds = from_items([{"a": i} for i in range(200)], parallelism=2)  # 100/block
+    out = ds.map(spy).limit(5).take_all()
+    assert [r["a"] % 10 for r in out] == [0] * 5 and len(out) == 5
+    with open(marker) as f:
+        calls = f.read().count("x")
+    assert calls <= 5, f"map ran on {calls} rows (limit was 5)"
+
+
+def test_projection_chain_single_pass_behavior(ray_start_regular):
+    ds = from_items([{"a": i, "b": -i, "c": 2 * i} for i in range(10)],
+                    parallelism=2)
+    out = (ds.select_columns(["a", "b"])
+             .rename_columns({"a": "x"})
+             .drop_columns(["b"]))
+    assert len(out._physical_ops) == 1  # fused into one block pass
+    rows = out.take_all()
+    assert rows == [{"x": i} for i in range(10)]
+
+
+def test_stats_aware_repartition_sizes_from_rows(ray_start_regular):
+    ds = from_items([{"a": i} for i in range(100)], parallelism=10)
+    auto = ds.repartition()
+    # 100 rows << TARGET_ROWS_PER_BLOCK: collapses to one block
+    assert auto.num_blocks() == 1
+    assert auto.count() == 100
+    explicit = ds.repartition(5)
+    assert explicit.num_blocks() == 5
+    assert explicit.count() == 100
