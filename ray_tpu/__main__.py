@@ -32,6 +32,44 @@ def main(argv=None) -> int:
 
         return client_server_main(argv[1:])
 
+    if argv and argv[0] in ("up", "down", "exec", "submit", "attach"):
+        # cluster launcher (reference `ray up/down/exec/attach/submit`,
+        # scripts.py:1223): dispatched directly — exec/submit forward
+        # arbitrary trailing commands argparse REMAINDER would mangle
+        from ray_tpu.autoscaler import launcher as _launcher
+
+        cmd, rest = argv[0], argv[1:]
+        if not rest or rest[0] in ("-h", "--help"):
+            print(f"usage: ray_tpu {cmd} cluster.yaml ...", file=sys.stderr)
+            return 0 if rest else 2
+        yaml_path = rest[0]
+        try:
+            if cmd == "up":
+                return _launcher.cli_up(yaml_path,
+                                        block="--block" in rest[1:])
+            if cmd == "down":
+                return _launcher.cli_down(yaml_path)
+            if cmd == "exec":
+                if len(rest) < 2:
+                    print("usage: ray_tpu exec cluster.yaml -- cmd ...",
+                          file=sys.stderr)
+                    return 2
+                cmd_args = rest[1:]
+                if cmd_args and cmd_args[0] == "--":
+                    cmd_args = cmd_args[1:]
+                return _launcher.cli_exec(yaml_path, cmd_args)
+            if cmd == "submit":
+                if len(rest) < 2:
+                    print("usage: ray_tpu submit cluster.yaml script.py ...",
+                          file=sys.stderr)
+                    return 2
+                return _launcher.cli_submit(yaml_path, rest[1], rest[2:])
+            return _launcher.cli_attach(yaml_path)
+        except (FileNotFoundError, ValueError) as e:
+            # bad yaml path / malformed config: one-line error, not a trace
+            print(f"ray_tpu {cmd}: {e}", file=sys.stderr)
+            return 2
+
     parser = argparse.ArgumentParser(prog="ray_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
 

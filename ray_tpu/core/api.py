@@ -93,6 +93,15 @@ def init(
             return {"gcs_address": _worker.gcs_address, "client": True}
 
         if address is None:
+            # cluster-launcher integration (`ray_tpu exec/attach` export
+            # this; reference RAY_ADDRESS): join instead of booting a head
+            address = os.environ.get("RAY_TPU_ADDRESS") or None
+            if address is not None and (num_cpus is not None or resources):
+                logger.warning(
+                    "RAY_TPU_ADDRESS=%s: joining the existing cluster; "
+                    "init()'s num_cpus/resources apply only when booting a "
+                    "local head and are ignored here", address)
+        if address is None:
             from ray_tpu.core.node import HeadNode
 
             _node = HeadNode(

@@ -28,6 +28,12 @@ def main(argv=None) -> None:
     parser.add_argument("--object-store-memory", type=int, default=None)
     parser.add_argument("--snapshot-path", default=None,
                         help="persist GCS KV/job tables here (head only)")
+    parser.add_argument("--gcs-port", type=int, default=0,
+                        help="fixed GCS port (head only; cluster-launcher "
+                             "startup scripts need a known join address)")
+    parser.add_argument("--gcs-host", default="127.0.0.1",
+                        help="GCS bind host (head only; 0.0.0.0 for "
+                             "clusters whose workers join over the network)")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
 
@@ -47,7 +53,8 @@ def main(argv=None) -> None:
     gcs_address = args.address
     gcs = None
     if args.head:
-        gcs = GcsServer(snapshot_path=args.snapshot_path)
+        gcs = GcsServer(snapshot_path=args.snapshot_path,
+                        port=args.gcs_port, host=args.gcs_host)
         gcs_address = gcs.start()
         print(f"ray_tpu head started. GCS address: {gcs_address}")
         print(f"Connect with: ray_tpu.init(address=\"{gcs_address}\")")

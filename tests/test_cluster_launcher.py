@@ -1,0 +1,134 @@
+"""Cluster launcher (`ray_tpu up/down/exec/submit/attach`; reference
+`python/ray/scripts/scripts.py:1223` + command_runner bootstrap): head
+bring-up on the invoking machine, provider-driven workers, durable cluster
+state for later invocations."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import launcher as launcher_mod
+from ray_tpu.autoscaler.launcher import ClusterConfig, ClusterLauncher
+
+
+@pytest.fixture
+def state_root(tmp_path, monkeypatch):
+    root = str(tmp_path / "clusters")
+    monkeypatch.setattr(launcher_mod, "_STATE_ROOT", root)
+    return root
+
+
+def test_cluster_yaml_parsing(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text(
+        "cluster_name: demo\n"
+        "provider:\n  type: fake\n"
+        "head:\n  num_cpus: 2\n"
+        "workers:\n  count: 2\n  resources: {CPU: 1}\n")
+    cfg = ClusterConfig.from_yaml(str(p))
+    assert cfg.cluster_name == "demo"
+    assert cfg.provider["type"] == "fake"
+    assert cfg.workers["count"] == 2
+    with pytest.raises(ValueError):
+        q = tmp_path / "bad.yaml"
+        q.write_text("provider: {type: fake}\n")
+        ClusterConfig.from_yaml(str(q))
+
+
+def test_fake_cluster_up_submit_down(tmp_path, state_root):
+    """VERDICT done-criterion: one command chain — up, submit a driver
+    script that uses the whole cluster, down."""
+    cfg = ClusterConfig(
+        cluster_name="e2e",
+        provider={"type": "fake"},
+        head={"num_cpus": 2},
+        workers={"count": 2, "resources": {"CPU": 1}})
+    launcher = ClusterLauncher(cfg)
+    try:
+        state = launcher.up(wait_timeout_s=90)
+        assert state["gcs_address"]
+        assert len(state["worker_node_ids"]) == 2
+        assert os.path.exists(os.path.join(state_root, "e2e.json"))
+
+        script = tmp_path / "driver.py"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script.write_text(
+            f"import sys\nsys.path.insert(0, {repo!r})\n"
+            "import ray_tpu\n"
+            "ray_tpu.init()\n"  # RAY_TPU_ADDRESS env joins the cluster
+            "assert len([n for n in ray_tpu.nodes() if n['alive']]) == 3, "
+            "ray_tpu.nodes()\n"
+            "@ray_tpu.remote\n"
+            "def f(x):\n    return x * 2\n"
+            "assert ray_tpu.get([f.remote(i) for i in range(8)]) == "
+            "[i * 2 for i in range(8)]\n"
+            "print('DRIVER OK')\n")
+        rc = ClusterLauncher.submit("e2e", str(script))
+        assert rc == 0
+    finally:
+        launcher.down()
+    assert not os.path.exists(os.path.join(state_root, "e2e.json"))
+    # the head daemon is gone
+    pid = state["head_pid"]
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("head process survived down()")
+
+
+def test_gce_cluster_up_request_shapes(state_root):
+    """GCE flow: up() creates one TPU node per worker through the REST
+    API with a startup script that joins the head; down() deletes them.
+    Transport is injected — request shapes are asserted, nothing egresses."""
+    calls = []
+
+    def fake_request(method, url, body, headers):
+        if "metadata.google.internal" in url:
+            return {"access_token": "tok", "expires_in": 3600}
+        calls.append((method, url, body))
+        return {"name": "operations/op-1"}
+
+    cfg = ClusterConfig(
+        cluster_name="gce-test",
+        provider={"type": "gce", "project": "proj", "zone": "us-central2-b",
+                  "request_fn": fake_request},
+        head={"num_cpus": 1, "gcs_port": 0},
+        workers={"count": 2, "node_type": "tpu-16",
+                 "resources": {"TPU": 16}})
+    launcher = ClusterLauncher(cfg)
+    try:
+        state = launcher.up()
+        creates = [c for c in calls if c[0] == "POST"]
+        assert len(creates) == 2
+        for method, url, body in creates:
+            assert "projects/proj/locations/us-central2-b/nodes" in url
+            assert body["acceleratorType"] == "v5litepod-16"
+            startup = body["metadata"]["startup-script"]
+            assert state["gcs_address"] in startup
+            assert "ray_tpu start --address=" in startup
+        # provider config in the state file excludes the injected callable
+        with open(os.path.join(state_root, "gce-test.json")) as f:
+            persisted = json.load(f)
+        assert "request_fn" not in persisted["provider"]
+    finally:
+        launcher.down()
+    deletes = [c for c in calls if c[0] == "DELETE"]
+    assert len(deletes) == 2
+
+
+def test_attach_command_exports_address(state_root):
+    os.makedirs(state_root, exist_ok=True)
+    with open(os.path.join(state_root, "att.json"), "w") as f:
+        json.dump({"cluster_name": "att", "gcs_address": "1.2.3.4:6380"}, f)
+    cmd = ClusterLauncher.attach_command("att")
+    assert "RAY_TPU_ADDRESS=1.2.3.4:6380" in cmd
