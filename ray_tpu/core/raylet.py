@@ -350,6 +350,9 @@ class Raylet:
                     break
             spawned_env = self._starting_env.pop(payload["pid"], None)
             handle.env_key = payload.get("env_key") or spawned_env
+            if handle.env_key:
+                # URI-style env refcount: alive while any worker serves it
+                self._env_manager.acquire(handle.env_key)
             self._workers[wid] = handle
             conn.on_close.append(lambda c, wid=wid: self._on_worker_disconnect(wid))
             if payload.get("worker_type") == "driver":
@@ -401,15 +404,16 @@ class Raylet:
 
             def create_and_spawn():
                 try:
-                    py = self._env_manager.python_for(runtime_env)
-                except RuntimeError as e:
+                    ctx = self._env_manager.context_for(runtime_env)
+                except Exception as e:  # ANY plugin failure fails the tasks
                     logger.warning("%s", e)
                     self._fail_env_tasks(env_key, str(e))
                     return
                 finally:
                     with self._lock:
                         self._env_spawning.discard(env_key)
-                self._launch_worker(py, env)
+                env.update(ctx.env_vars)  # plugin-contributed worker env
+                self._launch_worker(ctx.python, env)
 
             threading.Thread(target=create_and_spawn, daemon=True,
                              name="runtime-env-create").start()
@@ -454,6 +458,9 @@ class Raylet:
             handle = self._workers.pop(wid, None)
             if handle is None:
                 return
+        if handle.env_key:
+            self._env_manager.release(handle.env_key)
+        with self._lock:
             try:
                 self._idle_workers.remove(wid)
             except ValueError:
@@ -579,9 +586,20 @@ class Raylet:
         return psutil.virtual_memory().percent / 100.0
 
     def _reaper_loop(self) -> None:
-        """Reap dead spawned processes + kill long-idle workers."""
+        """Reap dead spawned processes + kill long-idle workers + reclaim
+        long-unreferenced runtime envs."""
         cfg = get_config()
+        last_env_gc = time.monotonic()
         while not self._shutdown.wait(1.0):
+            if time.monotonic() - last_env_gc >= 60.0:
+                last_env_gc = time.monotonic()
+                try:
+                    # idle grace matches the worker-pool idle policy: an env
+                    # whose last worker left may get a new task momentarily
+                    self._env_manager.gc(
+                        min_idle_s=cfg.idle_worker_killing_time_s)
+                except Exception:
+                    logger.exception("runtime env gc failed")
             with self._lock:
                 starting = list(self._starting)
             for p in starting:
@@ -603,6 +621,9 @@ class Raylet:
                         self._workers.pop(wid, None)
                         to_kill.append(w)
             for w in to_kill:
+                if w.env_key:
+                    # popped here, so _on_worker_disconnect won't release
+                    self._env_manager.release(w.env_key)
                 try:
                     w.conn.push("exit", {})
                 except Exception:

@@ -1,17 +1,17 @@
-"""Runtime-env manager: venv-backed pip environments for workers.
+"""Runtime-env manager: pluggable per-env worker environments.
 
-Equivalent of the reference's runtime-env agent
-(`dashboard/modules/runtime_env/runtime_env_agent.py:161` +
-`_private/runtime_env/pip.py`): a `pip` runtime env resolves to a cached
-virtualenv (created with --system-site-packages so jax/numpy resolve from
-the base image — the reference's pip plugin inherits site-packages the same
-way), and workers for that env are spawned from the venv's interpreter.
-Environments are content-addressed by the normalized spec, created once
-under a filesystem lock, and reused across jobs; creation failures are
-remembered so queued work fails fast instead of respawning forever.
+Equivalent of the reference's runtime-env agent + plugin architecture
+(`dashboard/modules/runtime_env/runtime_env_agent.py:161`,
+`_private/runtime_env/{plugin,pip,conda}.py`): every runtime-env FIELD that
+needs machinery is a PLUGIN — a named unit with a spec normalizer, a
+create step (run once per content-addressed key under a cross-process
+lock), a context hook (which interpreter / env vars workers get), and a
+delete step driven by URI-style reference counts. `pip` (virtualenv) and
+`conda` ship built in; third parties register theirs with
+`register_plugin` without touching the manager.
 
 Lightweight fields (env_vars, working_dir) are applied in-process by the
-worker (`core/worker.py _apply_runtime_env`) and need no dedicated pool.
+worker (`core/worker.py _apply_runtime_env`) and need no plugin.
 """
 
 from __future__ import annotations
@@ -20,101 +20,103 @@ import hashlib
 import json
 import logging
 import os
+import shutil
 import subprocess
 import sys
 import threading
-from typing import Dict, Optional
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
 
 logger = logging.getLogger(__name__)
 
 _DEFAULT_BASE = "/tmp/ray_tpu/runtime_envs"
 
 
-def env_key(runtime_env: Optional[dict]) -> Optional[str]:
-    """Stable key for envs that need a dedicated worker pool; None when any
-    worker can run the task after in-process env application."""
-    if not runtime_env:
-        return None
-    pip = runtime_env.get("pip")
-    mods = runtime_env.get("py_modules")
-    if not pip and not mods:
-        return None
-    if isinstance(pip, dict):  # {"packages": [...]} form
-        pip = pip.get("packages", [])
-    # py_modules mutate sys.path for the worker's lifetime, so workers are
-    # pooled per package set (like pip envs) rather than shared
-    spec = {"pip": sorted(str(p) for p in pip or []),
-            "py_modules": sorted(
-                str(m.get("uri", m) if isinstance(m, dict) else m)
-                for m in mods or [])}
-    return hashlib.sha1(json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
+@dataclass
+class EnvContext:
+    """What a plugin contributes to worker startup."""
+
+    python: str = sys.executable
+    env_vars: Dict[str, str] = field(default_factory=dict)
 
 
-class RuntimeEnvManager:
-    """Creates and caches venvs; thread-safe, one creation per key."""
+class RuntimeEnvPlugin:
+    """One runtime-env field's machinery (reference RuntimeEnvPlugin,
+    _private/runtime_env/plugin.py). Subclass and `register_plugin()`.
 
-    def __init__(self, base_dir: str = _DEFAULT_BASE):
-        self.base_dir = base_dir
-        self._lock = threading.Lock()
-        self._locks: Dict[str, threading.Lock] = {}
-        self._failed: Dict[str, str] = {}
+    name:   the runtime_env dict key this plugin owns (e.g. "pip")
+    pooled: True if workers must be pooled per env key (an interpreter or
+            sys.path change); False for fields any worker can apply
+    """
 
-    def _key_lock(self, key: str) -> threading.Lock:
-        with self._lock:
-            return self._locks.setdefault(key, threading.Lock())
+    name: str = ""
+    pooled: bool = True
 
-    def creation_error(self, key: str) -> Optional[str]:
-        return self._failed.get(key)
+    def key_spec(self, value: Any) -> Any:
+        """Normalized, hashable spec for content addressing."""
+        return value
 
-    def python_for(self, runtime_env: dict) -> str:
-        """Blocking: return the env's python executable, creating the venv
-        on first use. Raises RuntimeError on (possibly cached) failure."""
-        import fcntl
-        import sys
+    def create(self, value: Any, env_dir: str) -> None:
+        """Build the environment under env_dir (called once per key,
+        cross-process locked). Raise on failure."""
 
-        key = env_key(runtime_env)
-        assert key is not None
-        if not runtime_env.get("pip"):
-            # py_modules-only env: dedicated worker pool (sys.path isolation)
-            # but no venv — the host interpreter serves it
-            return sys.executable
-        with self._key_lock(key):
-            if key in self._failed:
-                raise RuntimeError(self._failed[key])
-            env_dir = os.path.join(self.base_dir, key)
-            py = os.path.join(env_dir, "bin", "python")
-            marker = os.path.join(env_dir, ".ready")
-            if os.path.exists(marker):
-                return py
-            # cross-process lock: multiple raylets (in-process Cluster or
-            # co-hosted nodes) share /tmp/ray_tpu/runtime_envs — exactly one
-            # builds the env, the rest wait and reuse it
-            os.makedirs(self.base_dir, exist_ok=True)
-            with open(os.path.join(self.base_dir, f".{key}.lock"), "w") as lk:
-                fcntl.flock(lk, fcntl.LOCK_EX)
-                try:
-                    if os.path.exists(marker):
-                        return py
-                    pip = runtime_env.get("pip")
-                    if isinstance(pip, dict):
-                        pip = pip.get("packages", [])
-                    try:
-                        self._create(env_dir, py, [str(p) for p in pip])
-                    except Exception as e:
-                        msg = f"runtime env creation failed for pip={pip}: {e}"
-                        self._failed[key] = msg
-                        raise RuntimeError(msg) from None
-                    with open(marker, "w") as f:
-                        f.write(json.dumps({"pip": pip}))
-                    return py
-                finally:
-                    fcntl.flock(lk, fcntl.LOCK_UN)
+    def modify_context(self, value: Any, env_dir: str,
+                       ctx: EnvContext) -> None:
+        """Point the worker context at the built environment."""
 
-    def _create(self, env_dir: str, py: str, pip: list) -> None:
+    def delete(self, env_dir: str) -> None:
+        """Reclaim the built environment (refcount hit zero)."""
+        shutil.rmtree(env_dir, ignore_errors=True)
+
+
+# ------------------------------------------------------------ registration
+
+_plugins: Dict[str, RuntimeEnvPlugin] = {}
+_plugins_lock = threading.Lock()
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    if not plugin.name:
+        raise ValueError("plugin needs a name (the runtime_env key it owns)")
+    with _plugins_lock:
+        _plugins[plugin.name] = plugin
+
+
+def unregister_plugin(name: str) -> None:
+    with _plugins_lock:
+        _plugins.pop(name, None)
+
+
+def _active_plugins(runtime_env: dict) -> List[RuntimeEnvPlugin]:
+    with _plugins_lock:
+        plugins = list(_plugins.values())
+    return [p for p in plugins if runtime_env.get(p.name)]
+
+
+# ---------------------------------------------------------------- builtins
+
+
+class PipPlugin(RuntimeEnvPlugin):
+    """Virtualenv-backed pip env (--system-site-packages so jax/numpy
+    resolve from the base image, like the reference's pip plugin)."""
+
+    name = "pip"
+
+    def key_spec(self, value):
+        return sorted(self._packages(value))
+
+    def _packages(self, value) -> List[str]:
+        if isinstance(value, dict):  # {"packages": [...]} form
+            value = value.get("packages", [])
+        return [str(p) for p in value or []]
+
+    def create(self, value, env_dir: str) -> None:
         import sysconfig
 
-        os.makedirs(self.base_dir, exist_ok=True)
-        logger.info("creating runtime env at %s (pip=%s)", env_dir, pip)
+        pip = self._packages(value)
+        py = os.path.join(env_dir, "bin", "python")
+        logger.info("creating pip runtime env at %s (pip=%s)", env_dir, pip)
         subprocess.run(
             [sys.executable, "-m", "venv", "--system-site-packages", env_dir],
             check=True, capture_output=True)
@@ -123,11 +125,13 @@ class RuntimeEnvManager:
         # parent's site-packages too (after the env's own dir, so installed
         # packages shadow inherited ones).
         child_purelib = subprocess.run(
-            [py, "-c", "import sysconfig; print(sysconfig.get_paths()['purelib'])"],
+            [py, "-c",
+             "import sysconfig; print(sysconfig.get_paths()['purelib'])"],
             check=True, capture_output=True, text=True).stdout.strip()
         parent_purelib = sysconfig.get_paths()["purelib"]
         if parent_purelib != child_purelib:
-            with open(os.path.join(child_purelib, "_parent_site.pth"), "w") as f:
+            with open(os.path.join(child_purelib, "_parent_site.pth"),
+                      "w") as f:
                 f.write(parent_purelib + "\n")
         if pip:
             r = subprocess.run(
@@ -135,3 +139,279 @@ class RuntimeEnvManager:
                 capture_output=True, text=True, timeout=600)
             if r.returncode != 0:
                 raise RuntimeError(r.stderr[-2000:])
+
+    def modify_context(self, value, env_dir: str, ctx: EnvContext) -> None:
+        ctx.python = os.path.join(env_dir, "bin", "python")
+
+
+class CondaPlugin(RuntimeEnvPlugin):
+    """Conda env support (reference _private/runtime_env/conda.py):
+    `{"conda": {"dependencies": [...]}}` builds a prefix env;
+    `{"conda": "existing-env-name"}` reuses a named env. Requires a conda
+    binary on PATH."""
+
+    name = "conda"
+
+    def key_spec(self, value):
+        if isinstance(value, str):
+            return value
+        return json.dumps(value, sort_keys=True)
+
+    @staticmethod
+    def _conda() -> str:
+        exe = shutil.which("conda") or shutil.which("mamba")
+        if exe is None:
+            raise RuntimeError(
+                "runtime_env 'conda' requires a conda/mamba binary on PATH")
+        return exe
+
+    def create(self, value, env_dir: str) -> None:
+        import tempfile
+
+        conda = self._conda()
+        if isinstance(value, str):
+            return  # named env: nothing to build
+        deps = list((value or {}).get("dependencies", []))
+        spec = {"dependencies": deps or [f"python={sys.version_info.major}."
+                                         f"{sys.version_info.minor}"]}
+        os.makedirs(os.path.dirname(env_dir), exist_ok=True)
+        fd, spec_path = tempfile.mkstemp(suffix=".yaml")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump({"name": "rtpu", **spec}, f)  # yaml-subset JSON
+            r = subprocess.run(
+                [conda, "env", "create", "-p", env_dir, "-f", spec_path],
+                capture_output=True, text=True, timeout=1800)
+            if r.returncode != 0:
+                raise RuntimeError(r.stderr[-2000:])
+        finally:
+            os.unlink(spec_path)
+
+    def modify_context(self, value, env_dir: str, ctx: EnvContext) -> None:
+        if isinstance(value, str):
+            conda_root = os.path.dirname(os.path.dirname(self._conda()))
+            ctx.python = os.path.join(conda_root, "envs", value,
+                                      "bin", "python")
+        else:
+            ctx.python = os.path.join(env_dir, "bin", "python")
+
+
+class _PyModulesPlugin(RuntimeEnvPlugin):
+    """py_modules mutate sys.path for the worker's lifetime, so workers are
+    pooled per package set; the download/sys.path work happens in-worker
+    (runtime_env.ensure_py_modules)."""
+
+    name = "py_modules"
+
+    def key_spec(self, value):
+        return sorted(str(m.get("uri", m) if isinstance(m, dict) else m)
+                      for m in value or [])
+
+
+register_plugin(PipPlugin())
+register_plugin(CondaPlugin())
+register_plugin(_PyModulesPlugin())
+
+
+# ------------------------------------------------------------------- keys
+
+
+def env_key(runtime_env: Optional[dict]) -> Optional[str]:
+    """Stable key for envs that need a dedicated worker pool; None when any
+    worker can run the task after in-process env application."""
+    if not runtime_env:
+        return None
+    active = [p for p in _active_plugins(runtime_env) if p.pooled]
+    if not active:
+        return None
+    spec = {p.name: p.key_spec(runtime_env[p.name]) for p in active}
+    return hashlib.sha1(
+        json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
+
+
+class RuntimeEnvManager:
+    """Creates, caches, refcounts and deletes plugin-built environments;
+    thread-safe, one creation per key (cross-process file lock)."""
+
+    def __init__(self, base_dir: str = _DEFAULT_BASE):
+        self.base_dir = base_dir
+        self._lock = threading.Lock()
+        self._locks: Dict[str, threading.Lock] = {}
+        self._failed: Dict[str, str] = {}
+        self._refs: Dict[str, int] = {}  # URI-style env refcounts
+        self._zero_since: Dict[str, float] = {}  # key -> t at refcount 0
+
+    def _key_lock(self, key: str) -> threading.Lock:
+        with self._lock:
+            return self._locks.setdefault(key, threading.Lock())
+
+    def creation_error(self, key: str) -> Optional[str]:
+        return self._failed.get(key)
+
+    # ---------------------------------------------------------- refcounts
+    # Counts are kept BOTH in-process (fast) and in an on-disk counter file
+    # mutated under the key's cross-process flock: the base dir is shared
+    # across raylets on a host, and a gc() in one process must never delete
+    # an env another raylet's live workers run from.
+
+    def _refs_path(self, key: str) -> str:
+        return os.path.join(self.base_dir, f".{key}.refs")
+
+    def _bump_disk_refs(self, key: str, delta: int) -> int:
+        import fcntl
+
+        os.makedirs(self.base_dir, exist_ok=True)
+        with open(os.path.join(self.base_dir, f".{key}.lock"), "w") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            try:
+                try:
+                    with open(self._refs_path(key)) as f:
+                        n = int(f.read().strip() or 0)
+                except (FileNotFoundError, ValueError):
+                    n = 0
+                n = max(0, n + delta)
+                with open(self._refs_path(key), "w") as f:
+                    f.write(str(n))
+                return n
+            finally:
+                fcntl.flock(lk, fcntl.LOCK_UN)
+
+    def acquire(self, key: str) -> None:
+        """One more worker serves this env (reference URI refcounting,
+        runtime_env_agent URI cache)."""
+        with self._lock:
+            self._refs[key] = self._refs.get(key, 0) + 1
+            self._zero_since.pop(key, None)
+        self._bump_disk_refs(key, +1)
+
+    def release(self, key: str) -> int:
+        """A worker for this env exited; returns the remaining local count.
+        Envs at zero (here AND on disk) become gc-eligible after an idle
+        grace period."""
+        with self._lock:
+            n = max(0, self._refs.get(key, 0) - 1)
+            self._refs[key] = n
+            if n == 0:
+                self._zero_since[key] = time.monotonic()
+        self._bump_disk_refs(key, -1)
+        return n
+
+    def gc(self, min_idle_s: float = 0.0) -> List[str]:
+        """Delete built envs unreferenced (cross-process) for at least
+        min_idle_s; returns deleted keys. An instant-delete-at-zero would
+        churn envs that the next task reuses, so callers pass a grace."""
+        import fcntl
+
+        now = time.monotonic()
+        with self._lock:
+            dead = [k for k, n in self._refs.items()
+                    if n == 0 and now - self._zero_since.get(k, now) >= min_idle_s]
+        deleted = []
+        for key in dead:
+            with self._key_lock(key):
+                env_dir = os.path.join(self.base_dir, key)
+                if not os.path.exists(env_dir):
+                    continue
+                with open(os.path.join(self.base_dir, f".{key}.lock"),
+                          "w") as lk:
+                    fcntl.flock(lk, fcntl.LOCK_EX)
+                    try:
+                        try:
+                            with open(self._refs_path(key)) as f:
+                                disk_refs = int(f.read().strip() or 0)
+                        except (FileNotFoundError, ValueError):
+                            disk_refs = 0
+                        with self._lock:
+                            local = self._refs.get(key, 0)
+                        if disk_refs > 0 or local > 0:
+                            continue  # another raylet (or a racing
+                            # acquire) still serves this env
+                        for plugin in list(_plugins.values()):
+                            marker = os.path.join(env_dir,
+                                                  f".built.{plugin.name}")
+                            if os.path.exists(marker):
+                                try:
+                                    plugin.delete(env_dir)
+                                except Exception:
+                                    logger.exception("env delete failed: %s",
+                                                     key)
+                        shutil.rmtree(env_dir, ignore_errors=True)
+                        try:
+                            os.unlink(self._refs_path(key))
+                        except FileNotFoundError:
+                            pass
+                        deleted.append(key)
+                    finally:
+                        fcntl.flock(lk, fcntl.LOCK_UN)
+        with self._lock:
+            for key in deleted:
+                self._refs.pop(key, None)
+                self._zero_since.pop(key, None)
+        return deleted
+
+    # ------------------------------------------------------------- create
+    def python_for(self, runtime_env: dict) -> str:
+        """Blocking: the env's python executable (see context_for)."""
+        return self.context_for(runtime_env).python
+
+    def context_for(self, runtime_env: dict) -> EnvContext:
+        """Blocking: the full worker context (interpreter + plugin env
+        vars), running every active plugin's create step on first use.
+        Raises RuntimeError on (possibly cached) failure."""
+        import fcntl
+
+        key = env_key(runtime_env)
+        assert key is not None
+        if runtime_env.get("pip") and runtime_env.get("conda"):
+            # both want to own the interpreter; the reference rejects the
+            # combination too
+            raise RuntimeError(
+                "runtime_env 'pip' and 'conda' are mutually exclusive "
+                "(put pip packages inside the conda dependencies instead)")
+        active = [p for p in _active_plugins(runtime_env) if p.pooled]
+
+        def contexts(env_dir: str) -> EnvContext:
+            ctx = EnvContext()
+            for p in active:
+                try:
+                    p.modify_context(runtime_env[p.name], env_dir, ctx)
+                except Exception as e:
+                    # cache: a broken context is as fatal as a failed build
+                    msg = f"runtime env context failed ({p.name}): {e}"
+                    self._failed[key] = msg
+                    raise RuntimeError(msg) from None
+            return ctx
+
+        with self._key_lock(key):
+            if key in self._failed:
+                raise RuntimeError(self._failed[key])
+            env_dir = os.path.join(self.base_dir, key)
+            ready = os.path.join(env_dir, ".ready")
+            if os.path.exists(ready):
+                return contexts(env_dir)
+            # cross-process lock: multiple raylets (in-process Cluster or
+            # co-hosted nodes) share the base dir — exactly one builds the
+            # env, the rest wait and reuse it
+            os.makedirs(self.base_dir, exist_ok=True)
+            with open(os.path.join(self.base_dir, f".{key}.lock"), "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+                try:
+                    if not os.path.exists(ready):
+                        for p in active:
+                            try:
+                                p.create(runtime_env[p.name], env_dir)
+                            except Exception as e:
+                                msg = (f"runtime env creation failed "
+                                       f"({p.name}): {e}")
+                                self._failed[key] = msg
+                                raise RuntimeError(msg) from None
+                            os.makedirs(env_dir, exist_ok=True)
+                            with open(os.path.join(
+                                    env_dir, f".built.{p.name}"), "w"):
+                                pass
+                        with open(ready, "w") as f:
+                            f.write(json.dumps(
+                                {p.name: True for p in active}))
+                    return contexts(env_dir)
+                finally:
+                    fcntl.flock(lk, fcntl.LOCK_UN)

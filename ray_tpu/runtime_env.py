@@ -6,8 +6,10 @@ applied in-process by the executing worker (core/worker.py
 `_apply_runtime_env`); `pip` resolves to a cached virtualenv-backed worker
 pool on each node (core/runtime_env_manager.py, the equivalent of the
 reference's `_private/runtime_env/pip.py` + per-env worker pools in
-`src/ray/raylet/worker_pool.cc:1664`). Conda is not supported — pip covers
-the isolation story without a conda toolchain in the image.
+`src/ray/raylet/worker_pool.cc:1664`). `conda` rides the plugin API
+(core/runtime_env_manager.py CondaPlugin; requires a conda binary on
+PATH), and third-party plugins register their own fields the same way
+(reference `_private/runtime_env/plugin.py`).
 """
 
 from __future__ import annotations
@@ -20,11 +22,12 @@ class RuntimeEnv(dict):
                  working_dir: Optional[str] = None,
                  pip: Optional[Union[List[str], Dict]] = None,
                  py_modules: Optional[List[str]] = None,
-                 conda: Optional[str] = None):
-        if conda:
-            raise NotImplementedError(
-                "conda runtime envs are not supported; use pip")
+                 conda: Optional[Union[str, Dict]] = None):
         super().__init__()
+        if conda:
+            # named env (str) or {"dependencies": [...]} spec; built by the
+            # CondaPlugin at worker-pool creation time
+            self["conda"] = conda
         if env_vars:
             self["env_vars"] = dict(env_vars)
         if working_dir:

@@ -122,3 +122,105 @@ def test_py_modules_shipping(ray_start_regular, tmp_path):
 
     a = Uses.options(runtime_env={"py_modules": [str(pkg)]}).remote()
     assert ray_tpu.get(a.get.remote()) == "shipped-427"
+
+
+def test_third_party_plugin_registers_and_builds(tmp_path):
+    """VERDICT done-criterion: a third-party runtime-env plugin is
+    registrable and drives create/modify_context through the manager."""
+    from ray_tpu.core.runtime_env_manager import (
+        EnvContext, RuntimeEnvManager, RuntimeEnvPlugin, env_key,
+        register_plugin, unregister_plugin)
+
+    calls = []
+
+    class TouchPlugin(RuntimeEnvPlugin):
+        name = "touch"
+
+        def key_spec(self, value):
+            return sorted(value)
+
+        def create(self, value, env_dir):
+            calls.append(("create", tuple(sorted(value))))
+            os.makedirs(env_dir, exist_ok=True)
+            with open(os.path.join(env_dir, "touched"), "w") as f:
+                f.write(",".join(value))
+
+        def modify_context(self, value, env_dir, ctx: EnvContext):
+            calls.append(("context", env_dir))
+            ctx.env_vars["TOUCHED"] = "1"
+
+    register_plugin(TouchPlugin())
+    try:
+        mgr = RuntimeEnvManager(base_dir=str(tmp_path))
+        env = {"touch": ["a", "b"]}
+        key = env_key(env)
+        assert key is not None  # pooled plugin => dedicated worker pool key
+        py = mgr.python_for(env)
+        assert py  # context default: host interpreter
+        assert os.path.exists(os.path.join(str(tmp_path), key, "touched"))
+        assert ("create", ("a", "b")) in calls
+        # second resolve: cached, no second create
+        n_creates = sum(1 for c in calls if c[0] == "create")
+        mgr.python_for(env)
+        assert sum(1 for c in calls if c[0] == "create") == n_creates
+    finally:
+        unregister_plugin("touch")
+    assert env_key({"touch": ["a"]}) is None  # unregistered: key gone
+
+
+def test_env_refcount_and_gc(tmp_path):
+    """URI-style refcounting: envs deletable only at zero references."""
+    from ray_tpu.core.runtime_env_manager import (RuntimeEnvManager,
+                                                  env_key)
+
+    mgr = RuntimeEnvManager(base_dir=str(tmp_path))
+    key = env_key({"py_modules": ["x"]})
+    env_dir = os.path.join(str(tmp_path), key)
+    os.makedirs(env_dir)
+    mgr.acquire(key)
+    mgr.acquire(key)
+    assert mgr.release(key) == 1
+    assert mgr.gc() == []          # still referenced
+    assert os.path.exists(env_dir)
+    assert mgr.release(key) == 0
+    assert mgr.gc() == [key]       # reclaimed at zero
+    assert not os.path.exists(env_dir)
+
+
+def test_conda_plugin_requires_conda(tmp_path):
+    """Conda envs are supported behind the plugin API; without a conda
+    binary the failure is a clear error (skips where conda exists)."""
+    import shutil as _shutil
+
+    import ray_tpu as _rt
+    from ray_tpu.core.runtime_env_manager import RuntimeEnvManager
+
+    if _shutil.which("conda") or _shutil.which("mamba"):
+        pytest.skip("conda present: the no-conda error path can't run")
+    mgr = RuntimeEnvManager(base_dir=str(tmp_path))
+    with pytest.raises(RuntimeError, match="conda"):
+        mgr.python_for({"conda": {"dependencies": ["pip"]}})
+
+
+def test_worker_env_refcount_lifecycle(ray_start_regular, local_pkg):
+    """A pip-env worker acquires its env's refcount on register and
+    releases on exit."""
+    import ray_tpu
+    from ray_tpu.core import api as _api
+    from ray_tpu.core.runtime_env_manager import env_key
+
+    env = {"pip": ["--no-index", "--no-build-isolation", local_pkg]}
+
+    @ray_tpu.remote
+    def where():
+        import sys
+
+        return sys.executable
+
+    path = ray_tpu.get(where.options(runtime_env=env).remote(), timeout=180)
+    assert "/runtime_envs/" in path
+    raylet = getattr(_api._node, "_raylet", None)
+    if raylet is None:
+        pytest.skip("in-process raylet not reachable from this fixture")
+    key = env_key(env)
+    assert raylet._env_manager._refs.get(key, 0) >= 1
