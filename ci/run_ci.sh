@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# One-command CI for ray_tpu (reference role: .buildkite/pipeline.build.yml).
+#
+#   ci/run_ci.sh            # fast tier + ordering stress x20 + native sanitizers
+#   ci/run_ci.sh --fast     # fast test tier only
+#   ci/run_ci.sh --native   # native ASAN/UBSAN harness only
+#   ci/run_ci.sh --stress   # actor-ordering stress x20 only
+#
+# Stages:
+#   1. native    : arena + scheduler + token-loader compiled whole-program
+#                  with -fsanitize=address,undefined and exercised by
+#                  src/tests/sanitize_main.cpp (allocation churn, shared
+#                  mappings, thread shutdown).
+#   2. fast tier : pytest tests/ (the "not slow" default tier).
+#   3. stress    : the actor-ordering race test repeated 20x (the round-1
+#                  ordering bug class must stay dead).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+STAGE="${1:-all}"
+
+run_native() {
+  echo "=== [1/3] native modules under ASan/UBSan ==="
+  mkdir -p build
+  g++ -std=c++17 -O1 -g -fsanitize=address,undefined \
+      -fno-omit-frame-pointer -o build/sanitize_native \
+      src/tests/sanitize_main.cpp src/arena/arena.cpp \
+      src/scheduler/cluster_scheduler.cpp src/loader/token_loader.cpp \
+      -lpthread
+  ASAN_OPTIONS=detect_leaks=1 UBSAN_OPTIONS=halt_on_error=1 \
+      ./build/sanitize_native
+}
+
+run_fast() {
+  echo "=== [2/3] fast test tier ==="
+  python -m pytest tests/ -q
+}
+
+run_stress() {
+  echo "=== [3/3] actor ordering stress x20 ==="
+  for i in $(seq 1 20); do
+    python -m pytest tests/test_actor_ordering_stress.py -q -x \
+      || { echo "ordering stress failed on iteration $i"; exit 1; }
+  done
+}
+
+case "$STAGE" in
+  --native) run_native ;;
+  --fast)   run_fast ;;
+  --stress) run_stress ;;
+  all)      run_native; run_fast; run_stress ;;
+  *) echo "unknown stage: $STAGE (use --native|--fast|--stress)" >&2
+     exit 2 ;;
+esac
+echo "CI green"

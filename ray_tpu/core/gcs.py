@@ -91,6 +91,7 @@ class GcsServer:
         # src/ray/core_worker/task_event_buffer.h)
         self._task_events: Dict[bytes, dict] = {}
         self._task_events_order: List[bytes] = []
+        self._task_events_dropped = 0  # evictions since boot (truncation flag)
         self._max_task_events = 10000
         self._task_counts = {"submitted": 0, "finished": 0, "failed": 0}
         self._profile_events: List[dict] = []
@@ -492,6 +493,10 @@ class GcsServer:
                 if len(self._task_events_order) >= self._max_task_events:
                     old = self._task_events_order.pop(0)
                     self._task_events.pop(old, None)
+                    # surfaced by list_task_events so `ray_tpu list tasks`
+                    # can SAY history was truncated instead of silently
+                    # showing a complete-looking window
+                    self._task_events_dropped += 1
                 e = {"task_id": key}
                 self._task_events[key] = e
                 self._task_events_order.append(key)
@@ -516,7 +521,14 @@ class GcsServer:
         limit = (payload or {}).get("limit", 1000)
         with self._lock:
             keys = self._task_events_order[-limit:]
-            return [dict(self._task_events[k]) for k in keys]
+            out = [dict(self._task_events[k]) for k in keys]
+            dropped = self._task_events_dropped
+        if dropped:
+            # sideband metadata row: EVICTED history is gone forever —
+            # distinct from limit windowing, where a larger limit still
+            # reaches the older retained entries
+            out.append({"__truncated__": dropped})
+        return out
 
     def rpc_profile_events(self, conn, req_id, payload):
         """Chrome-trace spans shipped by workers (reference ProfileEvent

@@ -341,6 +341,14 @@ class Raylet:
         handle = WorkerHandle(
             worker_id=wid, conn=conn, address=payload["address"], pid=payload["pid"],
         )
+        env_key_for_refs = payload.get("env_key") \
+            or self._starting_env.get(payload["pid"])
+        if env_key_for_refs:
+            # URI-style env refcount: alive while any worker serves it.
+            # Taken BEFORE the raylet lock — the bump does flock'd disk IO
+            # that must never stall scheduling; net count with the spawn
+            # lease released below: +1.
+            self._env_manager.acquire(env_key_for_refs)
         with self._lock:
             # adopt the Popen if we spawned it
             for p in self._starting:
@@ -350,9 +358,6 @@ class Raylet:
                     break
             spawned_env = self._starting_env.pop(payload["pid"], None)
             handle.env_key = payload.get("env_key") or spawned_env
-            if handle.env_key:
-                # URI-style env refcount: alive while any worker serves it
-                self._env_manager.acquire(handle.env_key)
             self._workers[wid] = handle
             conn.on_close.append(lambda c, wid=wid: self._on_worker_disconnect(wid))
             if payload.get("worker_type") == "driver":
@@ -370,6 +375,9 @@ class Raylet:
                 self._assign_actor(handle, spec)
             else:
                 self._idle_workers.append(wid)
+        if spawned_env:
+            # the spawn lease handed off to the worker's own reference
+            self._env_manager.release(spawned_env)
         self._schedule()
         return {"node_id": self.node_id.binary(), "gcs_address": self.gcs_address}
 
@@ -403,17 +411,22 @@ class Raylet:
                 self._env_spawning.add(env_key)
 
             def create_and_spawn():
+                # spawn LEASE: hold the env's refcount from resolution until
+                # the worker registers (which takes its own reference), so a
+                # gc tick can't delete the env out from under a booting
+                # worker; released at registration or on spawn failure
+                self._env_manager.acquire(env_key)
                 try:
                     ctx = self._env_manager.context_for(runtime_env)
-                except Exception as e:  # ANY plugin failure fails the tasks
+                    env.update(ctx.env_vars)  # plugin-contributed worker env
+                    self._launch_worker(ctx.python, env)
+                except Exception as e:  # ANY plugin/spawn failure fails tasks
                     logger.warning("%s", e)
+                    self._env_manager.release(env_key)
                     self._fail_env_tasks(env_key, str(e))
-                    return
                 finally:
                     with self._lock:
                         self._env_spawning.discard(env_key)
-                env.update(ctx.env_vars)  # plugin-contributed worker env
-                self._launch_worker(ctx.python, env)
 
             threading.Thread(target=create_and_spawn, daemon=True,
                              name="runtime-env-create").start()
@@ -609,6 +622,10 @@ class Raylet:
                             self._starting.remove(p)
                         except ValueError:
                             pass
+                        dead_env = self._starting_env.pop(p.pid, None)
+                    if dead_env:
+                        # died before registering: return its spawn lease
+                        self._env_manager.release(dead_env)
                     logger.warning("worker pid %d exited during startup rc=%s", p.pid, p.returncode)
             # idle killing
             now = time.monotonic()

@@ -190,8 +190,13 @@ class CondaPlugin(RuntimeEnvPlugin):
     def modify_context(self, value, env_dir: str, ctx: EnvContext) -> None:
         if isinstance(value, str):
             conda_root = os.path.dirname(os.path.dirname(self._conda()))
-            ctx.python = os.path.join(conda_root, "envs", value,
-                                      "bin", "python")
+            py = os.path.join(conda_root, "envs", value, "bin", "python")
+            if not os.path.exists(py):
+                # validate NOW: a bad named env must fail the queued tasks,
+                # not FileNotFoundError the spawn thread later
+                raise RuntimeError(
+                    f"conda env {value!r} not found (no {py})")
+            ctx.python = py
         else:
             ctx.python = os.path.join(env_dir, "bin", "python")
 

@@ -232,7 +232,13 @@ class CoreWorker:
         # rpc_add_borrower): conn id -> {object_id: count}
         self._conn_borrows: Dict[int, Dict[ObjectID, int]] = {}
         # objects whose local pulled copy we already announced to the owner
-        self._registered_copies: set = set()
+        from collections import OrderedDict
+
+        self._registered_copies: "OrderedDict[ObjectID, bool]" = OrderedDict()
+        self._registered_copies_lock = threading.Lock()
+        # shared outstanding wait-futures: (owner, oid) -> Future
+        self._wait_futures: Dict[tuple, Any] = {}
+        self._wait_futures_lock = threading.Lock()
 
         # grace-deferred plasma frees (see _maybe_free)
         self._deferred_frees: deque = deque()
@@ -753,11 +759,16 @@ class CoreWorker:
         """A successful pull materialized a copy on OUR raylet: register it
         with the owner so later readers spread across holders (once per
         object — repeat gets of a hot ref must not spam the owner)."""
-        if ref.id in self._registered_copies:
-            return
-        self._registered_copies.add(ref.id)
-        if len(self._registered_copies) > 100_000:
-            self._registered_copies.clear()  # crude bound; re-notifies are idempotent
+        with self._registered_copies_lock:
+            if ref.id in self._registered_copies:
+                self._registered_copies.move_to_end(ref.id)
+                return
+            self._registered_copies[ref.id] = True
+            # bounded LRU: evict the COLDEST entry instead of clearing the
+            # whole set (a clear made every hot ref re-notify its owner at
+            # once — exactly wrong at the 10k-objects-per-get envelope)
+            if len(self._registered_copies) > 100_000:
+                self._registered_copies.popitem(last=False)
         try:
             if ref.owner_address in ("", self.address):
                 with self._obj_lock:
@@ -898,26 +909,102 @@ class CoreWorker:
     # ------------------------------------------------------------------ wait
     def wait(self, refs: List[ObjectRef], num_returns: int, timeout: Optional[float],
              fetch_local: bool = True):
+        if len({r.id for r in refs}) != len(refs):
+            # the reference rejects duplicates too; silently collapsing them
+            # would make len(ready)+len(pending) != len(refs)
+            raise ValueError("wait() got duplicate object refs")
         deadline = None if timeout is None else time.monotonic() + timeout
         if all(r.owner_address in ("", self.address) for r in refs):
             return self._wait_owned(refs, num_returns, deadline)
-        # borrowed refs involved: poll the owners (latency floor = interval)
-        pending = list(refs)
+        # Borrowed refs ride the owners' DEFERRED-REPLY path: one
+        # get_object_info(wait=True) future per ref, resolved by the owner
+        # when the object turns terminal — no per-tick RPC storm and no
+        # get_check_interval_s latency floor (the old design polled every
+        # owner for every ref each interval; reference WaitManager is
+        # event-driven end to end). An owner's error/disconnect counts the
+        # ref ready: the subsequent get() surfaces the real failure.
+        # Futures are CACHED per (owner, object): the canonical poll loop —
+        # wait(timeout=...) in a while — reuses one outstanding deferred
+        # call instead of parking a fresh owner-side waiter per tick.
+        from concurrent.futures import FIRST_COMPLETED
+        from concurrent.futures import wait as futures_wait
+
+        owned_ids = {r.id for r in refs
+                     if r.owner_address in ("", self.address)}
+        owned = [r for r in refs if r.id in owned_ids]
+        futures: Dict[ObjectRef, Any] = {}
         ready: List[ObjectRef] = []
-        while len(ready) < num_returns:
-            still = []
-            for r in pending:
-                if self._is_ready(r):
+        ready_ids = set()
+        for r in refs:
+            if r.id in owned_ids:
+                continue
+            f = self._borrowed_wait_future(r)
+            if f is None:
+                ready.append(r)  # owner unreachable: ready-with-error
+                ready_ids.add(r.id)
+            else:
+                futures[r] = f
+        while True:
+            for r in [r for r, f in futures.items() if f.done()]:
+                self._drop_wait_future(r, futures.pop(r))
+                ready.append(r)
+                ready_ids.add(r.id)
+            owned_pending = []
+            for r in owned:
+                if r.id in ready_ids:
+                    continue
+                with self._obj_lock:
+                    st = self._objects.get(r.id)
+                    terminal = st is not None and st.state != "pending"
+                if terminal:
                     ready.append(r)
+                    ready_ids.add(r.id)
                 else:
-                    still.append(r)
-            pending = still
+                    owned_pending.append(r)
+            pending = owned_pending + list(futures)
             if len(ready) >= num_returns or not pending:
                 break
-            if deadline is not None and time.monotonic() >= deadline:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
                 break
-            time.sleep(get_config().get_check_interval_s)
+            # owned refs have no future to park on: bound the sleep so
+            # their cv-side transitions are observed promptly
+            slice_s = min(0.2, remaining) if remaining is not None else \
+                (0.2 if owned_pending else None)
+            if futures:
+                futures_wait(list(futures.values()), timeout=slice_s,
+                             return_when=FIRST_COMPLETED)
+            else:
+                with self._obj_cv:
+                    self._obj_cv.wait(timeout=slice_s or 5.0)
+        # preserve input order within each bucket for determinism
+        order = {id(r): i for i, r in enumerate(refs)}
+        ready.sort(key=lambda r: order[id(r)])
+        pending.sort(key=lambda r: order[id(r)])
         return ready[:num_returns], pending + ready[num_returns:]
+
+    def _borrowed_wait_future(self, ref: ObjectRef):
+        """One OUTSTANDING get_object_info(wait=True) future per borrowed
+        object: repeated wait() calls share it, so a poll loop parks exactly
+        one owner-side waiter per object instead of one per tick."""
+        key = (ref.owner_address, ref.id)
+        with self._wait_futures_lock:
+            f = self._wait_futures.get(key)
+            if f is not None and not f.done():
+                return f
+            try:
+                f = self.peer(ref.owner_address).call_future(
+                    "get_object_info", {"object_id": ref.id, "wait": True})
+            except Exception:
+                self._wait_futures.pop(key, None)
+                return None
+            self._wait_futures[key] = f
+            return f
+
+    def _drop_wait_future(self, ref: ObjectRef, fut) -> None:
+        with self._wait_futures_lock:
+            if self._wait_futures.get((ref.owner_address, ref.id)) is fut:
+                self._wait_futures.pop((ref.owner_address, ref.id), None)
 
     def _wait_owned(self, refs: List[ObjectRef], num_returns: int,
                     deadline: Optional[float]):
@@ -942,18 +1029,6 @@ class CoreWorker:
                     break
                 self._obj_cv.wait(timeout=min(remaining, 5.0) if remaining else 5.0)
         return ready[:num_returns], pending + ready[num_returns:]
-
-    def _is_ready(self, ref: ObjectRef) -> bool:
-        if ref.owner_address in ("", self.address):
-            with self._obj_lock:
-                st = self._objects.get(ref.id)
-                return st is not None and st.state != "pending"
-        try:
-            info = self.peer(ref.owner_address).call(
-                "get_object_info", {"object_id": ref.id, "wait": False}, timeout=5)
-            return info is not None and info["kind"] != "pending"
-        except Exception:
-            return False
 
     # -------------------------------------------------- owner-side RPC surface
     def rpc_get_object_info(self, conn, req_id, payload):

@@ -47,6 +47,14 @@ def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
     w = _global_worker()
     out = []
     for t in w.gcs.call("list_task_events", {"limit": limit}):
+        if "__truncated__" in t:
+            # history window overflowed: surface it instead of presenting a
+            # silently-complete-looking listing (weak spot flagged in review)
+            out.append({"task_id": "", "name": "(truncated)",
+                        "type": "META", "state":
+                        f"+{t['__truncated__']} older tasks evicted",
+                        "node_id": ""})
+            continue
         out.append({
             "task_id": t["task_id"].hex(),
             "name": t.get("name", ""),

@@ -230,3 +230,23 @@ def test_remote_pdb_breakpoint(ray_start_regular):
     while time.time() < deadline and rpdb.list_breakpoints(gcs):
         time.sleep(0.2)
     assert not rpdb.list_breakpoints(gcs)
+
+
+def test_list_tasks_reports_truncation(ray_start_regular):
+    """When the task-event window evicts history, `list_tasks` surfaces a
+    truncation row instead of a silently complete-looking listing."""
+    from ray_tpu import state
+    from ray_tpu.core import api as _api
+
+    gcs = _api._node._gcs
+    gcs._max_task_events = 10  # shrink the window for the test
+
+    @ray_tpu.remote
+    def tick(i):
+        return i
+
+    ray_tpu.get([tick.remote(i) for i in range(30)])
+    rows = state.list_tasks(limit=1000)
+    meta = [r for r in rows if r["type"] == "META"]
+    assert meta, "no truncation indicator after eviction"
+    assert "evicted" in meta[0]["state"]

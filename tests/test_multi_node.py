@@ -187,3 +187,56 @@ def test_get_current_placement_group(two_node_cluster):
     assert got == pg.id
     # outside a PG: None
     assert ray_tpu.get(where_am_i.remote(), timeout=60) is None
+
+
+def test_wait_on_borrowed_refs_is_event_driven(ray_start_regular):
+    """wait() over refs owned by ANOTHER process rides the owners'
+    deferred-reply path: a pending borrowed ref reports not-ready, then
+    ready promptly once the producing task finishes — with no per-tick
+    polling RPCs (worker.wait borrowed branch)."""
+    import time as _time
+
+    @ray_tpu.remote
+    class Owner:
+        def start(self, delay):
+            @ray_tpu.remote
+            def slow(d):
+                import time
+
+                time.sleep(d)
+                return 42
+
+            self._ref = slow.remote(delay)
+            return [self._ref]  # escapes: the driver borrows it
+
+    owner = Owner.remote()
+    [borrowed] = ray_tpu.get(owner.start.remote(1.2), timeout=60)
+    ready, pending = ray_tpu.wait([borrowed], num_returns=1, timeout=0.2)
+    assert not ready and pending == [borrowed]
+    t0 = _time.monotonic()
+    ready, pending = ray_tpu.wait([borrowed], num_returns=1, timeout=30)
+    waited = _time.monotonic() - t0
+    assert ready == [borrowed] and not pending
+    assert waited < 10, waited  # event-driven, not timeout-bound
+    assert ray_tpu.get(borrowed, timeout=30) == 42
+
+
+def test_wait_mixed_owned_and_borrowed(ray_start_regular):
+    """A wait() set mixing owned and borrowed refs resolves both kinds."""
+
+    @ray_tpu.remote
+    class Owner:
+        def make(self):
+            return [ray_tpu.put("theirs")]
+
+    @ray_tpu.remote
+    def mine():
+        return "ours"
+
+    owner = Owner.remote()
+    [borrowed] = ray_tpu.get(owner.make.remote(), timeout=60)
+    owned = mine.remote()
+    ready, pending = ray_tpu.wait([owned, borrowed], num_returns=2,
+                                  timeout=30)
+    assert len(ready) == 2 and not pending
+    assert sorted(ray_tpu.get(ready)) == ["ours", "theirs"]
