@@ -519,6 +519,8 @@ class GcsServer:
 
     def rpc_list_task_events(self, conn, req_id, payload):
         limit = (payload or {}).get("limit", 1000)
+        if limit <= 0:
+            return []
         with self._lock:
             keys = self._task_events_order[-limit:]
             out = [dict(self._task_events[k]) for k in keys]
@@ -526,7 +528,10 @@ class GcsServer:
         if dropped:
             # sideband metadata row: EVICTED history is gone forever —
             # distinct from limit windowing, where a larger limit still
-            # reaches the older retained entries
+            # reaches the older retained entries. The row counts against
+            # the limit so consumers never receive more than they asked.
+            if len(out) >= limit:
+                out = out[1:]
             out.append({"__truncated__": dropped})
         return out
 

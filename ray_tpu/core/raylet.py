@@ -341,14 +341,6 @@ class Raylet:
         handle = WorkerHandle(
             worker_id=wid, conn=conn, address=payload["address"], pid=payload["pid"],
         )
-        env_key_for_refs = payload.get("env_key") \
-            or self._starting_env.get(payload["pid"])
-        if env_key_for_refs:
-            # URI-style env refcount: alive while any worker serves it.
-            # Taken BEFORE the raylet lock — the bump does flock'd disk IO
-            # that must never stall scheduling; net count with the spawn
-            # lease released below: +1.
-            self._env_manager.acquire(env_key_for_refs)
         with self._lock:
             # adopt the Popen if we spawned it
             for p in self._starting:
@@ -359,6 +351,17 @@ class Raylet:
             spawned_env = self._starting_env.pop(payload["pid"], None)
             handle.env_key = payload.get("env_key") or spawned_env
             self._workers[wid] = handle
+        if handle.env_key:
+            # URI-style env refcount: alive while any worker serves it.
+            # Bumped OUTSIDE the raylet lock (flock'd disk IO must never
+            # stall scheduling), keyed off the SAME value the disconnect
+            # release uses; if the worker vanished in the window, undo.
+            self._env_manager.acquire(handle.env_key)
+            with self._lock:
+                gone = wid not in self._workers
+            if gone:
+                self._env_manager.release(handle.env_key)
+        with self._lock:
             conn.on_close.append(lambda c, wid=wid: self._on_worker_disconnect(wid))
             if payload.get("worker_type") == "driver":
                 handle.is_driver = True

@@ -236,8 +236,8 @@ class CoreWorker:
 
         self._registered_copies: "OrderedDict[ObjectID, bool]" = OrderedDict()
         self._registered_copies_lock = threading.Lock()
-        # shared outstanding wait-futures: (owner, oid) -> Future
-        self._wait_futures: Dict[tuple, Any] = {}
+        # shared outstanding wait-futures: (owner, oid) -> Future (LRU-capped)
+        self._wait_futures: "OrderedDict[tuple, Any]" = OrderedDict()
         self._wait_futures_lock = threading.Lock()
 
         # grace-deferred plasma frees (see _maybe_free)
@@ -991,6 +991,7 @@ class CoreWorker:
         with self._wait_futures_lock:
             f = self._wait_futures.get(key)
             if f is not None and not f.done():
+                self._wait_futures.move_to_end(key)
                 return f
             try:
                 f = self.peer(ref.owner_address).call_future(
@@ -999,6 +1000,11 @@ class CoreWorker:
                 self._wait_futures.pop(key, None)
                 return None
             self._wait_futures[key] = f
+            # bounded LRU: a stream of abandoned timed-out waits over
+            # distinct refs must not grow this forever (evicting a live
+            # entry only means a later wait() re-issues the call)
+            while len(self._wait_futures) > 4096:
+                self._wait_futures.popitem(last=False)
             return f
 
     def _drop_wait_future(self, ref: ObjectRef, fut) -> None:
