@@ -1,1 +1,3 @@
-from ray_tpu.workflow.api import step, run, run_async, resume, list_all, get_status
+from ray_tpu.workflow.api import (
+    WorkflowCancelledError, cancel, delete, get_output, get_status,
+    list_all, resume, run, run_async, send_event, step, wait_for_event)

@@ -1,10 +1,16 @@
 """Workflows: durable DAG execution with storage-backed step checkpoints.
 
 Mirrors the reference workflow library's capability
-(`python/ray/workflow/workflow_executor.py`, `workflow_storage.py`): every
-step's result is persisted under the workflow's storage directory before
-dependents run, so a crashed/cancelled workflow `resume()`s from the last
-completed step instead of recomputing.
+(`python/ray/workflow/workflow_executor.py`, `workflow_storage.py`,
+`event_listener.py`): every step's result is persisted under the
+workflow's storage directory before dependents run, so a crashed/cancelled
+workflow `resume()`s from the last completed step instead of recomputing.
+INDEPENDENT steps execute concurrently (one in-flight task per ready DAG
+node, like the reference executor's dag-level parallelism), steps can
+block on DURABLE EVENTS (`wait_for_event` / `send_event` — delivery is
+persisted, so an event received before a crash survives the resume), and
+workflows are manageable: `cancel`, `get_output`, `delete`, `get_status`,
+`list_all`.
 
     @workflow.step
     def add(a, b): return a + b
@@ -56,6 +62,44 @@ class WorkflowStep:
         return f"{self.name}-{h.hexdigest()}"
 
 
+class WorkflowCancelledError(RuntimeError):
+    """The workflow was cancelled (workflow.cancel) mid-execution."""
+
+
+class EventStep(WorkflowStep):
+    """A DAG node that becomes ready when a named DURABLE event arrives
+    (reference workflow events, `python/ray/workflow/event_listener.py`):
+    `send_event` persists the payload under the workflow's storage, so an
+    event delivered before a crash is still there after resume()."""
+
+    def __init__(self, event_name: str):
+        super().__init__(fn=None, args=(), kwargs={},
+                         name=f"event::{event_name}")
+        self.event_name = event_name
+
+    def step_id(self) -> str:
+        return f"event-{self.event_name}"
+
+
+def wait_for_event(event_name: str) -> EventStep:
+    """A step whose value is the event's payload; dependents run only
+    after `send_event(workflow_id, event_name, ...)`."""
+    return EventStep(event_name)
+
+
+def send_event(workflow_id: str, event_name: str, payload=None, *,
+               storage: Optional[str] = None, create: bool = False) -> None:
+    """Deliver (and persist) an event. The workflow must EXIST — a typo'd
+    id errors instead of silently minting a ghost directory — unless
+    create=True, the explicit pre-delivery form for events that arrive
+    before the workflow starts (delivery is durable either way)."""
+    st = _Storage(storage or _DEFAULT_STORAGE, workflow_id, create=create)
+    if not st.exists():
+        raise ValueError(f"no workflow {workflow_id!r} under storage "
+                         "(send_event(..., create=True) pre-delivers)")
+    st.save_event(event_name, payload)
+
+
 class _StepBuilder:
     def __init__(self, fn, **opts):
         self.fn = fn
@@ -85,9 +129,15 @@ def step(fn=None, *, name: Optional[str] = None, max_retries: int = 0):
 
 
 class _Storage:
-    def __init__(self, root: str, workflow_id: str):
+    def __init__(self, root: str, workflow_id: str, create: bool = False):
+        """create=False (read/manage paths) must not resurrect deleted
+        workflows or mint ghost dirs for typo'd ids."""
         self.dir = os.path.join(root, workflow_id)
-        os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
+        if create:
+            os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
+
+    def exists(self) -> bool:
+        return os.path.isdir(self.dir)
 
     def _path(self, step_id: str) -> str:
         return os.path.join(self.dir, "steps", step_id + ".pkl")
@@ -130,6 +180,23 @@ class _Storage:
         with open(os.path.join(self.dir, "dag.pkl"), "rb") as f:
             return pickle.load(f)
 
+    def _event_path(self, name: str) -> str:
+        return os.path.join(self.dir, "events", name + ".pkl")
+
+    def save_event(self, name: str, payload) -> None:
+        os.makedirs(os.path.join(self.dir, "events"), exist_ok=True)
+        tmp = self._event_path(name) + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(payload, f)
+        os.replace(tmp, self._event_path(name))
+
+    def has_event(self, name: str) -> bool:
+        return os.path.exists(self._event_path(name))
+
+    def load_event(self, name: str):
+        with open(self._event_path(name), "rb") as f:
+            return pickle.load(f)
+
 
 # ---------------------------------------------------------------- executor
 
@@ -140,40 +207,127 @@ def _run_step(fn_blob: bytes, args, kwargs):
     return fn(*args, **kwargs)
 
 
-def _execute(node: WorkflowStep, storage: _Storage):
-    step_id = node.step_id()
-    if storage.has(step_id):
-        return storage.load(step_id)
-    args = [_execute(a, storage) if isinstance(a, WorkflowStep) else a
-            for a in node.args]
-    kwargs = {k: (_execute(v, storage) if isinstance(v, WorkflowStep) else v)
-              for k, v in node.kwargs.items()}
-    attempts = node.max_retries + 1
+def _execute(root: WorkflowStep, storage: _Storage):
+    """Topological executor: every READY node (deps persisted) runs as its
+    own in-flight task, so independent DAG branches execute CONCURRENTLY
+    (reference workflow_executor dag parallelism); results persist before
+    dependents become ready. Event steps become ready when their event
+    file exists; cancel() flips the persisted status and the loop raises.
+    """
+    nodes: Dict[str, WorkflowStep] = {}
+    deps: Dict[str, List[str]] = {}
+
+    def visit(n: WorkflowStep) -> str:
+        sid = n.step_id()
+        if sid in nodes:
+            return sid
+        nodes[sid] = n
+        child_ids = [visit(a) for a in n.args if isinstance(a, WorkflowStep)]
+        child_ids += [visit(v) for v in n.kwargs.values()
+                      if isinstance(v, WorkflowStep)]
+        deps[sid] = child_ids
+        return sid
+
+    root_id = visit(root)
+    results: Dict[str, Any] = {
+        sid: storage.load(sid) for sid in nodes if storage.has(sid)}
+    attempts_left = {sid: nodes[sid].max_retries for sid in nodes}
+    inflight: Dict[Any, str] = {}  # result ref -> step id
     last_exc: Optional[Exception] = None
-    for _ in range(attempts):
-        try:
-            value = ray_tpu.get(_run_step.remote(
-                cloudpickle.dumps(node.fn), args, kwargs))
-            storage.save(step_id, value)
-            return value
-        except Exception as e:
-            last_exc = e
-    raise last_exc  # type: ignore[misc]
+
+    def resolved(v):
+        return results[v.step_id()] if isinstance(v, WorkflowStep) else v
+
+    while root_id not in results:
+        if storage.get_meta().get("status") == "CANCELED":
+            # drain ALREADY-FINISHED in-flight steps so their results
+            # persist for a later resume (steps still running on workers
+            # run to completion — task preemption is not part of the
+            # cancel contract — but nothing new launches)
+            if inflight:
+                done, _ = ray_tpu.wait(list(inflight),
+                                       num_returns=len(inflight),
+                                       timeout=5.0)
+                for ref in done:
+                    sid = inflight.pop(ref)
+                    try:
+                        value = ray_tpu.get(ref)
+                    except Exception:
+                        continue
+                    storage.save(sid, value)
+                    results[sid] = value
+            raise WorkflowCancelledError(
+                f"workflow cancelled with {len(results)}/{len(nodes)} "
+                f"steps complete")
+        launched = False
+        for sid, n in nodes.items():
+            if (sid in results or sid in inflight.values()
+                    or any(d not in results for d in deps[sid])):
+                continue
+            if isinstance(n, EventStep):
+                if storage.has_event(n.event_name):
+                    value = storage.load_event(n.event_name)
+                    storage.save(sid, value)
+                    results[sid] = value
+                    launched = True
+                continue  # not delivered yet: poll next loop
+            args = [resolved(a) for a in n.args]
+            kwargs = {k: resolved(v) for k, v in n.kwargs.items()}
+            ref = _run_step.remote(cloudpickle.dumps(n.fn), args, kwargs)
+            inflight[ref] = sid
+            launched = True
+        if root_id in results:
+            break
+        if not inflight:
+            if launched:
+                continue
+            if any(isinstance(nodes[s], EventStep) for s in nodes
+                   if s not in results):
+                time.sleep(0.2)  # waiting purely on external events
+                continue
+            raise last_exc or RuntimeError("workflow made no progress")
+        done, _ = ray_tpu.wait(list(inflight), num_returns=1, timeout=1.0)
+        for ref in done:
+            sid = inflight.pop(ref)
+            try:
+                value = ray_tpu.get(ref)
+            except Exception as e:
+                if attempts_left[sid] > 0:
+                    attempts_left[sid] -= 1
+                    last_exc = e
+                    continue  # becomes ready again next loop
+                raise
+            storage.save(sid, value)
+            results[sid] = value
+    return results[root_id]
+
+
+def _run_to_completion(st: _Storage, root: WorkflowStep):
+    """Shared status-transition policy for run()/resume()."""
+    st.set_meta(status="RUNNING")
+    try:
+        out = _execute(root, st)
+        st.set_meta(status="SUCCEEDED", end_time=time.time())
+        return out
+    except WorkflowCancelledError:
+        raise  # status already CANCELED; do not overwrite with FAILED
+    except Exception as e:
+        st.set_meta(status="FAILED", error=str(e), end_time=time.time())
+        raise
 
 
 def run(root: WorkflowStep, *, workflow_id: Optional[str] = None,
         storage: Optional[str] = None):
     workflow_id = workflow_id or f"wf-{int(time.time() * 1000)}"
-    st = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    st = _Storage(storage or _DEFAULT_STORAGE, workflow_id, create=True)
+    if st.get_meta().get("status") == "CANCELED":
+        # a cancel that landed before the (async) driver started must
+        # stick; resume() is the explicit un-cancel path
+        raise WorkflowCancelledError(
+            f"workflow {workflow_id!r} was cancelled before it started")
     st.save_dag(root)
-    st.set_meta(status="RUNNING", start_time=time.time())
-    try:
-        out = _execute(root, st)
-        st.set_meta(status="SUCCEEDED", end_time=time.time())
-        return out
-    except Exception as e:
-        st.set_meta(status="FAILED", error=str(e), end_time=time.time())
-        raise
+    st.set_meta(start_time=time.time())
+    return _run_to_completion(st, root)
 
 
 def run_async(root: WorkflowStep, *, workflow_id: Optional[str] = None,
@@ -195,20 +349,47 @@ def run_async(root: WorkflowStep, *, workflow_id: Optional[str] = None,
 def resume(workflow_id: str, *, storage: Optional[str] = None):
     """Resume from persisted step results (completed steps are not re-run)."""
     st = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    if not st.exists():
+        raise ValueError(f"no workflow {workflow_id!r} under storage")
     root = st.load_dag()
-    st.set_meta(status="RUNNING")
-    try:
-        out = _execute(root, st)
-        st.set_meta(status="SUCCEEDED", end_time=time.time())
-        return out
-    except Exception as e:
-        st.set_meta(status="FAILED", error=str(e), end_time=time.time())
-        raise
+    return _run_to_completion(st, root)
 
 
 def get_status(workflow_id: str, *, storage: Optional[str] = None) -> Optional[str]:
     st = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
     return st.get_meta().get("status")
+
+
+def cancel(workflow_id: str, *, storage: Optional[str] = None) -> None:
+    """Cancel a running workflow (reference workflow.cancel): the executor
+    observes the persisted status flip, drains finished in-flight steps
+    (their results persist for a later resume()), and stops launching new
+    ones; steps already running on workers run to completion."""
+    st = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    if not st.exists():
+        raise ValueError(f"no workflow {workflow_id!r} under storage")
+    st.set_meta(status="CANCELED", end_time=time.time())
+
+
+def get_output(workflow_id: str, *, storage: Optional[str] = None):
+    """Result of a SUCCEEDED workflow from storage (reference
+    workflow.get_output), without re-running anything."""
+    st = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    meta = st.get_meta()
+    if meta.get("status") != "SUCCEEDED":
+        raise ValueError(
+            f"workflow {workflow_id!r} is {meta.get('status')!r}, "
+            "not SUCCEEDED; resume() it first")
+    root = st.load_dag()
+    return st.load(root.step_id())
+
+
+def delete(workflow_id: str, *, storage: Optional[str] = None) -> None:
+    """Remove a workflow's storage (reference workflow.delete)."""
+    import shutil
+
+    st = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    shutil.rmtree(st.dir, ignore_errors=True)
 
 
 def list_all(storage: Optional[str] = None) -> List[Dict[str, Any]]:
