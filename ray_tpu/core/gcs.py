@@ -307,9 +307,12 @@ class GcsServer:
 
     def _public_node(self, node_id: bytes) -> dict:
         n = self._nodes[node_id]
-        return {k: n[k] for k in (
+        out = {k: n[k] for k in (
             "node_id", "address", "object_store_address", "resources_total",
             "resources_available", "labels", "alive")}
+        if n.get("stats"):
+            out["stats"] = n["stats"]
+        return out
 
     def rpc_heartbeat(self, conn, req_id, payload):
         node_id = payload["node_id"]
@@ -320,6 +323,16 @@ class GcsServer:
                 n["resources_available"] = payload["resources_available"]
             if n is not None:
                 n["pending_demands"] = payload.get("pending_demands", [])
+                # per-node physical utilization (reference reporter agent):
+                # ALWAYS overwritten (an empty report clears the entry —
+                # stale samples must not masquerade as live data) and
+                # timestamped so readers can judge freshness
+                stats = payload.get("node_stats") or {}
+                if stats:
+                    stats["sampled_at"] = time.time()
+                    n["stats"] = stats
+                else:
+                    n.pop("stats", None)
         return True
 
     def rpc_get_pending_demands(self, conn, req_id, payload):

@@ -297,6 +297,24 @@ class Raylet:
             self._raylet_clients[address] = c
             return c
 
+    def _node_stats(self) -> dict:
+        """Per-node physical utilization for the dashboard/state API
+        (reference dashboard agent's psutil reporter,
+        dashboard/modules/reporter/reporter_agent.py)."""
+        try:
+            import psutil
+
+            vm = psutil.virtual_memory()
+            return {
+                "cpu_percent": psutil.cpu_percent(interval=None),
+                "mem_used": vm.used,
+                "mem_total": vm.total,
+                "object_store_used": self.store.stats().get("used_bytes", 0),
+                "num_workers": len(self._workers),
+            }
+        except Exception:
+            return {}
+
     def _heartbeat_loop(self) -> None:
         period = get_config().health_check_period_ms / 1000.0
         while not self._shutdown.wait(period):
@@ -308,6 +326,7 @@ class Raylet:
                     "node_id": self.node_id.binary(),
                     "resources_available": dict(self.resources_available),
                     "pending_demands": demands,
+                    "node_stats": self._node_stats(),
                 }, timeout=5)
             except Exception:
                 if not self._shutdown.is_set():

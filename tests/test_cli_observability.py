@@ -250,3 +250,22 @@ def test_list_tasks_reports_truncation(ray_start_regular):
     meta = [r for r in rows if r["type"] == "META"]
     assert meta, "no truncation indicator after eviction"
     assert "evicted" in meta[0]["state"]
+
+
+def test_nodes_report_physical_stats(ray_start_regular):
+    """Heartbeats carry a psutil-backed per-node utilization report
+    (reference reporter agent) surfaced through nodes()."""
+    import time as _time
+
+    deadline = _time.monotonic() + 30
+    stats = None
+    while _time.monotonic() < deadline:
+        nodes = ray_tpu.nodes()
+        stats = next((n.get("stats") for n in nodes if n.get("stats")), None)
+        if stats:
+            break
+        _time.sleep(0.2)
+    assert stats, "no node published stats"
+    assert stats["mem_total"] > 0
+    assert 0 <= stats["cpu_percent"] <= 100 * 64
+    assert stats["num_workers"] >= 0
