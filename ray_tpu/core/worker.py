@@ -154,7 +154,11 @@ class ReferenceCounter:
                 "add_borrower", {"object_id": ref.id})
             self._borrowed[ref.id]["registered"] = True
         except Exception:
-            pass
+            # NOT silent: an unregistered borrow leaves only the owner's
+            # free-grace window protecting the object; the reconnect replay
+            # re-attempts, and lineage recovery backstops the loss
+            logger.debug("borrow registration for %s with %s failed",
+                         ref.id, ref.owner_address, exc_info=True)
 
     def remove_local(self, ref: ObjectRef) -> None:
         # The full decrement/pop happens under the lock; only the (idempotent)
@@ -444,7 +448,7 @@ class CoreWorker:
                 "worker_id": self.worker_id.binary(),
             })
         except Exception:
-            pass
+            logger.debug("task event emit failed", exc_info=True)
 
     def _register_returns(self, spec: TaskSpec) -> List[ObjectRef]:
         refs = []
@@ -782,7 +786,8 @@ class CoreWorker:
                     "add_object_location",
                     {"object_id": ref.id, "raylet": self.raylet_address})
         except Exception:
-            pass
+            logger.debug("copy registration for %s failed", ref.id,
+                         exc_info=True)
 
     def _note_location_failed(self, ref: ObjectRef, source: Optional[str]) -> None:
         if not source:
@@ -795,7 +800,8 @@ class CoreWorker:
                     "object_location_failed",
                     {"object_id": ref.id, "raylet": source})
         except Exception:
-            pass
+            logger.debug("location-failed report for %s lost", ref.id,
+                         exc_info=True)
 
     # ------------------------------------------------------ lineage recovery
     def _recover_object(self, ref: ObjectRef) -> bool:
