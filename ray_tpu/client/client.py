@@ -135,12 +135,14 @@ class ClientWorker:
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
                           args: tuple, kwargs: dict,
-                          num_returns: int = 1) -> List[ObjectRef]:
+                          num_returns: int = 1,
+                          concurrency_group: str = None) -> List[ObjectRef]:
         return self._call("cl_actor_task", {
             "actor_id": actor_id,
             "method": method_name,
             "args_blob": cloudpickle.dumps((args, kwargs)),
             "num_returns": num_returns,
+            "concurrency_group": concurrency_group,
         })["refs"]
 
     def get_actor_info(self, actor_id: Optional[ActorID] = None,

@@ -155,7 +155,8 @@ class ClientServer:
         args, kwargs = cloudpickle.loads(payload["args_blob"])
         refs = self._worker.submit_actor_task(
             payload["actor_id"], payload["method"], args, kwargs,
-            num_returns=payload.get("num_returns", 1))
+            num_returns=payload.get("num_returns", 1),
+            concurrency_group=payload.get("concurrency_group"))
         session.pin_all(refs)
         return {"refs": refs}
 

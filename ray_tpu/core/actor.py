@@ -19,16 +19,21 @@ from ray_tpu.core.task_spec import ActorCreationSpec, SchedulingStrategy
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1,
+                 concurrency_group: str = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def remote(self, *args, **kwargs):
-        return self._handle._invoke(self._name, args, kwargs, self._num_returns)
+        return self._handle._invoke(self._name, args, kwargs,
+                                    self._num_returns,
+                                    self._concurrency_group)
 
-    def options(self, num_returns: int = 1):
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns: int = 1, concurrency_group: str = None):
+        return ActorMethod(self._handle, self._name, num_returns,
+                           concurrency_group)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -45,14 +50,16 @@ class ActorHandle:
     def actor_id(self) -> ActorID:
         return self._actor_id
 
-    def _invoke(self, method_name: str, args, kwargs, num_returns):
+    def _invoke(self, method_name: str, args, kwargs, num_returns,
+                concurrency_group=None):
         from ray_tpu.core.api import _global_worker
 
         if num_returns in ("dynamic", "streaming"):
             num_returns = -1  # generator method (reference num_returns="dynamic")
         w = _global_worker()
         refs = w.submit_actor_task(
-            self._actor_id, method_name, args, kwargs, num_returns=num_returns)
+            self._actor_id, method_name, args, kwargs, num_returns=num_returns,
+            concurrency_group=concurrency_group)
         if num_returns == -1:
             return w.make_dynamic_generator(refs[0])
         return refs[0] if num_returns == 1 else refs
@@ -108,6 +115,7 @@ class ActorClass:
             max_task_retries=o.get("max_task_retries", 0),
             max_concurrency=o.get("max_concurrency", 1),
             lifetime=o.get("lifetime", "non_detached"),
+            concurrency_groups=o.get("concurrency_groups"),
             class_blob=cloudpickle.dumps(self._cls),
             init_args=w._serialize_args(args),
             init_kwargs_blob=serialization.dumps(kwargs) if kwargs else None,

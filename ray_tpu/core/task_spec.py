@@ -67,6 +67,9 @@ class TaskSpec:
     actor_creation_spec: Optional["ActorCreationSpec"] = None
     sequence_number: int = 0  # per-caller ordering for actor tasks
     caller_id: Optional[WorkerID] = None
+    # call-site concurrency-group override (reference actor.py:82
+    # method.options(concurrency_group=...)); None = method annotation
+    concurrency_group: Optional[str] = None
 
     # runtime env (conda/pip not supported; env vars + working dir are)
     runtime_env: Optional[dict] = None
@@ -91,6 +94,9 @@ class ActorCreationSpec:
     resources: Dict[str, float] = field(default_factory=dict)
     scheduling: SchedulingStrategy = field(default_factory=SchedulingStrategy)
     runtime_env: Optional[dict] = None
+    # named thread pools: methods annotated (or called) with a group run on
+    # that group's threads (reference actor.py:65 concurrency_groups)
+    concurrency_groups: Optional[Dict[str, int]] = None
 
 
 class ActorState(Enum):

@@ -287,6 +287,13 @@ class CoreWorker:
         # execution
         self._registered = threading.Event()
         self._task_queue: "queue.Queue[TaskSpec]" = queue.Queue()
+        # actor concurrency groups: name -> dedicated queue (reference
+        # actor.py:65; threads started in _init_actor)
+        self._group_queues: Dict[str, "queue.Queue[TaskSpec]"] = {}
+        # default-pool threads tracked separately: group threads also live
+        # in _exec_threads, and sizing the default pool off the combined
+        # list would under-spawn it
+        self._default_exec_threads: List[threading.Thread] = []
         self._executing_count = 0
         # executing+queued actor tasks excluding control-plane probes, so a
         # load reading is never inflated by the health checks that sample it
@@ -1699,6 +1706,7 @@ class CoreWorker:
         args: tuple,
         kwargs: dict,
         num_returns: int = 1,
+        concurrency_group: str = None,
     ) -> List[ObjectRef]:
         task_id = self._task_counter.next_task_id()
         with self._actor_seq_lock:
@@ -1718,6 +1726,7 @@ class CoreWorker:
             actor_id=actor_id,
             sequence_number=seq,
             caller_id=self.worker_id,
+            concurrency_group=concurrency_group,
         )
         refs = self._register_returns(spec)
         with self._pending_lock:
@@ -1957,6 +1966,20 @@ class CoreWorker:
             logger.info("worker exiting on raylet request")
             os._exit(0)
 
+    def _actor_group_for(self, spec: TaskSpec) -> Optional[str]:
+        """Concurrency group for an actor call: the call-site override
+        (method.options(concurrency_group=...)) wins, else the method's
+        @method(concurrency_group=...) annotation; unknown names fall back
+        to the default pool rather than stranding the call."""
+        if not self._group_queues:
+            return None
+        group = spec.concurrency_group
+        if group is None and self._actor_instance is not None:
+            fn = getattr(type(self._actor_instance), spec.method_name, None)
+            group = getattr(fn, "_ray_tpu_method_opts", {}).get(
+                "concurrency_group")
+        return group if group in self._group_queues else None
+
     def _enqueue_actor_task(self, spec: TaskSpec) -> None:
         # Load accounting happens HERE — only for tasks that actually enter
         # the exec queue (the matching decrement runs at execution end);
@@ -1964,7 +1987,8 @@ class CoreWorker:
         if spec.method_name not in self._PROBE_METHODS:
             with self._exec_count_lock:
                 self._load_count += 1
-        self._task_queue.put(spec)
+        group = self._actor_group_for(spec)
+        (self._group_queues[group] if group else self._task_queue).put(spec)
 
     def rpc_push_actor_task(self, conn, req_id, payload) -> None:
         """Direct actor transport target (callers push here)."""
@@ -2008,8 +2032,17 @@ class CoreWorker:
             if spec.runtime_env:
                 self._apply_runtime_env(spec.runtime_env)
             self._actor_instance = cls(*args, **kwargs)
-            n = max(1, spec.max_concurrency)
-            self._start_exec_threads(n)
+            # dedicated pools BEFORE creation_done: callers only learn our
+            # address afterwards, so no task can race an unrouted group
+            for gname, gsize in (spec.concurrency_groups or {}).items():
+                q: "queue.Queue[TaskSpec]" = queue.Queue()
+                self._group_queues[gname] = q
+                group_threads: List[threading.Thread] = []
+                with self._exec_threads_lock:
+                    for _ in range(max(1, int(gsize))):
+                        self._spawn_exec_thread(q, f"task-exec-{gname}",
+                                                group_threads)
+            self._start_exec_threads(max(1, spec.max_concurrency))
             # spec included so a GCS that restarted DURING our __init__ (and
             # so never saw the registration) can rebuild the actor record.
             self.gcs.call("actor_creation_done", {
@@ -2046,15 +2079,25 @@ class CoreWorker:
         # per-caller FIFO guarantee (reference
         # transport/actor_scheduling_queue.h) is violated.
         with self._exec_threads_lock:
-            while len(self._exec_threads) < n:
-                t = threading.Thread(target=self._exec_loop, name="task-exec", daemon=True)
-                t.start()
-                self._exec_threads.append(t)
+            while len(self._default_exec_threads) < n:
+                self._spawn_exec_thread(self._task_queue, "task-exec",
+                                        self._default_exec_threads)
 
-    def _exec_loop(self) -> None:
+    def _spawn_exec_thread(self, q: "queue.Queue", name: str,
+                           tracking: List[threading.Thread]) -> None:
+        """Caller holds _exec_threads_lock."""
+        t = threading.Thread(target=self._exec_loop, args=(q,),
+                             name=name, daemon=True)
+        t.start()
+        tracking.append(t)
+        if tracking is not self._exec_threads:
+            self._exec_threads.append(t)
+
+    def _exec_loop(self, q: Optional["queue.Queue"] = None) -> None:
+        q = q if q is not None else self._task_queue
         while not self._shutdown.is_set():
             try:
-                spec = self._task_queue.get(timeout=0.2)
+                spec = q.get(timeout=0.2)
             except queue.Empty:
                 continue
             self._execute_task(spec)

@@ -371,3 +371,43 @@ def test_returned_nested_ref_survives_container_lifetime(ray_start_regular):
     [inner] = ray_tpu.get(container)
     assert int(ray_tpu.get(inner).sum()) == int(
         np.arange(1 << 15, dtype=np.int64).sum())
+
+
+def test_actor_concurrency_groups(ray_start_regular, tmp_path):
+    """Concurrency groups (reference actor.py:65,82): a method annotated
+    into a named group runs on that group's dedicated threads, so it
+    completes while a default-pool call is still blocking; call-site
+    .options(concurrency_group=...) overrides too."""
+    import os
+
+    flag = str(tmp_path / "unblock")
+
+    @ray_tpu.remote(concurrency_groups={"io": 1})
+    class Server:
+        def blocker(self, path):
+            import time as _t
+
+            t0 = _t.time()
+            while not os.path.exists(path) and _t.time() - t0 < 30:
+                _t.sleep(0.05)
+            return "unblocked"
+
+        @ray_tpu.method(concurrency_group="io")
+        def ping(self):
+            return "pong"
+
+        def plain(self):
+            return "plain"
+
+    s = Server.remote()
+    blocked = s.blocker.remote(flag)
+    time.sleep(0.3)  # let blocker occupy the single default thread
+    # annotated method rides the io pool: completes despite the blocker
+    assert ray_tpu.get(s.ping.remote(), timeout=10) == "pong"
+    # unannotated method, call-site override onto the io pool
+    assert ray_tpu.get(
+        s.plain.options(concurrency_group="io").remote(), timeout=10) \
+        == "plain"
+    with open(flag, "w"):
+        pass
+    assert ray_tpu.get(blocked, timeout=30) == "unblocked"
