@@ -290,9 +290,8 @@ class CoreWorker:
         # actor concurrency groups: name -> dedicated queue (reference
         # actor.py:65; threads started in _init_actor)
         self._group_queues: Dict[str, "queue.Queue[TaskSpec]"] = {}
-        # default-pool threads tracked separately: group threads also live
-        # in _exec_threads, and sizing the default pool off the combined
-        # list would under-spawn it
+        # default-pool threads (group pools track nothing: their threads
+        # are daemons sized once at creation)
         self._default_exec_threads: List[threading.Thread] = []
         self._executing_count = 0
         # executing+queued actor tasks excluding control-plane probes, so a
@@ -301,9 +300,7 @@ class CoreWorker:
         self._exec_count_lock = threading.Lock()
         self._profile_flush_lock = threading.Lock()
         self._profile_events_sent = 0
-        self._exec_threads: List[threading.Thread] = []
         self._exec_threads_lock = threading.Lock()
-        self._num_exec_threads = 1
         self._shutdown = threading.Event()
 
         self.raylet = rpc.connect_with_retry(
@@ -1971,14 +1968,18 @@ class CoreWorker:
         (method.options(concurrency_group=...)) wins, else the method's
         @method(concurrency_group=...) annotation; unknown names fall back
         to the default pool rather than stranding the call."""
-        if not self._group_queues:
-            return None
         group = spec.concurrency_group
         if group is None and self._actor_instance is not None:
             fn = getattr(type(self._actor_instance), spec.method_name, None)
             group = getattr(fn, "_ray_tpu_method_opts", {}).get(
                 "concurrency_group")
-        return group if group in self._group_queues else None
+        if group is not None and group not in self._group_queues:
+            # a typo'd group must FAIL the call, not silently land in the
+            # default pool it was trying to escape (reference raises too)
+            raise ValueError(
+                f"actor has no concurrency group {group!r} "
+                f"(declared: {sorted(self._group_queues) or 'none'})")
+        return group
 
     def _enqueue_actor_task(self, spec: TaskSpec) -> None:
         # Load accounting happens HERE — only for tasks that actually enter
@@ -1987,7 +1988,31 @@ class CoreWorker:
         if spec.method_name not in self._PROBE_METHODS:
             with self._exec_count_lock:
                 self._load_count += 1
-        group = self._actor_group_for(spec)
+        try:
+            group = self._actor_group_for(spec)
+        except ValueError as e:
+            # report the error to the caller's return objects; raising in
+            # the push handler would vanish silently (pushes have no reply)
+            with self._exec_count_lock:
+                if spec.method_name not in self._PROBE_METHODS:
+                    self._load_count -= 1  # undo the accounting above
+            blob = serialization.dumps(
+                TaskError.from_exception(spec.method_name, e))
+            results = [("error", oid, blob)
+                       for oid in spec.return_object_ids()]
+            try:
+                if spec.owner_address == self.address:
+                    self.rpc_report_task_result(
+                        None, 0, {"task_id": spec.task_id,
+                                  "results": results})
+                else:
+                    self.peer(spec.owner_address).notify(
+                        "report_task_result",
+                        {"task_id": spec.task_id, "results": results})
+            except Exception:
+                logger.warning("could not report bad-group error for %s",
+                               spec.method_name)
+            return
         (self._group_queues[group] if group else self._task_queue).put(spec)
 
     def rpc_push_actor_task(self, conn, req_id, payload) -> None:
@@ -2037,11 +2062,9 @@ class CoreWorker:
             for gname, gsize in (spec.concurrency_groups or {}).items():
                 q: "queue.Queue[TaskSpec]" = queue.Queue()
                 self._group_queues[gname] = q
-                group_threads: List[threading.Thread] = []
                 with self._exec_threads_lock:
                     for _ in range(max(1, int(gsize))):
-                        self._spawn_exec_thread(q, f"task-exec-{gname}",
-                                                group_threads)
+                        self._spawn_exec_thread(q, f"task-exec-{gname}")
             self._start_exec_threads(max(1, spec.max_concurrency))
             # spec included so a GCS that restarted DURING our __init__ (and
             # so never saw the registration) can rebuild the actor record.
@@ -2084,14 +2107,14 @@ class CoreWorker:
                                         self._default_exec_threads)
 
     def _spawn_exec_thread(self, q: "queue.Queue", name: str,
-                           tracking: List[threading.Thread]) -> None:
+                           tracking: Optional[List[threading.Thread]] = None
+                           ) -> None:
         """Caller holds _exec_threads_lock."""
         t = threading.Thread(target=self._exec_loop, args=(q,),
                              name=name, daemon=True)
         t.start()
-        tracking.append(t)
-        if tracking is not self._exec_threads:
-            self._exec_threads.append(t)
+        if tracking is not None:
+            tracking.append(t)
 
     def _exec_loop(self, q: Optional["queue.Queue"] = None) -> None:
         q = q if q is not None else self._task_queue
