@@ -3,10 +3,12 @@ from ray_tpu.rllib.env import (
 from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.learner import Learner, LearnerGroup, delayed
 from ray_tpu.rllib.rl_module import (
-    Categorical, DiscreteActorCriticModule, QModule, RLModule)
+    Categorical, Deterministic, DeterministicPolicyModule,
+    DiscreteActorCriticModule, QModule, RecurrentQModule, RLModule,
+    SquashedGaussian, SquashedGaussianModule)
 from ray_tpu.rllib.connectors import (
     ArgmaxAction, CastObsFloat32, ClipAction, Connector, ConnectorPipeline,
-    EpsilonGreedy, GaussianNoise, SampleAction)
+    EpsilonGreedy, GaussianNoise, RandomActions, SampleAction)
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.a2c import A2C, A2CConfig
 from ray_tpu.rllib.appo import APPO, APPOConfig

@@ -21,6 +21,7 @@ import numpy as np
 __all__ = [
     "Connector", "ConnectorPipeline", "CastObsFloat32", "SampleAction",
     "ArgmaxAction", "EpsilonGreedy", "GaussianNoise", "ClipAction",
+    "RandomActions",
 ]
 
 
@@ -70,13 +71,19 @@ class CastObsFloat32(Connector):
 
 class SampleAction(Connector):
     """Sample from the module's action distribution; records "logp" (what
-    on-policy losses need)."""
+    on-policy losses need). Off-policy pipelines that never consume logp
+    (SAC/DDPG replay) pass record_logp=False to keep it off the per-step
+    hot path."""
+
+    def __init__(self, record_logp: bool = True):
+        self.record_logp = record_logp
 
     def __call__(self, data):
         dist = data["module"].action_dist(data["fwd_out"])
         actions = dist.sample(data["rng"])
         data["actions"] = actions
-        data["logp"] = np.asarray(dist.logp(actions), np.float32)
+        if self.record_logp:
+            data["logp"] = np.asarray(dist.logp(actions), np.float32)
         return data
 
 
@@ -108,7 +115,12 @@ class EpsilonGreedy(Connector):
         dist = data["module"].action_dist(data["fwd_out"])
         greedy = dist.argmax()
         rng: np.random.Generator = data["rng"]
-        eps = self.epsilon(int(data.get("timestep", 0)))
+        # algorithms that schedule epsilon centrally (DQN anneals per
+        # training iteration, not per env timestep) force it per call
+        if "epsilon_override" in data:
+            eps = float(data["epsilon_override"])
+        else:
+            eps = self.epsilon(int(data.get("timestep", 0)))
         explore = rng.random(len(greedy)) < eps
         randoms = rng.integers(0, self.num_actions, size=len(greedy))
         data["actions"] = np.where(explore, randoms, greedy).astype(np.int32)
@@ -140,4 +152,22 @@ class ClipAction(Connector):
     def __call__(self, data):
         data["actions"] = np.clip(np.asarray(data["actions"]),
                                   self.low, self.high)
+        return data
+
+
+class RandomActions(Connector):
+    """Uniform random actions — the warmup phase of off-policy continuous
+    algorithms (SAC/DDPG learning_starts), run INSTEAD of the module
+    forward (reference Random exploration,
+    rllib/utils/exploration/random.py)."""
+
+    def __init__(self, action_dim: int, low: float, high: float):
+        self.action_dim = action_dim
+        self.low = low
+        self.high = high
+
+    def __call__(self, data):
+        n = len(data["obs"])
+        data["actions"] = data["rng"].uniform(
+            self.low, self.high, (n, self.action_dim)).astype(np.float32)
         return data

@@ -16,7 +16,7 @@ import numpy as np
 import ray_tpu
 from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.env import PendulumEnv
-from ray_tpu.rllib.models import init_mlp, mlp_forward, mlp_forward_np
+from ray_tpu.rllib.models import init_mlp, mlp_forward
 from ray_tpu.rllib.replay_buffers import ReplayBuffer
 from ray_tpu.rllib.learner import Learner, delayed
 from ray_tpu.rllib.sac import ContinuousWorkerBase, q_value
@@ -45,19 +45,29 @@ def actor_apply(actor_params, obs, max_action: float):
 
 @ray_tpu.remote
 class NoisyActorWorker(ContinuousWorkerBase):
-    """Env actor: deterministic policy + Gaussian exploration noise."""
+    """Env actor for DDPG/TD3: DeterministicPolicyModule + the
+    SampleAction -> GaussianNoise connector pipeline (exploration is a
+    pipeline edit, not worker code)."""
 
     def __init__(self, env_maker, num_envs: int, seed: int, obs_dim: int,
                  action_dim: int, max_action: float, noise_scale: float):
+        self.noise_scale = noise_scale
         super().__init__(env_maker, num_envs, seed, obs_dim, action_dim,
                          max_action)
-        self.noise_scale = noise_scale
 
-    def _select_actions(self, obs: np.ndarray) -> np.ndarray:
-        mean = np.tanh(mlp_forward_np(self.actor, obs)) * self.max_action
-        noise = self.rng.standard_normal((len(obs), self.action_dim)) \
-            * self.noise_scale * self.max_action
-        return np.clip(mean + noise, -self.max_action, self.max_action)
+    def _make_module(self, obs_dim, action_dim, max_action):
+        from ray_tpu.rllib.rl_module import DeterministicPolicyModule
+
+        return DeterministicPolicyModule(obs_dim, action_dim, max_action)
+
+    def _make_module_to_env(self):
+        from ray_tpu.rllib.connectors import (ConnectorPipeline,
+                                              GaussianNoise, SampleAction)
+
+        return ConnectorPipeline([
+            SampleAction(record_logp=False),
+            GaussianNoise(self.noise_scale * self.max_action,
+                          -self.max_action, self.max_action)])
 
 
 class DDPGLearner(Learner):

@@ -19,7 +19,7 @@ from ray_tpu.rllib.learner import Learner
 from ray_tpu.rllib.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 
 
-from ray_tpu.rllib.models import init_mlp, mlp_forward, mlp_forward_np
+from ray_tpu.rllib.models import init_mlp, mlp_forward
 
 
 def init_q_params(rng_seed: int, obs_dim: int, num_actions: int,
@@ -33,20 +33,34 @@ def q_apply(params, obs, n_layers: int = 3):
     return mlp_forward(params, obs, n_layers)
 
 
-_q_apply_np = mlp_forward_np
-
-
 @ray_tpu.remote
 class EpsilonGreedyWorker:
-    """Env-stepping actor collecting transitions under epsilon-greedy."""
+    """Env-stepping actor collecting transitions under epsilon-greedy.
+
+    Acting is MODULE + CONNECTORS (reference EnvRunner + connector
+    pipelines): the worker owns a `QModule` and the `EpsilonGreedy`
+    module-to-env connector — no hand-rolled action selection. The
+    algorithm's per-iteration epsilon schedule is forwarded per sample
+    call as an override on the connector."""
 
     def __init__(self, env_maker, num_envs: int, seed: int, obs_dim: int,
-                 num_actions: int):
+                 num_actions: int, module=None, env_to_module=None,
+                 module_to_env=None):
+        from ray_tpu.rllib.connectors import (CastObsFloat32,
+                                              ConnectorPipeline,
+                                              EpsilonGreedy)
+        from ray_tpu.rllib.rl_module import QModule
+
         self.vec = VectorEnv(env_maker, num_envs, seed)
         self.obs = self.vec.reset()
         self.rng = np.random.default_rng(seed)
         self.params = None
         self.num_actions = num_actions
+        self.module = module or QModule(obs_dim, num_actions)
+        self.env_to_module = env_to_module or ConnectorPipeline(
+            [CastObsFloat32()])
+        self.module_to_env = module_to_env or ConnectorPipeline(
+            [EpsilonGreedy(num_actions)])
         self._ep_returns = np.zeros(num_envs, np.float32)
         self._completed: List[float] = []
 
@@ -55,14 +69,15 @@ class EpsilonGreedyWorker:
         return True
 
     def sample(self, num_steps: int, epsilon: float) -> Dict[str, np.ndarray]:
-        N = self.vec.num_envs
         cols = {k: [] for k in ("obs", "actions", "rewards", "next_obs", "dones")}
         for _ in range(num_steps):
-            q = _q_apply_np(self.params, self.obs)
-            greedy = q.argmax(-1)
-            explore = self.rng.random(N) < epsilon
-            random_a = self.rng.integers(0, self.num_actions, N)
-            actions = np.where(explore, random_a, greedy)
+            data = {"obs": self.obs, "rng": self.rng, "module": self.module,
+                    "params": self.params, "epsilon_override": epsilon}
+            data = self.env_to_module(data)
+            data["fwd_out"] = self.module.forward_inference(self.params,
+                                                            data["obs"])
+            data = self.module_to_env(data)
+            actions = data["actions"]
             prev_obs = self.obs
             self.obs, rewards, dones, _ = self.vec.step(actions)
             cols["obs"].append(prev_obs)

@@ -26,11 +26,18 @@ def init_mlp(rng: np.random.Generator, sizes: Sequence[int],
 
 
 def mlp_hidden(params: Dict[str, Any], x, n_hidden: int):
-    """tanh trunk through the first n_hidden layers. jnp or numpy."""
-    import jax.numpy as jnp
+    """tanh trunk through the first n_hidden layers. Dispatches on the
+    INPUT type: numpy stays numpy (env-stepping actors never touch jax on
+    their per-step hot path), traced/jax inputs use jnp (learner losses
+    under jit)."""
+    if isinstance(x, np.ndarray):
+        xp = np
+    else:
+        import jax.numpy as jnp
 
+        xp = jnp
     for i in range(n_hidden):
-        x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
+        x = xp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
     return x
 
 

@@ -3,14 +3,13 @@
 Mirrors the reference's R2D2 (`rllib/algorithms/r2d2/`): an LSTM-style
 recurrent Q network trained on stored *sequences* with burn-in — the first
 `burn_in` steps of each sampled sequence only rebuild the recurrent state
-(no gradient), the remainder takes double-DQN TD updates. The recurrent
-cell is a GRU (one gate fewer than LSTM, same episodic-memory capability,
-friendlier to the MXU: all gates are two fused matmuls).
+(no gradient), the remainder takes double-DQN TD updates.
 
-The env for learning tests is a memory task (`MemoryCorridorEnv`): the
-first observation carries a cue that disappears immediately and must be
-recalled at the corridor's end — feedforward DQN cannot beat chance on it,
-a recurrent learner can.
+The network is a `RecurrentQModule` (GRU with explicit state in/out) and
+BOTH paths ride it: acting steps `forward_inference(params, obs, state)`
+through the EpsilonGreedy connector pipeline, training unrolls the same
+cell under jit inside an `R2D2Learner` on the Learner stack — the
+recurrent proof that the module/connector contract is not MLP-only.
 """
 
 from __future__ import annotations
@@ -20,6 +19,7 @@ from typing import Any, Callable, Dict, List, Tuple
 import numpy as np
 
 from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.learner import Learner
 
 
 class MemoryCorridorEnv:
@@ -49,6 +49,69 @@ class MemoryCorridorEnv:
             return obs, 0.0, False, {}
         r = 1.0 if action == self._cue else -1.0
         return obs, r, True, {}
+
+
+class R2D2Learner(Learner):
+    """Burn-in double-DQN sequence loss over `RecurrentQModule.unroll`
+    (reference r2d2_torch_policy.py `r2d2_loss`): the first `burn_in`
+    steps rebuild hidden state without gradient, then one EXTENDED unroll
+    [obs[bi:], final next_obs] yields both taken-action and next-state Q
+    values with non-stale hidden state. The target net rides as the
+    Learner's `extra` pytree, synced by aliasing (params pytrees are
+    immutable)."""
+
+    def __init__(self, module, lr: float, gamma: float, burn_in: int,
+                 seed: int = 0, mesh=None):
+        self.module = module
+        self._gamma = gamma
+        self._burn_in = burn_in
+        super().__init__(lr=lr, mesh=mesh, seed=seed)
+
+    def init_params(self, seed: int):
+        return self.module.init_params(seed)
+
+    def make_extra(self):
+        return self.params
+
+    def loss(self, params, batch, extra, rng):
+        import jax
+        import jax.numpy as jnp
+
+        m, bi, tp = self.module, self._burn_in, extra
+        B = batch["obs"].shape[0]
+        h0 = jnp.zeros((B, m.hidden))
+        # burn-in: rebuild recurrent state without gradients
+        _, h_start = m.unroll(jax.lax.stop_gradient(params),
+                              batch["obs"][:, :bi], h0)
+        h_start = jax.lax.stop_gradient(h_start)
+        _, ht_start = m.unroll(tp, batch["obs"][:, :bi], h0)
+        # one extended pass: [obs[bi:], final next_obs]. Since
+        # next_obs[t] == obs[t+1], q_ext[:, 1:] are the next-state values
+        # evaluated with the CORRECT (non-stale) hidden state.
+        ext = jnp.concatenate(
+            [batch["obs"][:, bi:], batch["next_obs"][:, -1:]], axis=1)
+        q_ext, _ = m.unroll(params, ext, h_start)       # [B, T'+1, A]
+        q_taken = jnp.take_along_axis(
+            q_ext[:, :-1],
+            batch["actions"][:, bi:, None].astype(jnp.int32), axis=-1)[..., 0]
+        # double DQN: online picks the argmax, target evaluates
+        a_star = jnp.argmax(q_ext[:, 1:], axis=-1)
+        q_ext_t, _ = m.unroll(tp, ext, ht_start)
+        next_q = jnp.take_along_axis(
+            q_ext_t[:, 1:], a_star[..., None], axis=-1)[..., 0]
+        target = batch["rewards"][:, bi:] + self._gamma * \
+            (1 - batch["dones"][:, bi:]) * jax.lax.stop_gradient(next_q)
+        mask = batch["mask"][:, bi:]
+        td = (q_taken - target) * mask
+        loss = (td ** 2).sum() / jnp.maximum(mask.sum(), 1.0)
+        return loss, {}
+
+    def sync_target(self) -> None:
+        self.extra = self.params
+
+    def set_weights(self, weights):
+        super().set_weights(weights)
+        self.extra = self.params
 
 
 class R2D2Config:
@@ -89,94 +152,27 @@ class R2D2Config:
 
 class R2D2(Algorithm):
     def setup(self, config: Dict[str, Any]) -> None:
-        import jax
-        import jax.numpy as jnp
-        import optax
+        from ray_tpu.rllib.connectors import (CastObsFloat32,
+                                              ConnectorPipeline,
+                                              EpsilonGreedy)
+        from ray_tpu.rllib.rl_module import RecurrentQModule
 
         cfg: R2D2Config = config.get("r2d2_config") or R2D2Config()
         self.cfg = cfg
         self.env = cfg.env_maker(cfg.seed)
-        rng = np.random.default_rng(cfg.seed)
-        self._np_rng = rng
-        h, d, A = cfg.hidden, cfg.obs_dim, cfg.num_actions
-
-        def glorot(m, n):
-            return (rng.standard_normal((m, n)) *
-                    np.sqrt(2.0 / (m + n))).astype(np.float32)
-
-        self.params = jax.tree_util.tree_map(jnp.asarray, {
-            "wxz": glorot(d, h), "whz": glorot(h, h), "bz": np.zeros(h, np.float32),
-            "wxr": glorot(d, h), "whr": glorot(h, h), "br": np.zeros(h, np.float32),
-            "wxn": glorot(d, h), "whn": glorot(h, h), "bn": np.zeros(h, np.float32),
-            "wq": glorot(h, A), "bq": np.zeros(A, np.float32),
-        })
-        self.target = jax.device_get(self.params)
-        self.optimizer = optax.adam(cfg.lr)
-        self.opt_state = self.optimizer.init(self.params)
+        self._np_rng = np.random.default_rng(cfg.seed)
+        self.module = RecurrentQModule(cfg.obs_dim, cfg.num_actions,
+                                       cfg.hidden)
+        self.learner = R2D2Learner(self.module, cfg.lr, cfg.gamma,
+                                   cfg.burn_in, cfg.seed)
+        self.env_to_module = ConnectorPipeline([CastObsFloat32()])
+        self.module_to_env = ConnectorPipeline(
+            [EpsilonGreedy(cfg.num_actions)])
+        # host-side numpy copy of the params for env-stepping
+        self._acting_params = self.learner.get_weights()
         # sequence-major replay: each row is one [seq_len] slice
         self._sequences: List[dict] = []
         self._reward_hist: List[float] = []
-
-        def gru_cell(p, hprev, x):
-            z = jax.nn.sigmoid(x @ p["wxz"] + hprev @ p["whz"] + p["bz"])
-            r = jax.nn.sigmoid(x @ p["wxr"] + hprev @ p["whr"] + p["br"])
-            n = jnp.tanh(x @ p["wxn"] + (r * hprev) @ p["whn"] + p["bn"])
-            return (1 - z) * n + z * hprev
-
-        def q_seq(p, obs_seq, h0):
-            """obs_seq [B,T,d], h0 [B,h] -> (q [B,T,A], h_T)."""
-            def body(hc, x):
-                hc = gru_cell(p, hc, x)
-                return hc, hc
-
-            hT, hs = jax.lax.scan(body, h0, obs_seq.swapaxes(0, 1))
-            hs = hs.swapaxes(0, 1)                      # [B,T,h]
-            return hs @ p["wq"] + p["bq"], hT
-
-        self._gru_cell = gru_cell
-
-        def loss_fn(p, tp, batch):
-            B = batch["obs"].shape[0]
-            h0 = jnp.zeros((B, h))
-            # burn-in: rebuild recurrent state without gradients
-            bi = cfg.burn_in
-            _, h_start = q_seq(jax.lax.stop_gradient(p),
-                               batch["obs"][:, :bi], h0)
-            h_start = jax.lax.stop_gradient(h_start)
-            _, ht_start = q_seq(tp, batch["obs"][:, :bi], h0)
-            # one extended pass: [obs[bi:], final next_obs]. Since
-            # next_obs[t] == obs[t+1], q_ext[:, 1:] are the next-state
-            # values evaluated with the CORRECT (non-stale) hidden state.
-            ext = jnp.concatenate(
-                [batch["obs"][:, bi:], batch["next_obs"][:, -1:]], axis=1)
-            q_ext, _ = q_seq(p, ext, h_start)           # [B,T'+1,A]
-            q_taken = jnp.take_along_axis(
-                q_ext[:, :-1], batch["actions"][:, bi:, None],
-                axis=-1)[..., 0]
-            # double DQN: online picks the argmax, target evaluates
-            a_star = jnp.argmax(q_ext[:, 1:], axis=-1)
-            q_ext_t, _ = q_seq(tp, ext, ht_start)
-            next_q = jnp.take_along_axis(
-                q_ext_t[:, 1:], a_star[..., None], axis=-1)[..., 0]
-            target = batch["rewards"][:, bi:] + cfg.gamma * \
-                (1 - batch["dones"][:, bi:]) * jax.lax.stop_gradient(next_q)
-            mask = batch["mask"][:, bi:]
-            td = (q_taken - target) * mask
-            return (td ** 2).sum() / jnp.maximum(mask.sum(), 1.0)
-
-        def update(p, opt_state, tp, batch):
-            loss, grads = jax.value_and_grad(loss_fn)(p, tp, batch)
-            updates, opt_state = self.optimizer.update(grads, opt_state, p)
-            return optax.apply_updates(p, updates), opt_state, loss
-
-        def act_step(p, hc, x):
-            hc = gru_cell(p, hc, x)
-            return hc, hc @ p["wq"] + p["bq"]
-
-        self._update = jax.jit(update)
-        self._act_step = jax.jit(act_step)
-        self._jax = jax
-        self._jnp = jnp
 
     # ----------------------------------------------------------- rollouts
     def _epsilon(self) -> float:
@@ -185,19 +181,25 @@ class R2D2(Algorithm):
         return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
 
     def _collect_episode(self, epsilon: float, store: bool = True) -> float:
-        cfg, jnp = self.cfg, self._jnp
+        cfg = self.cfg
         env = self.env
         obs = env.reset()
-        hc = jnp.zeros((1, cfg.hidden))
+        state = self.module.get_initial_state(1)
         rows = {k: [] for k in ("obs", "actions", "rewards", "next_obs",
                                 "dones")}
         total = 0.0
         for _ in range(cfg.max_episode_steps):
-            hc, q = self._act_step(self.params, hc, jnp.asarray(obs[None]))
-            if epsilon > 0 and self._np_rng.random() < epsilon:
-                a = int(self._np_rng.integers(cfg.num_actions))
-            else:
-                a = int(np.asarray(q)[0].argmax())
+            data = {"obs": np.asarray(obs, np.float32)[None],
+                    "rng": self._np_rng, "module": self.module,
+                    "params": self._acting_params,
+                    "epsilon_override": epsilon}
+            data = self.env_to_module(data)
+            fwd = self.module.forward_inference(
+                self._acting_params, data["obs"], state=state)
+            data["fwd_out"] = fwd
+            data = self.module_to_env(data)
+            a = int(data["actions"][0])
+            state = np.asarray(fwd["state_out"])
             nxt, r, done, _ = env.step(a)
             rows["obs"].append(obs)
             rows["actions"].append(a)
@@ -255,13 +257,13 @@ class R2D2(Algorithm):
                 idx = self._np_rng.integers(0, len(self._sequences),
                                             cfg.train_batch_size)
                 rows = [self._sequences[i] for i in idx]
-                batch = {k: self._jnp.asarray(np.stack([r[k] for r in rows]))
+                batch = {k: np.stack([r[k] for r in rows])
                          for k in rows[0]}
-                self.params, self.opt_state, loss = self._update(
-                    self.params, self.opt_state, self.target, batch)
-                losses.append(float(loss))
+                aux = self.learner.update(batch)
+                losses.append(float(aux["total_loss"]))
             if self.iteration % cfg.target_update_interval == 0:
-                self.target = self._jax.device_get(self.params)
+                self.learner.sync_target()
+            self._acting_params = self.learner.get_weights()
         return {
             "episode_reward_mean": float(np.mean(self._reward_hist)),
             "epsilon": eps,
@@ -274,8 +276,8 @@ class R2D2(Algorithm):
                               for _ in range(episodes)]))
 
     def get_weights(self):
-        return self._jax.device_get(self.params)
+        return self.learner.get_weights()
 
     def set_weights(self, weights) -> None:
-        self.params = self._jax.tree_util.tree_map(self._jnp.asarray, weights)
-        self.target = self._jax.device_get(self.params)
+        self.learner.set_weights(weights)
+        self._acting_params = self.learner.get_weights()

@@ -22,7 +22,9 @@ import numpy as np
 from ray_tpu.rllib.models import init_mlp, mlp_forward, mlp_hidden
 
 __all__ = [
-    "Categorical", "RLModule", "DiscreteActorCriticModule", "QModule",
+    "Categorical", "SquashedGaussian", "Deterministic", "RLModule",
+    "DiscreteActorCriticModule", "QModule", "SquashedGaussianModule",
+    "DeterministicPolicyModule", "RecurrentQModule",
 ]
 
 
@@ -72,6 +74,74 @@ class Categorical:
 
     def argmax(self) -> np.ndarray:
         return np.asarray(self.logits).argmax(-1).astype(np.int32)
+
+
+class SquashedGaussian:
+    """tanh-squashed diagonal Gaussian over `concat([mean, log_std])`
+    inputs, scaled to [-max_action, max_action] (SAC's acting policy;
+    reference rllib/models/tf/tf_distributions.py TfSquashedGaussian).
+    The learner's reparameterized path keeps its own jax sampler (it
+    needs the pre-squash value for the exact log-prob); this distribution
+    serves the HOST-SIDE acting path."""
+
+    LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+    def __init__(self, inputs, max_action: float = 1.0):
+        inputs = np.asarray(inputs)
+        d = inputs.shape[-1] // 2
+        self.mean = inputs[..., :d]
+        self.log_std = np.clip(inputs[..., d:],
+                               self.LOG_STD_MIN, self.LOG_STD_MAX)
+        self.max_action = max_action
+
+    def sample(self, rng: np.random.Generator) -> np.ndarray:
+        pre = self.mean + np.exp(self.log_std) \
+            * rng.standard_normal(self.mean.shape)
+        return (np.tanh(pre) * self.max_action).astype(np.float32)
+
+    def argmax(self) -> np.ndarray:
+        """Mode: the squashed mean (evaluation-time deterministic act)."""
+        return (np.tanh(self.mean) * self.max_action).astype(np.float32)
+
+    def logp(self, actions) -> np.ndarray:
+        """Change-of-variables log-prob; recovers the pre-squash value by
+        atanh (clipped away from the +-1 boundary)."""
+        a = np.clip(np.asarray(actions) / self.max_action,
+                    -1.0 + 1e-6, 1.0 - 1e-6)
+        pre = np.arctanh(a)
+        std = np.exp(self.log_std)
+        z = (pre - self.mean) / std
+        logp = (-0.5 * (z ** 2 + 2 * self.log_std + np.log(2 * np.pi))).sum(-1)
+        # d tanh(x)/dx = 1 - tanh(x)^2; stable softplus form
+        logp -= (2 * (np.log(2.0) - pre
+                      - np.logaddexp(0.0, -2.0 * pre))).sum(-1)
+        return logp.astype(np.float32)
+
+    def entropy(self) -> np.ndarray:
+        """Pre-squash Gaussian entropy (the squash correction has no closed
+        form; this is the standard surrogate)."""
+        return (self.log_std + 0.5 * np.log(2 * np.pi * np.e)).sum(-1)
+
+
+class Deterministic:
+    """Point-mass distribution: DDPG/TD3 actors emit the action directly;
+    exploration noise is a CONNECTOR, not part of the distribution
+    (reference rllib/models/distributions.py Deterministic)."""
+
+    def __init__(self, actions):
+        self.actions = actions
+
+    def sample(self, rng: np.random.Generator) -> np.ndarray:
+        return np.asarray(self.actions, np.float32)
+
+    def argmax(self) -> np.ndarray:
+        return np.asarray(self.actions, np.float32)
+
+    def logp(self, actions) -> np.ndarray:
+        return np.zeros(np.asarray(actions).shape[:-1], np.float32)
+
+    def entropy(self) -> np.ndarray:
+        return np.zeros(np.asarray(self.actions).shape[:-1], np.float32)
 
 
 # ----------------------------------------------------------------- modules
@@ -153,7 +223,9 @@ class QModule(RLModule):
                         final_scale=np.sqrt(2.0 / self.hidden[-1]))
 
     def _apply(self, params, obs):
-        return mlp_forward(params, obs, len(self.hidden) + 1)
+        # depth inferred from params (w0/b0 ... pairs), so a learner with a
+        # different hidden stack than the module default still applies fully
+        return mlp_forward(params, obs, len(params) // 2)
 
     def forward_inference(self, params, obs) -> Dict[str, Any]:
         return {"action_dist_inputs": self._apply(params, obs)}
@@ -161,6 +233,150 @@ class QModule(RLModule):
     def forward_train(self, params, batch) -> Dict[str, Any]:
         return {"q": self._apply(params, batch["obs"]),
                 "q_next": self._apply(params, batch["next_obs"])}
+
+    def action_dist(self, fwd_out) -> Categorical:
+        return Categorical(fwd_out["action_dist_inputs"])
+
+
+class SquashedGaussianModule(RLModule):
+    """Continuous stochastic actor: MLP -> concat(mean, log_std), squashed
+    tanh-Gaussian — SAC's acting module (reference SACTorchRLModule). The
+    SAC learner keeps its own jax reparameterized sampler over the SAME
+    params; this module is the worker-side acting contract."""
+
+    def __init__(self, obs_dim: int, action_dim: int, max_action: float,
+                 hidden: Tuple[int, ...] = (256, 256)):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.max_action = max_action
+        self.hidden = tuple(hidden)
+
+    def init_params(self, seed: int) -> Dict[str, Any]:
+        rng = np.random.default_rng(seed)
+        return init_mlp(rng, (self.obs_dim, *self.hidden, 2 * self.action_dim),
+                        final_scale=0.01)
+
+    def forward_inference(self, params, obs) -> Dict[str, Any]:
+        # depth from params, matching sac.actor_dist's len(params)//2 rule
+        out = mlp_forward(params, obs, len(params) // 2)
+        return {"action_dist_inputs": out}
+
+    def forward_train(self, params, batch) -> Dict[str, Any]:
+        return self.forward_inference(params, batch["obs"])
+
+    def action_dist(self, fwd_out) -> SquashedGaussian:
+        return SquashedGaussian(fwd_out["action_dist_inputs"],
+                                self.max_action)
+
+
+class DeterministicPolicyModule(RLModule):
+    """Deterministic continuous actor: tanh(MLP) * max_action — the module
+    under DDPG/TD3 (reference DDPGTorchModel); exploration noise is the
+    GaussianNoise connector, not baked into the network."""
+
+    def __init__(self, obs_dim: int, action_dim: int, max_action: float,
+                 hidden: Tuple[int, ...] = (256, 256)):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.max_action = max_action
+        self.hidden = tuple(hidden)
+
+    def init_params(self, seed: int) -> Dict[str, Any]:
+        rng = np.random.default_rng(seed)
+        return init_mlp(rng, (self.obs_dim, *self.hidden, self.action_dim),
+                        final_scale=0.01)
+
+    def forward_inference(self, params, obs) -> Dict[str, Any]:
+        out = mlp_forward(params, obs, len(params) // 2)
+        xp = _xp(out)
+        return {"action_dist_inputs": xp.tanh(out) * self.max_action}
+
+    def forward_train(self, params, batch) -> Dict[str, Any]:
+        return self.forward_inference(params, batch["obs"])
+
+    def action_dist(self, fwd_out) -> Deterministic:
+        return Deterministic(fwd_out["action_dist_inputs"])
+
+
+class RecurrentQModule(RLModule):
+    """GRU Q-network with EXPLICIT state in/out — the recurrent module
+    R2D2 acts and trains through (reference rllib/algorithms/r2d2/
+    r2d2_torch_policy.py; get_initial_state per rl_module.py). A GRU over
+    LSTM: one gate fewer, same episodic memory, and all gates are two fused
+    matmuls — friendlier to the MXU.
+
+    Acting calls `forward_inference(params, obs, state=h)` one step at a
+    time (numpy on env hosts); training calls `unroll` over [B, T]
+    sequences (jax lax.scan under jit). Both run the SAME cell math."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hidden: int = 32):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = hidden
+
+    def init_params(self, seed: int) -> Dict[str, Any]:
+        rng = np.random.default_rng(seed)
+        d, h, A = self.obs_dim, self.hidden, self.num_actions
+
+        def glorot(m, n):
+            return (rng.standard_normal((m, n))
+                    * np.sqrt(2.0 / (m + n))).astype(np.float32)
+
+        return {
+            "wxz": glorot(d, h), "whz": glorot(h, h),
+            "bz": np.zeros(h, np.float32),
+            "wxr": glorot(d, h), "whr": glorot(h, h),
+            "br": np.zeros(h, np.float32),
+            "wxn": glorot(d, h), "whn": glorot(h, h),
+            "bn": np.zeros(h, np.float32),
+            "wq": glorot(h, A), "bq": np.zeros(A, np.float32),
+        }
+
+    def get_initial_state(self, batch_size: int = 1) -> np.ndarray:
+        return np.zeros((batch_size, self.hidden), np.float32)
+
+    def _cell(self, params, h, x):
+        """One GRU step — numpy or jax by input type."""
+        xp = _xp(x)
+
+        def sigmoid(v):
+            return 1.0 / (1.0 + xp.exp(-v))
+
+        z = sigmoid(x @ params["wxz"] + h @ params["whz"] + params["bz"])
+        r = sigmoid(x @ params["wxr"] + h @ params["whr"] + params["br"])
+        n = xp.tanh(x @ params["wxn"] + (r * h) @ params["whn"]
+                    + params["bn"])
+        return (1 - z) * n + z * h
+
+    def forward_inference(self, params, obs, state=None) -> Dict[str, Any]:
+        if state is None:
+            state = self.get_initial_state(len(obs))
+        h = self._cell(params, state, obs)
+        return {"action_dist_inputs": h @ params["wq"] + params["bq"],
+                "state_out": h}
+
+    def unroll(self, params, obs_seq, h0):
+        """obs_seq [B, T, d], h0 [B, h] -> (q [B, T, A], h_T). jax-only
+        (training path; per-tick outputs stream as scan ys, never carry)."""
+        import jax
+        import jax.numpy as jnp
+
+        def body(hc, x):
+            hc = self._cell(params, hc, x)
+            return hc, hc
+
+        hT, hs = jax.lax.scan(body, h0, obs_seq.swapaxes(0, 1))
+        hs = hs.swapaxes(0, 1)                       # [B, T, h]
+        return hs @ params["wq"] + params["bq"], hT
+
+    def forward_train(self, params, batch) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        h0 = batch.get("state_in")
+        if h0 is None:
+            h0 = jnp.zeros((batch["obs"].shape[0], self.hidden))
+        q, hT = self.unroll(params, batch["obs"], h0)
+        return {"action_dist_inputs": q, "state_out": hT}
 
     def action_dist(self, fwd_out) -> Categorical:
         return Categorical(fwd_out["action_dist_inputs"])

@@ -16,7 +16,7 @@ import numpy as np
 import ray_tpu
 from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.env import ContinuousVectorEnv, PendulumEnv
-from ray_tpu.rllib.models import init_mlp, mlp_forward, mlp_forward_np
+from ray_tpu.rllib.models import init_mlp, mlp_forward
 from ray_tpu.rllib.learner import Learner
 from ray_tpu.rllib.replay_buffers import ReplayBuffer
 
@@ -69,37 +69,60 @@ def q_value(q_params, obs, action):
 
 class ContinuousWorkerBase:
     """Shared env-actor loop for continuous control: random warmup phase,
-    transition collection, episode-return bookkeeping. Subclasses implement
-    `_select_actions` (the exploration policy) on a numpy actor copy."""
+    transition collection, episode-return bookkeeping.
+
+    Acting is MODULE + CONNECTORS (reference EnvRunner + connector
+    pipelines): subclasses provide `_make_module` and `_make_module_to_env`
+    — the exploration policy is a pipeline edit (SampleAction for SAC's
+    stochastic actor; SampleAction+GaussianNoise for DDPG/TD3), and the
+    warmup phase is the RandomActions connector, not worker code."""
 
     def __init__(self, env_maker, num_envs: int, seed: int,
                  obs_dim: int, action_dim: int, max_action: float):
+        from ray_tpu.rllib.connectors import (CastObsFloat32,
+                                              ConnectorPipeline,
+                                              RandomActions)
+
         self.vec = ContinuousVectorEnv(env_maker, num_envs, seed)
         self.obs = self.vec.reset()
         self.rng = np.random.default_rng(seed)
-        self.actor = None
+        self.params = None
         self.action_dim = action_dim
         self.max_action = max_action
+        self.module = self._make_module(obs_dim, action_dim, max_action)
+        self.env_to_module = ConnectorPipeline([CastObsFloat32()])
+        self.module_to_env = self._make_module_to_env()
+        self.random_warmup = ConnectorPipeline(
+            [RandomActions(action_dim, -max_action, max_action)])
         self._ep_returns = np.zeros(num_envs, np.float32)
         self._completed: List[float] = []
 
     def set_weights(self, actor) -> bool:
-        self.actor = {k: np.asarray(v) for k, v in actor.items()}
+        self.params = {k: np.asarray(v) for k, v in actor.items()}
         return True
 
-    def _select_actions(self, obs: np.ndarray) -> np.ndarray:
+    def _make_module(self, obs_dim: int, action_dim: int, max_action: float):
         raise NotImplementedError
 
+    def _make_module_to_env(self):
+        raise NotImplementedError
+
+    def _act(self, random_policy: bool) -> np.ndarray:
+        data = {"obs": self.obs, "rng": self.rng, "module": self.module,
+                "params": self.params}
+        data = self.env_to_module(data)
+        if random_policy or self.params is None:
+            data = self.random_warmup(data)
+        else:
+            data["fwd_out"] = self.module.forward_inference(self.params,
+                                                            data["obs"])
+            data = self.module_to_env(data)
+        return np.asarray(data["actions"], np.float32)
+
     def sample(self, num_steps: int, random_policy: bool = False):
-        N = self.vec.num_envs
         cols = {k: [] for k in ("obs", "actions", "rewards", "next_obs", "dones")}
         for _ in range(num_steps):
-            if random_policy or self.actor is None:
-                actions = self.rng.uniform(
-                    -self.max_action, self.max_action, (N, self.action_dim))
-            else:
-                actions = self._select_actions(self.obs)
-            actions = actions.astype(np.float32)
+            actions = self._act(random_policy)
             prev = self.obs
             self.obs, rewards, dones, _ = self.vec.step(actions)
             cols["obs"].append(prev)
@@ -121,14 +144,17 @@ class ContinuousWorkerBase:
 
 @ray_tpu.remote
 class ContinuousSampleWorker(ContinuousWorkerBase):
-    """Env actor sampling with a numpy copy of the tanh-Gaussian policy."""
+    """Env actor for SAC: SquashedGaussianModule + SampleAction."""
 
-    def _select_actions(self, obs: np.ndarray) -> np.ndarray:
-        out = mlp_forward_np(self.actor, obs)
-        mean, log_std = out[..., :self.action_dim], out[..., self.action_dim:]
-        log_std = np.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
-        pre = mean + np.exp(log_std) * self.rng.standard_normal(mean.shape)
-        return np.tanh(pre) * self.max_action
+    def _make_module(self, obs_dim, action_dim, max_action):
+        from ray_tpu.rllib.rl_module import SquashedGaussianModule
+
+        return SquashedGaussianModule(obs_dim, action_dim, max_action)
+
+    def _make_module_to_env(self):
+        from ray_tpu.rllib.connectors import ConnectorPipeline, SampleAction
+
+        return ConnectorPipeline([SampleAction(record_logp=False)])
 
 
 class SACLearner(Learner):
