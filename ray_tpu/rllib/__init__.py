@@ -11,12 +11,17 @@ from ray_tpu.rllib.connectors import (
     EpsilonGreedy, GaussianNoise, RandomActions, SampleAction)
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.a2c import A2C, A2CConfig
+from ray_tpu.rllib.a3c import A3C, A3CConfig
+from ray_tpu.rllib.pg import PG, PGConfig
 from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig
+from ray_tpu.rllib.simple_q import SimpleQ, SimpleQConfig
+from ray_tpu.rllib.random_agent import RandomAgent, RandomAgentConfig
 from ray_tpu.rllib.impala import IMPALA, ImpalaConfig
 from ray_tpu.rllib.es import ES, ESConfig
 from ray_tpu.rllib.ars import ARS, ARSConfig
-from ray_tpu.rllib.apex import ApexDQN, ApexDQNConfig
+from ray_tpu.rllib.apex import (ApexDDPG, ApexDDPGConfig, ApexDQN,
+                                ApexDQNConfig)
 from ray_tpu.rllib.sac import SAC, SACConfig
 from ray_tpu.rllib.ddpg import DDPG, DDPGConfig, TD3, TD3Config
 from ray_tpu.rllib.offline import (

@@ -91,27 +91,58 @@ class ApexDQNConfig:
 
 
 class ApexDQN(Algorithm):
+    """The Ape-X anatomy as an extensible template: `_make_learner`,
+    `_make_workers`, `_issue_sample`, `_learner_update`, and
+    `_maybe_sync_target` are the algorithm-specific seams ApexDDPG
+    overrides (the reference derives apex_ddpg from apex_dqn the same
+    way)."""
+
     def setup(self, config: Dict[str, Any]) -> None:
-        cfg: ApexDQNConfig = config.get("apex_config") or ApexDQNConfig()
+        cfg = config.get("apex_config") or ApexDQNConfig()
         self.cfg = cfg
-        self.learner = DQNLearner(cfg.obs_dim, cfg.num_actions, cfg.lr,
-                                  cfg.gamma, cfg.seed)
+        self.learner = self._make_learner(cfg)
         self.replays = [
             ReplayActor.options(num_cpus=1).remote(
                 cfg.buffer_capacity // cfg.num_replay_shards,
                 cfg.replay_alpha, cfg.seed + i)
             for i in range(cfg.num_replay_shards)]
-        self.workers = [
+        self.workers = self._make_workers(cfg)
+        self._broadcast()
+        self._reward_history: List[float] = []
+        self._total_steps = 0
+        self._buffered = 0
+        self._pending: Dict[Any, int] = {}  # sample future -> worker index
+
+    # ------------------------------------------------------- subclass seams
+    def _make_learner(self, cfg):
+        return DQNLearner(cfg.obs_dim, cfg.num_actions, cfg.lr,
+                          cfg.gamma, cfg.seed)
+
+    def _make_workers(self, cfg) -> List[Any]:
+        self._epsilons = self._epsilon_ladder(cfg.num_rollout_workers)
+        return [
             EpsilonGreedyWorker.options(num_cpus=1).remote(
                 cfg.env_maker, cfg.num_envs_per_worker,
                 cfg.seed + 1000 * (i + 1), cfg.obs_dim, cfg.num_actions)
             for i in range(cfg.num_rollout_workers)]
-        self._epsilons = self._epsilon_ladder(cfg.num_rollout_workers)
-        self._broadcast()
-        self._reward_history: List[float] = []
-        self._total_steps = 0
-        self._pending: Dict[Any, int] = {}  # sample future -> worker index
 
+    def _issue_sample(self, i: int, wk):
+        return wk.sample.remote(self.cfg.rollout_fragment_length,
+                                self._epsilons[i])
+
+    def _learner_update(self, batch):
+        """One update; returns (loss, |td| priorities)."""
+        loss, td = self.learner.update_batch(batch)
+        return loss, np.abs(td)
+
+    def _maybe_sync_target(self) -> None:
+        if self.iteration % self.cfg.target_update_interval == 0:
+            self.learner.sync_target()
+
+    def _extra_stats(self) -> Dict[str, Any]:
+        return {"epsilons": list(self._epsilons)}
+
+    # -------------------------------------------------------------- driver
     def _epsilon_ladder(self, n: int) -> List[float]:
         cfg = self.cfg
         if n == 1:
@@ -132,9 +163,7 @@ class ApexDQN(Algorithm):
         # so rollout collection overlaps with the learner's update loop
         for i, wk in enumerate(self.workers):
             if not any(w == i for w in self._pending.values()):
-                fut = wk.sample.remote(cfg.rollout_fragment_length,
-                                       self._epsilons[i])
-                self._pending[fut] = i
+                self._pending[self._issue_sample(i, wk)] = i
         sizes = ray_tpu.get([r.size.remote() for r in self.replays])
         ready, _ = ray_tpu.wait(list(self._pending),
                                 num_returns=len(self._pending), timeout=0.05)
@@ -155,8 +184,9 @@ class ApexDQN(Algorithm):
         ray_tpu.get(store_futs)
         self._reward_history = self._reward_history[-100:]
 
+        self._buffered = int(sum(sizes) + n_stored)
         losses = []
-        if sum(sizes) + n_stored >= cfg.learning_starts:
+        if self._buffered >= cfg.learning_starts:
             for u in range(cfg.num_updates_per_step):
                 shard = self.replays[u % len(self.replays)]
                 batch = ray_tpu.get(shard.sample.remote(
@@ -164,20 +194,19 @@ class ApexDQN(Algorithm):
                 if batch is None:
                     continue
                 idx = batch.pop("batch_indexes")
-                loss, td = self.learner.update_batch(batch)
+                loss, priorities = self._learner_update(batch)
                 losses.append(loss)
-                shard.update_priorities.remote(idx, np.abs(td))
-            if self.iteration % cfg.target_update_interval == 0:
-                self.learner.sync_target()
+                shard.update_priorities.remote(idx, priorities)
+            self._maybe_sync_target()
             if self.iteration % cfg.broadcast_interval == 0:
                 self._broadcast()
         return {
             "episode_reward_mean": (float(np.mean(self._reward_history))
                                     if self._reward_history else 0.0),
-            "buffer_size": int(sum(sizes) + n_stored),
+            "buffer_size": self._buffered,
             "num_env_steps_sampled": self._total_steps,
             "loss": float(np.mean(losses)) if losses else float("nan"),
-            "epsilons": list(self._epsilons),
+            **self._extra_stats(),
         }
 
     def get_weights(self):
@@ -193,3 +222,112 @@ class ApexDQN(Algorithm):
                 ray_tpu.kill(a)
             except Exception:
                 pass
+
+
+class ApexDDPGConfig:
+    """Ape-X architecture around the DDPG learner
+    (reference `rllib/algorithms/apex_ddpg/apex_ddpg.py`)."""
+
+    def __init__(self):
+        from ray_tpu.rllib.env import PendulumEnv
+
+        self.env_maker: Callable[[int], Any] = lambda seed: PendulumEnv(seed)
+        self.obs_dim = PendulumEnv.observation_dim
+        self.action_dim = PendulumEnv.action_dim
+        self.max_action = PendulumEnv.max_action
+        self.num_rollout_workers = 3
+        self.num_envs_per_worker = 1
+        self.rollout_fragment_length = 32
+        self.num_replay_shards = 1
+        self.actor_lr = 1e-3
+        self.critic_lr = 1e-3
+        self.gamma = 0.99
+        self.tau = 0.005
+        self.twin_q = False
+        self.buffer_capacity = 100_000
+        self.replay_alpha = 0.6
+        self.replay_beta = 0.4
+        self.train_batch_size = 128
+        self.num_updates_per_step = 8
+        self.broadcast_interval = 1
+        # per-worker exploration-noise ladder (the continuous analog of
+        # Ape-X's epsilon ladder): worker i explores at base^(1+i/(N-1)*a)
+        self.base_noise = 0.4
+        self.noise_alpha = 3.0
+        self.learning_starts = 256
+        self.seed = 0
+
+    def rollouts(self, *, num_rollout_workers=None, num_envs_per_worker=None,
+                 rollout_fragment_length=None):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown ApexDDPG option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "ApexDDPG":
+        return ApexDDPG({"apex_config": self})
+
+
+class ApexDDPG(ApexDQN):
+    """Distributed prioritized replay + DDPG: noise-laddered continuous
+    actors feed replay shards; the learner polyak-syncs its targets inside
+    the jitted update, so there is no explicit target-sync step."""
+
+    def _make_learner(self, cfg):
+        from ray_tpu.rllib.ddpg import DDPGLearner
+
+        return DDPGLearner(
+            cfg.obs_dim, cfg.action_dim, cfg.max_action, cfg.actor_lr,
+            cfg.critic_lr, cfg.gamma, cfg.tau, cfg.twin_q,
+            smooth_target_policy=False, target_noise=0.0,
+            target_noise_clip=0.0, seed=cfg.seed)
+
+    def _make_workers(self, cfg) -> List[Any]:
+        from ray_tpu.rllib.ddpg import NoisyActorWorker
+
+        if cfg.num_rollout_workers == 1:
+            noises = [cfg.base_noise]
+        else:
+            n = cfg.num_rollout_workers
+            noises = [cfg.base_noise ** (1.0 + i / (n - 1) * cfg.noise_alpha)
+                      for i in range(n)]
+        self._noises = noises
+        return [
+            NoisyActorWorker.options(num_cpus=1).remote(
+                cfg.env_maker, cfg.num_envs_per_worker,
+                cfg.seed + 1000 * (i + 1), cfg.obs_dim, cfg.action_dim,
+                cfg.max_action, noises[i])
+            for i in range(cfg.num_rollout_workers)]
+
+    def _issue_sample(self, i: int, wk):
+        random_phase = self._buffered < self.cfg.learning_starts
+        return wk.sample.remote(self.cfg.rollout_fragment_length,
+                                random_phase)
+
+    def _learner_update(self, batch):
+        import jax
+
+        keys = ("obs", "actions", "rewards", "next_obs", "dones", "weights")
+        aux = jax.device_get(self.learner.update(
+            {k: batch[k] for k in keys if k in batch}))
+        return float(aux["total_loss"]), np.abs(np.asarray(aux["td"]))
+
+    def _maybe_sync_target(self) -> None:
+        pass  # polyak sync rides the jitted post_update hook
+
+    def _broadcast(self) -> None:
+        actor = self.learner.get_weights()["actor"]
+        ray_tpu.get([wk.set_weights.remote(actor) for wk in self.workers])
+
+    def _extra_stats(self) -> Dict[str, Any]:
+        return {"noise_scales": list(self._noises)}

@@ -152,23 +152,28 @@ class DDPGLearner(Learner):
                 tq, q_value(extra["q2"], batch["next_obs"], next_a))
         backup = sg(batch["rewards"] + self._gamma
                     * (1 - batch["dones"]) * tq)
-        c_loss = ((q_value(params["q1"], batch["obs"], batch["actions"])
-                   - backup) ** 2).mean()
+        # importance weights from prioritized replay (Ape-X), 1 otherwise
+        w = batch.get("weights", 1.0)
+        c_loss = (w * (q_value(params["q1"], batch["obs"], batch["actions"])
+                       - backup) ** 2).mean()
         if self.twin_q:
-            c_loss += ((q_value(params["q2"], batch["obs"], batch["actions"])
-                        - backup) ** 2).mean()
+            c_loss += (w * (q_value(params["q2"], batch["obs"],
+                                    batch["actions"])
+                            - backup) ** 2).mean()
 
         a = actor_apply(params["actor"], batch["obs"], self._max_action)
         a_loss = -q_value(sg(params["q1"]), batch["obs"], a).mean()
 
         total = c_loss + a_loss
-        return total, {"critic_loss": c_loss, "actor_loss": a_loss}
+        td = q_value(params["q1"], batch["obs"], batch["actions"]) - backup
+        return total, {"critic_loss": c_loss, "actor_loss": a_loss,
+                       "td": td}
 
     def update_batch(self, batch) -> Dict[str, float]:
         import jax
 
-        aux = self.update(batch)
-        return {k: float(v) for k, v in jax.device_get(aux).items()}
+        aux = jax.device_get(self.update(batch))
+        return {k: float(v) for k, v in aux.items() if np.ndim(v) == 0}
 
     def set_weights(self, weights):
         super().set_weights(weights)

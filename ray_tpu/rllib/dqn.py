@@ -103,10 +103,12 @@ class DQNLearner(Learner):
     batches over dp (LearnerGroup mesh backend)."""
 
     def __init__(self, obs_dim: int, num_actions: int, lr: float,
-                 gamma: float, seed: int = 0, mesh=None):
+                 gamma: float, seed: int = 0, mesh=None,
+                 double_q: bool = True):
         self._obs_dim = obs_dim
         self._num_actions = num_actions
         self._gamma = gamma
+        self._double_q = double_q
         super().__init__(lr=lr, mesh=mesh, seed=seed)
 
     def init_params(self, seed: int):
@@ -125,11 +127,16 @@ class DQNLearner(Learner):
         q = q_apply(params, batch["obs"])
         q_taken = jnp.take_along_axis(
             q, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
-        # double DQN: online net picks argmax, target net evaluates
-        next_online = q_apply(params, batch["next_obs"])
-        next_a = jnp.argmax(next_online, axis=-1)
         next_target = q_apply(target_params, batch["next_obs"])
-        next_q = jnp.take_along_axis(next_target, next_a[:, None], axis=-1)[:, 0]
+        if self._double_q:
+            # double DQN: online net picks argmax, target net evaluates
+            next_online = q_apply(params, batch["next_obs"])
+            next_a = jnp.argmax(next_online, axis=-1)
+            next_q = jnp.take_along_axis(
+                next_target, next_a[:, None], axis=-1)[:, 0]
+        else:
+            # SimpleQ: plain max over the target net
+            next_q = next_target.max(-1)
         target = batch["rewards"] + self._gamma * (1.0 - batch["dones"]) * \
             jax.lax.stop_gradient(next_q)
         td = q_taken - target
@@ -167,6 +174,7 @@ class DQNConfig:
         self.rollout_fragment_length = 32
         self.lr = 5e-4
         self.gamma = 0.99
+        self.double_q = True
         self.buffer_capacity = 50_000
         self.prioritized_replay = False
         self.train_batch_size = 64
@@ -213,7 +221,8 @@ class DQN(Algorithm):
         cfg: DQNConfig = config.get("dqn_config") or DQNConfig()
         self.cfg = cfg
         self.learner = DQNLearner(cfg.obs_dim, cfg.num_actions, cfg.lr,
-                                  cfg.gamma, cfg.seed)
+                                  cfg.gamma, cfg.seed,
+                                  double_q=getattr(cfg, "double_q", True))
         if cfg.prioritized_replay:
             self.buffer = PrioritizedReplayBuffer(cfg.buffer_capacity,
                                                   seed=cfg.seed)

@@ -1,0 +1,165 @@
+"""PG: vanilla policy gradient (REINFORCE).
+
+Mirrors the reference's PG (`rllib/algorithms/pg/pg.py`,
+`pg_tf_policy.py`: loss = -mean(logp * returns), no critic, no GAE — the
+minimal on-policy baseline): one parallel sample round, Monte-Carlo
+reward-to-go returns, a single policy-gradient step on the Learner stack.
+Reuses the PPO rollout fleet (module + connector acting); the value head
+of the shared module is simply untrained.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.env import CartPoleEnv
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.ppo import RolloutWorker, compute_gae
+
+
+class PGLearner(Learner):
+    """-mean(logp * returns) with an entropy bonus; critic-free
+    (reference pg_tf_policy.py `pg_tf_loss`)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, lr: float,
+                 entropy_coeff: float = 0.0, seed: int = 0, mesh=None,
+                 module=None):
+        from ray_tpu.rllib.rl_module import DiscreteActorCriticModule
+
+        self.module = module or DiscreteActorCriticModule(obs_dim, num_actions)
+        self._entropy_coeff = entropy_coeff
+        super().__init__(lr=lr, mesh=mesh, seed=seed)
+
+    def init_params(self, seed: int):
+        return self.module.init_params(seed)
+
+    def loss(self, params, batch, extra, rng):
+        out = self.module.forward_train(params, batch)
+        dist = self.module.action_dist(out)
+        logp = dist.logp(batch["actions"])
+        pg = -(logp * batch["returns"]).mean()
+        entropy = dist.entropy().mean()
+        total = pg - self._entropy_coeff * entropy
+        return total, {"policy_loss": pg, "entropy": entropy}
+
+    def update_once(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+
+        aux = self.update(batch)
+        return {k: float(v) for k, v in jax.device_get(aux).items()}
+
+
+class PGConfig:
+    def __init__(self):
+        self.env_maker: Callable[[int], Any] = lambda seed: CartPoleEnv(seed)
+        self.obs_dim = CartPoleEnv.observation_dim
+        self.num_actions = CartPoleEnv.num_actions
+        self.num_rollout_workers = 2
+        self.num_envs_per_worker = 4
+        self.rollout_fragment_length = 64
+        self.lr = 5e-3
+        self.gamma = 0.99
+        self.entropy_coeff = 0.0
+        self.seed = 0
+
+    def environment(self, env_maker=None, *, obs_dim=None, num_actions=None):
+        if env_maker is not None:
+            self.env_maker = env_maker
+        if obs_dim is not None:
+            self.obs_dim = obs_dim
+        if num_actions is not None:
+            self.num_actions = num_actions
+        return self
+
+    def rollouts(self, *, num_rollout_workers=None, num_envs_per_worker=None,
+                 rollout_fragment_length=None):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown PG option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "PG":
+        return PG({"pg_config": self})
+
+
+class PG(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        cfg: PGConfig = config.get("pg_config") or PGConfig()
+        self.cfg = cfg
+        self.learner = PGLearner(cfg.obs_dim, cfg.num_actions, cfg.lr,
+                                 cfg.entropy_coeff, cfg.seed)
+        self.workers = [
+            RolloutWorker.options(num_cpus=1).remote(
+                cfg.env_maker, cfg.num_envs_per_worker,
+                cfg.seed + 1000 * (i + 1), cfg.obs_dim, cfg.num_actions)
+            for i in range(cfg.num_rollout_workers)]
+        self._broadcast_weights()
+        self._reward_history: List[float] = []
+        self._total_steps = 0
+
+    def _broadcast_weights(self) -> None:
+        w = self.learner.get_weights()
+        ray_tpu.get([wk.set_weights.remote(w) for wk in self.workers])
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        samples = ray_tpu.get([
+            wk.sample.remote(cfg.rollout_fragment_length)
+            for wk in self.workers])
+        flats, episode_returns = [], []
+        for batch in samples:
+            # Monte-Carlo reward-to-go = GAE with a zero critic and
+            # lambda=1 (no bootstrap beyond the fragment tail)
+            zeroed = dict(batch, values=np.zeros_like(batch["values"]),
+                          last_value=np.zeros_like(batch["last_value"]))
+            ret, _ = compute_gae(zeroed, cfg.gamma, 1.0)
+            T, N = batch["actions"].shape
+            flats.append({
+                "obs": batch["obs"].reshape(T * N, -1),
+                "actions": batch["actions"].reshape(-1),
+                "returns": ret.reshape(-1),
+            })
+            episode_returns.extend(batch["episode_returns"].tolist())
+        flat = {k: np.concatenate([f[k] for f in flats]) for k in flats[0]}
+        ret = flat["returns"]
+        flat["returns"] = (ret - ret.mean()) / (ret.std() + 1e-8)
+        self._total_steps += int(flat["actions"].size)
+        stats = self.learner.update_once(flat)
+        self._broadcast_weights()
+        if episode_returns:
+            self._reward_history.extend(episode_returns)
+            self._reward_history = self._reward_history[-100:]
+        return {
+            "episode_reward_mean": (float(np.mean(self._reward_history))
+                                    if self._reward_history else 0.0),
+            "num_env_steps_sampled": self._total_steps,
+            **stats,
+        }
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.learner.set_weights(weights)
+        self._broadcast_weights()
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

@@ -51,8 +51,7 @@ def policy_apply(params, obs, n_hidden: int = 2):
 # ---------------------------------------------------------------- rollouts
 
 
-@ray_tpu.remote
-class RolloutWorker:
+class RolloutWorkerImpl:
     """Env-stepping actor (reference rollout_worker.py:166; `sample:879`).
 
     Acting is MODULE + CONNECTORS (reference EnvRunner + connector
@@ -131,6 +130,11 @@ class RolloutWorker:
             "last_value": np.asarray(last_value),
             "episode_returns": np.array(episode_returns, np.float32),
         }
+
+
+# the remote actor form (plain impl kept importable so subclasses — A3C's
+# gradient-computing worker — can extend the sample loop)
+RolloutWorker = ray_tpu.remote(RolloutWorkerImpl)
 
 
 def compute_gae(batch: Dict[str, np.ndarray], gamma: float, lam: float):

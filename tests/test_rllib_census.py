@@ -1,0 +1,132 @@
+"""Census additions: PG, A3C, SimpleQ, RandomAgent, ApexDDPG — the last
+reference algorithms ported onto the Learner/module/connector stack."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_pg_trains_on_cartpole(ray_start_regular):
+    from ray_tpu.rllib import PGConfig
+
+    algo = (PGConfig()
+            .rollouts(num_rollout_workers=1, num_envs_per_worker=2,
+                      rollout_fragment_length=32)
+            .build())
+    try:
+        last = {}
+        for _ in range(4):
+            last = algo.train()
+        assert np.isfinite(last["policy_loss"])
+        assert last["num_env_steps_sampled"] == 4 * 2 * 32
+    finally:
+        algo.stop()
+
+
+@pytest.mark.slow
+def test_pg_learns_cartpole(ray_start_regular):
+    from ray_tpu.rllib import PGConfig
+
+    algo = (PGConfig()
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=4,
+                      rollout_fragment_length=128)
+            .training(lr=5e-3, seed=1)
+            .build())
+    try:
+        best = 0.0
+        for _ in range(40):
+            best = max(best, algo.train()["episode_reward_mean"])
+        assert best >= 60.0, best  # vanilla PG is noisy; well above random
+    finally:
+        algo.stop()
+
+
+def test_a3c_applies_async_gradients(ray_start_regular):
+    from ray_tpu.rllib import A3CConfig
+
+    algo = (A3CConfig()
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                      rollout_fragment_length=16)
+            .training(grads_per_step=3)
+            .build())
+    try:
+        w0 = algo.get_weights()["w0"].copy()
+        last = {}
+        for _ in range(3):
+            last = algo.train()
+        assert last["num_grads_applied"] == 3
+        assert np.isfinite(last["loss"])
+        assert not np.allclose(algo.get_weights()["w0"], w0)
+    finally:
+        algo.stop()
+
+
+def test_simple_q_trains_and_differs_from_double(ray_start_regular):
+    """SimpleQ must run a plain max-backup: its jitted loss differs from
+    double-DQN's on a crafted batch where argmax(online) != argmax(target)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib import SimpleQConfig
+    from ray_tpu.rllib.dqn import DQNLearner
+
+    algo = SimpleQConfig().rollouts(num_rollout_workers=1).build()
+    try:
+        last = {}
+        for _ in range(4):
+            last = algo.train()
+        assert last["num_env_steps_sampled"] > 0
+    finally:
+        algo.stop()
+
+    simple = DQNLearner(2, 2, lr=1e-3, gamma=0.9, seed=0, double_q=False)
+    double = DQNLearner(2, 2, lr=1e-3, gamma=0.9, seed=0, double_q=True)
+    # diverge online vs target so the two backups disagree
+    rng = np.random.default_rng(0)
+    shifted = {k: v + rng.standard_normal(v.shape).astype(np.float32) * 0.5
+               for k, v in simple.get_weights().items()}
+    simple.extra = {k: jnp.asarray(v) for k, v in shifted.items()}
+    double.extra = {k: jnp.asarray(v) for k, v in shifted.items()}
+    batch = {
+        "obs": rng.standard_normal((32, 2)).astype(np.float32),
+        "actions": rng.integers(0, 2, 32).astype(np.int32),
+        "rewards": rng.standard_normal(32).astype(np.float32),
+        "next_obs": rng.standard_normal((32, 2)).astype(np.float32),
+        "dones": np.zeros(32, np.float32),
+    }
+    l_simple, _ = simple.update_batch(dict(batch))
+    l_double, _ = double.update_batch(dict(batch))
+    assert l_simple != l_double
+
+
+def test_random_agent_baseline():
+    from ray_tpu.rllib import RandomAgentConfig
+
+    algo = RandomAgentConfig().training(rollouts_per_iter=128).build()
+    res = {}
+    for _ in range(3):
+        res = algo.train()
+    # CartPole random policy scores ~20 +- 10
+    assert 5.0 < res["episode_reward_mean"] < 60.0
+    assert res["num_env_steps_sampled"] == 3 * 128 * 4
+
+
+def test_apex_ddpg_trains_on_pendulum(ray_start_regular):
+    from ray_tpu.rllib import ApexDDPGConfig
+
+    algo = (ApexDDPGConfig()
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=64)
+            .training(learning_starts=128, num_updates_per_step=2,
+                      train_batch_size=64)
+            .build())
+    try:
+        last = {}
+        for _ in range(5):
+            last = algo.train()
+        assert last["buffer_size"] > 0
+        assert len(last["noise_scales"]) == 2
+        # noise ladder is strictly decreasing exploration
+        assert last["noise_scales"][0] > last["noise_scales"][1]
+        assert np.isfinite(last["loss"]) or last["buffer_size"] < 128
+    finally:
+        algo.stop()
