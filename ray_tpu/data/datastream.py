@@ -746,12 +746,49 @@ def _write_block_csv(block: Block, path: str) -> None:
                 w.writerow([r])
 
 
+def _tensor_to_arrow(arr: np.ndarray):
+    """Multi-dim numpy -> (nested) FixedSizeList arrow array, so tensor
+    columns (e.g. [N, obs_dim] observations) round-trip through parquet
+    (reference ArrowTensorArray, python/ray/air/util/tensor_extensions)."""
+    import pyarrow as pa
+
+    out = pa.array(arr.reshape(-1))
+    for dim in reversed(arr.shape[1:]):
+        out = pa.FixedSizeListArray.from_arrays(out, dim)
+    return out
+
+
+def _arrow_to_numpy(column) -> np.ndarray:
+    """Arrow column -> numpy; (nested) FixedSizeList columns reassemble to
+    a contiguous [N, ...] tensor instead of degrading to object arrays."""
+    import pyarrow as pa
+
+    col = column.combine_chunks() if hasattr(column, "combine_chunks") \
+        else column
+    shape = [len(col)]
+    typ = col.type
+    while pa.types.is_fixed_size_list(typ):
+        shape.append(typ.list_size)
+        typ = typ.value_type
+    if len(shape) > 1:
+        flat = col
+        while hasattr(flat, "flatten") and pa.types.is_fixed_size_list(
+                flat.type):
+            flat = flat.flatten()
+        return flat.to_numpy(zero_copy_only=False).reshape(shape)
+    return col.to_numpy(zero_copy_only=False)
+
+
 def _write_block_parquet(block: Block, path: str) -> None:
     import pyarrow as pa
     import pyarrow.parquet as pq
 
     if isinstance(block, dict):
-        table = pa.table({k: np.asarray(v) for k, v in block.items()})
+        cols = {}
+        for k, v in block.items():
+            v = np.asarray(v)
+            cols[k] = _tensor_to_arrow(v) if v.ndim > 1 else pa.array(v)
+        table = pa.table(cols)
     else:
         rows = _block_rows(block)
         cols = {k: [r[k] for r in rows] for k in (rows[0] if rows else {})}
@@ -1044,7 +1081,7 @@ def read_parquet(paths: Union[str, List[str]]) -> Datastream:
         import pyarrow.parquet as pq
 
         table = pq.read_table(path)
-        return {c: table[c].to_numpy() for c in table.column_names}
+        return {c: _arrow_to_numpy(table[c]) for c in table.column_names}
 
     return Datastream([load.remote(p) for p in paths])
 

@@ -26,7 +26,7 @@ from ray_tpu.rllib.sac import SAC, SACConfig
 from ray_tpu.rllib.ddpg import DDPG, DDPGConfig, TD3, TD3Config
 from ray_tpu.rllib.offline import (
     BC, BCConfig, CQL, CQLConfig, CRR, CRRConfig, MARWIL, MARWILConfig,
-    collect_episodes)
+    collect_episodes, read_experiences, write_experiences)
 from ray_tpu.rllib.bandit import BanditLinTS, BanditLinUCB, LinearBanditEnv
 from ray_tpu.rllib.replay_buffers import ReplayBuffer, PrioritizedReplayBuffer
 from ray_tpu.rllib.multi_agent import (

@@ -85,6 +85,37 @@ def from_rows(rows: List[Dict[str, Any]]) -> Dict[str, np.ndarray]:
     return {k: np.asarray([r[k] for r in rows]) for k in keys}
 
 
+def write_experiences(dataset: Dict[str, np.ndarray], path: str, *,
+                      num_shards: int = 4) -> List[str]:
+    """Persist logged transitions as sharded parquet THROUGH the Data
+    plane (reference rllib/offline/json_writer.py role, riding
+    `Datastream.write_parquet` instead of a bespoke writer). Tensor
+    columns ([N, obs_dim] observations) round-trip via the parquet
+    writer's FixedSizeList encoding."""
+    from ray_tpu import data as rdata
+
+    return rdata.from_numpy(dataset,
+                            parallelism=num_shards).write_parquet(path)
+
+
+def read_experiences(path) -> Dict[str, np.ndarray]:
+    """Load an experience dataset from parquet shards through
+    `ray_tpu.data.read_parquet` (reference rllib/offline/dataset_reader.py):
+    shards load in parallel as Data tasks, then concatenate columnwise."""
+    import glob
+    import os
+
+    from ray_tpu import data as rdata
+
+    if isinstance(path, str) and os.path.isdir(path):
+        paths = sorted(glob.glob(os.path.join(path, "*.parquet")))
+    else:
+        paths = path
+    ds = rdata.read_parquet(paths)
+    batches = list(ds.iter_batches(batch_size=1 << 30))
+    return {k: np.concatenate([b[k] for b in batches]) for k in batches[0]}
+
+
 # ------------------------------------------------------------- algorithms
 
 
@@ -255,6 +286,20 @@ class CRRLearner(Learner):
                        "mean_weight": weight.mean()}
 
 
+def _resolve_offline_input(dataset, input_path):
+    """Config-side input resolution: a columnar dict passes through, a
+    Datastream materializes columnwise, a path reads parquet shards
+    through the Data plane (reference AlgorithmConfig.offline_data
+    `input_` handling, rllib/offline/dataset_reader.py)."""
+    if input_path is not None:
+        return read_experiences(input_path)
+    if hasattr(dataset, "iter_batches"):
+        batches = list(dataset.iter_batches(batch_size=1 << 30))
+        return {k: np.concatenate([b[k] for b in batches])
+                for k in batches[0]}
+    return dataset
+
+
 class _OfflineBase(Algorithm):
     """Shared setup: dataset + minibatch iterator."""
 
@@ -304,8 +349,8 @@ class BCConfig:
         self.vf_coeff = 1.0
         self.gamma = 0.99
 
-    def offline_data(self, dataset) -> "BCConfig":
-        self.dataset = dataset
+    def offline_data(self, dataset=None, *, input_path=None) -> "BCConfig":
+        self.dataset = _resolve_offline_input(dataset, input_path)
         return self
 
     def training(self, **kw):
@@ -382,8 +427,8 @@ class CQLConfig:
         self.dataset: Optional[Dict[str, np.ndarray]] = None
         self.seed = 0
 
-    def offline_data(self, dataset) -> "CQLConfig":
-        self.dataset = dataset
+    def offline_data(self, dataset=None, *, input_path=None) -> "CQLConfig":
+        self.dataset = _resolve_offline_input(dataset, input_path)
         return self
 
     def training(self, **kw):
@@ -445,8 +490,8 @@ class CRRConfig:
         self.dataset: Optional[Dict[str, np.ndarray]] = None
         self.seed = 0
 
-    def offline_data(self, dataset) -> "CRRConfig":
-        self.dataset = dataset
+    def offline_data(self, dataset=None, *, input_path=None) -> "CRRConfig":
+        self.dataset = _resolve_offline_input(dataset, input_path)
         return self
 
     def training(self, **kw):

@@ -1,0 +1,95 @@
+"""Offline RL rides the Data plane: experience datasets round-trip through
+sharded parquet (Datastream.write_parquet / read_parquet), and the offline
+quartet trains from file-backed input (reference rllib/offline/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def _random_policy_dataset(episodes=30):
+    from ray_tpu.rllib import CartPoleEnv, collect_episodes
+
+    return collect_episodes(
+        lambda seed: CartPoleEnv(seed),
+        lambda obs, rng: int(rng.integers(2)),
+        num_episodes=episodes, seed=0)
+
+
+def test_experience_parquet_roundtrip(ray_start_regular, tmp_path):
+    from ray_tpu.rllib import read_experiences, write_experiences
+
+    data = _random_policy_dataset(10)
+    paths = write_experiences(data, str(tmp_path / "exp"), num_shards=3)
+    assert len(paths) == 3
+    back = read_experiences(str(tmp_path / "exp"))
+    assert set(back) == set(data)
+    # tensor column survives with shape and dtype-compatible values
+    assert back["obs"].shape == data["obs"].shape
+    assert np.allclose(np.sort(back["rewards"]), np.sort(data["rewards"]))
+    # shards preserve total row alignment per column
+    for k in data:
+        assert len(back[k]) == len(data[k])
+
+
+def test_rollout_parquet_bc_roundtrip(ray_start_regular, tmp_path):
+    """The VERDICT round-trip: rollout -> parquet -> BC training."""
+    from ray_tpu.rllib import BCConfig, write_experiences
+
+    data = _random_policy_dataset(20)
+    write_experiences(data, str(tmp_path / "exp"), num_shards=2)
+    algo = (BCConfig()
+            .offline_data(input_path=str(tmp_path / "exp"))
+            .training(train_batch_size=128)
+            .build())
+    last = {}
+    for _ in range(3):
+        last = algo.train()
+    assert np.isfinite(last["total_loss"])
+
+
+def test_cql_trains_from_file_backed_dataset(ray_start_regular, tmp_path):
+    from ray_tpu.rllib import CQLConfig, write_experiences
+
+    data = _random_policy_dataset(20)
+    write_experiences(data, str(tmp_path / "exp"), num_shards=2)
+    algo = (CQLConfig()
+            .offline_data(input_path=str(tmp_path / "exp"))
+            .training(train_batch_size=128)
+            .build())
+    last = {}
+    for _ in range(3):
+        last = algo.train()
+    assert np.isfinite(last["total_loss"])
+
+
+def test_offline_data_accepts_datastream(ray_start_regular):
+    from ray_tpu import data as rdata
+    from ray_tpu.rllib import BCConfig
+
+    data = _random_policy_dataset(10)
+    ds = rdata.from_numpy(data, parallelism=2)
+    cfg = BCConfig().offline_data(ds)
+    assert cfg.dataset["obs"].shape == data["obs"].shape
+
+
+def test_parquet_tensor_columns(ray_start_regular, tmp_path):
+    """2-D/3-D numpy columns round-trip parquet as FixedSizeList, coming
+    back as contiguous tensors (not object arrays)."""
+    from ray_tpu import data as rdata
+
+    arrays = {
+        "flat": np.arange(12, dtype=np.float32),
+        "mat": np.arange(24, dtype=np.float32).reshape(12, 2),
+        "cube": np.arange(48, dtype=np.int64).reshape(12, 2, 2),
+    }
+    ds = rdata.from_numpy(arrays, parallelism=2)
+    ds.write_parquet(str(tmp_path / "t"))
+    back = rdata.read_parquet(
+        sorted(str(p) for p in (tmp_path / "t").glob("*.parquet")))
+    batches = list(back.iter_batches(batch_size=100))
+    got = {k: np.concatenate([b[k] for b in batches]) for k in batches[0]}
+    for k, v in arrays.items():
+        assert got[k].shape == v.shape, k
+        assert np.allclose(got[k], v), k
