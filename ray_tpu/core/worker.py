@@ -1233,6 +1233,7 @@ class CoreWorker:
                 if oid not in dyn:
                     dyn.append(oid)
             rec = self._dynamic_returns.get(task_id)
+            fire = []
             if (rec is not None and not rec["done"]
                     and oid not in rec.setdefault("seen", set())):
                 rec["seen"].add(oid)
@@ -1242,7 +1243,13 @@ class CoreWorker:
                 ref = ObjectRef(oid, owner_address=self.address)
                 ref._counted = True
                 rec["refs"].append(ref)
+                fire = self._drain_dynamic_waiters(rec)
             self._obj_cv.notify_all()
+        for cb in fire:
+            try:
+                cb()
+            except Exception:
+                logger.exception("dynamic-return callback failed")
         if contained:
             self._adopt_contained_refs(oid, contained)
         self._notify_info_waiters(oid)
@@ -1264,6 +1271,38 @@ class CoreWorker:
                 if self._shutdown.is_set():
                     return None, True, None
                 self._obj_cv.wait(timeout=1.0)
+
+    def add_dynamic_return_callback(self, task_id: TaskID, i: int,
+                                    cb) -> None:
+        """Event-driven streaming: invoke `cb()` (from whichever thread
+        reports the item) once the i-th dynamic return is available OR the
+        stream is terminal — at that point the generator's `__next__` is
+        guaranteed non-blocking. Fires immediately if already satisfied.
+        The async HTTP edge relays token streams with this instead of
+        parking a thread per live stream."""
+        with self._obj_lock:
+            rec = self._dynamic_returns.get(task_id)
+            if rec is None or i < len(rec["refs"]) or rec["done"]:
+                satisfied = True
+            else:
+                rec.setdefault("waiters", []).append((i, cb))
+                satisfied = False
+        if satisfied:
+            cb()
+
+    @staticmethod
+    def _drain_dynamic_waiters(rec) -> list:
+        """Under _obj_lock: pop the waiters whose item (or terminal state)
+        is now available; caller invokes them OUTSIDE the lock."""
+        waiters = rec.get("waiters")
+        if not waiters:
+            return []
+        n = len(rec["refs"])
+        fire = [cb for i, cb in waiters if i < n or rec["done"]]
+        if fire:
+            rec["waiters"] = [(i, cb) for i, cb in waiters
+                              if not (i < n or rec["done"])]
+        return fire
 
     def make_dynamic_generator(self, gen_ref: ObjectRef) -> ObjectRefGenerator:
         """Owner-side streaming generator for a just-submitted dynamic task
@@ -1288,7 +1327,13 @@ class CoreWorker:
                         err = TaskError("generator task failed")
             rec["done"] = True
             rec["error"] = err
+            fire = self._drain_dynamic_waiters(rec)
             self._obj_cv.notify_all()
+        for cb in fire:
+            try:
+                cb()
+            except Exception:
+                logger.exception("dynamic-return callback failed")
 
     def _report_dynamic(self, spec: TaskSpec, entry) -> None:
         """Deliver one streamed item to the owner. Raises on failure (after

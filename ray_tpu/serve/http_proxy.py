@@ -51,14 +51,11 @@ class AsyncHTTPProxy:
         self._get_handle = get_handle
         self._get_stream_handle = get_stream_handle
         # submissions + ready-object fetches; sized generously because every
-        # operation on it is short (submit) or instant (terminal-state get)
+        # operation on it is short (submit) or instant (terminal-state get).
+        # Streams don't park threads here: item arrival is event-driven
+        # (add_dynamic_return_callback), so live-stream count is unbounded.
         self._pool = ThreadPoolExecutor(max_workers=32,
                                         thread_name_prefix="serve-http")
-        # streaming iterations park a worker per LIVE stream (next() blocks
-        # on the owner's arrival condition); bounded separately so streams
-        # can't starve request submission
-        self._stream_pool = ThreadPoolExecutor(
-            max_workers=16, thread_name_prefix="serve-http-stream")
         self._loop = asyncio.new_event_loop()
         self.port: int = 0
         started = threading.Event()
@@ -259,18 +256,45 @@ class AsyncHTTPProxy:
             _serve_metrics()["latency"].observe(
                 time.monotonic() - t0, tags={"deployment": name})
 
+    async def _await_next_stream_item(self, gen) -> None:
+        """Event-driven wait for the generator's next item: resolves when
+        the ownership layer reports item `gen._i` (or the stream terminal),
+        after which `next(gen)` is guaranteed non-blocking. No parked
+        thread — a node can hold thousands of live token streams."""
+        from ray_tpu.core import worker as _worker_mod
+
+        w = _worker_mod.current_worker()
+        fut = self._loop.create_future()
+
+        def ready() -> None:
+            try:
+                self._loop.call_soon_threadsafe(
+                    lambda: fut.done() or fut.set_result(None))
+            except RuntimeError:
+                pass  # loop already stopped
+
+        w.add_dynamic_return_callback(gen._task_id, gen._i, ready)
+        await asyncio.wait_for(fut, timeout=_REQUEST_TIMEOUT_S)
+
     async def _dispatch_stream(self, name: str, method: str, payload: Any,
                                req: dict, writer) -> None:
         """Chunked-encoding relay of a streaming deployment: each object the
         replica's generator yields becomes one HTTP chunk as soon as it is
-        reported — tokens reach the client while the model still decodes."""
+        reported — tokens reach the client while the model still decodes.
+        Item arrival rides the same add_done_callback mechanism as the
+        non-streaming path (reference http_proxy.py's async streaming
+        model), so there is NO thread-per-live-stream and no stream cap."""
         import ray_tpu
+        from ray_tpu.core.api import _global_worker
 
         # submit BEFORE the 200 goes out: submission failures (no replicas,
         # unknown deployment) still produce a clean 500 via the caller
         handle = self._get_stream_handle(name, method)
-        gen = await self._loop.run_in_executor(
-            self._stream_pool, handle.remote, payload)
+        if getattr(handle, "_replicas", None):
+            gen = handle.remote(payload)
+        else:
+            gen = await self._loop.run_in_executor(
+                self._pool, handle.remote, payload)
         writer.write((
             "HTTP/1.1 200 OK\r\n"
             "Content-Type: application/x-ndjson\r\n"
@@ -279,24 +303,24 @@ class AsyncHTTPProxy:
             "\r\n").encode("latin1"))
         await writer.drain()
 
-        _done = object()
-
-        def next_item() -> Any:
-            try:
-                ref = next(gen)
-            except StopIteration:
-                return _done
-            return ray_tpu.get(ref, timeout=_REQUEST_TIMEOUT_S)
-
         # Once chunked 200 headers are out, an HTTP 500 can never follow —
         # writing one mid-body would corrupt framing and desync keep-alive.
         # Errors become a final error chunk + a CLEAN chunk terminator.
         try:
             while True:
-                item = await self._loop.run_in_executor(
-                    self._stream_pool, next_item)
-                if item is _done:
+                if not gen._done:
+                    await self._await_next_stream_item(gen)
+                try:
+                    ref = next(gen)
+                except StopIteration:
                     break
+                # the reported item is already terminal: inline values
+                # resolve on the loop; plasma values hop to the pool
+                item, ok = _global_worker().try_get_local(ref)
+                if not ok:
+                    item = await self._loop.run_in_executor(
+                        self._pool, lambda r=ref: ray_tpu.get(
+                            r, timeout=_REQUEST_TIMEOUT_S))
                 if isinstance(item, (bytes, bytearray, memoryview)):
                     chunk = bytes(item)
                 elif isinstance(item, str):
@@ -320,4 +344,3 @@ class AsyncHTTPProxy:
         except Exception:
             pass
         self._pool.shutdown(wait=False)
-        self._stream_pool.shutdown(wait=False)
