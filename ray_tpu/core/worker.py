@@ -1655,6 +1655,16 @@ class CoreWorker:
             self.raylet.notify("push_object", payload)
         else:
             self.peer(location).notify("push_object", payload)
+        try:
+            from ray_tpu.util.metrics import get_or_create
+
+            get_or_create("counter", "ray_tpu_push_requests_total",
+                          "push() broadcasts dispatched").inc()
+            get_or_create("counter", "ray_tpu_push_targets_total",
+                          "cumulative push fan-out targets").inc(
+                              len(targets))
+        except Exception:
+            pass
         return len(targets)
 
     def _notify_owner_async(self, owner: str, method: str, payload: dict) -> None:

@@ -151,8 +151,9 @@ class ApexDQN(Algorithm):
                 for i in range(n)]
 
     def _broadcast(self) -> None:
-        w = self.learner.get_weights()
-        ray_tpu.get([wk.set_weights.remote(w) for wk in self.workers])
+        from ray_tpu.rllib.learner import broadcast_weights
+
+        broadcast_weights(self.learner.get_weights(), self.workers)
 
     def _shard_for(self, i: int):
         return self.replays[i % len(self.replays)]
@@ -326,8 +327,9 @@ class ApexDDPG(ApexDQN):
         pass  # polyak sync rides the jitted post_update hook
 
     def _broadcast(self) -> None:
-        actor = self.learner.get_weights()["actor"]
-        ray_tpu.get([wk.set_weights.remote(actor) for wk in self.workers])
+        from ray_tpu.rllib.learner import broadcast_weights
+
+        broadcast_weights(self.learner.get_weights()["actor"], self.workers)
 
     def _extra_stats(self) -> Dict[str, Any]:
         return {"noise_scales": list(self._noises)}

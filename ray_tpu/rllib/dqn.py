@@ -238,8 +238,9 @@ class DQN(Algorithm):
         self._total_steps = 0
 
     def _broadcast(self) -> None:
-        w = self.learner.get_weights()
-        ray_tpu.get([wk.set_weights.remote(w) for wk in self.workers])
+        from ray_tpu.rllib.learner import broadcast_weights
+
+        broadcast_weights(self.learner.get_weights(), self.workers)
 
     def _epsilon(self) -> float:
         cfg = self.cfg

@@ -33,7 +33,23 @@ import numpy as np
 
 import ray_tpu
 
-__all__ = ["Learner", "LearnerGroup", "delayed"]
+__all__ = ["Learner", "LearnerGroup", "broadcast_weights", "delayed"]
+
+
+def broadcast_weights(weights, handles, method: str = "set_weights"):
+    """Fan a weights pytree out to worker actors as ONE plasma object with
+    an owner-directed push broadcast (`ray_tpu.push`, reference
+    push_manager.h:29): N workers on other nodes read a pre-pushed local
+    copy instead of N pulls serializing on this owner. Small (inlined)
+    weights skip the push. Blocks until every worker applied them."""
+    ref = ray_tpu.put(weights)
+    try:
+        ray_tpu.push(ref)
+    except ValueError:
+        pass  # inlined small object: nothing to push, args ship it inline
+    except Exception:
+        pass  # push is an optimization; the pull path still works
+    return ray_tpu.get([getattr(h, method).remote(ref) for h in handles])
 
 
 def delayed(tx, period: int):
@@ -356,7 +372,7 @@ class LearnerGroup:
         if self._learner is not None:
             self._learner.set_weights(weights)
         else:
-            ray_tpu.get([a.set_weights.remote(weights) for a in self._actors])
+            broadcast_weights(weights, self._actors)
 
     def shutdown(self) -> None:
         """Tear down learner actors + the collective rendezvous (the group

@@ -112,8 +112,9 @@ class PG(Algorithm):
         self._total_steps = 0
 
     def _broadcast_weights(self) -> None:
-        w = self.learner.get_weights()
-        ray_tpu.get([wk.set_weights.remote(w) for wk in self.workers])
+        from ray_tpu.rllib.learner import broadcast_weights
+
+        broadcast_weights(self.learner.get_weights(), self.workers)
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self.cfg

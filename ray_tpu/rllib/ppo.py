@@ -315,9 +315,11 @@ class PPO(Algorithm):
         self._total_steps = 0
 
     def _broadcast_weights(self) -> None:
+        from ray_tpu.rllib.learner import broadcast_weights
+
         w = (self.learner_group.get_weights() if self.learner_group is not None
              else self.learner.get_weights())
-        ray_tpu.get([wk.set_weights.remote(w) for wk in self.workers])
+        broadcast_weights(w, self.workers)
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self.cfg

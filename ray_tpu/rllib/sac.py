@@ -315,8 +315,9 @@ class SAC(Algorithm):
         self._total_steps = 0
 
     def _broadcast_weights(self) -> None:
-        actor = self.learner.get_weights()["actor"]
-        ray_tpu.get([w.set_weights.remote(actor) for w in self.workers])
+        from ray_tpu.rllib.learner import broadcast_weights
+
+        broadcast_weights(self.learner.get_weights()["actor"], self.workers)
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self.cfg

@@ -7,9 +7,11 @@ from ray_tpu.serve.api import (
     get_deployment_handle,
     start_http_proxy,
     start_http_proxies_per_node,
+    start_grpc_proxy,
     start_rpc_proxy,
     AutoscalingConfig,
     Deployment,
     DeploymentHandle,
 )
 from ray_tpu.serve.config import deploy_config_file, load_config
+from ray_tpu.serve.ingress import App, Request, RouteNotFound, ingress

@@ -35,6 +35,7 @@ logger = logging.getLogger(__name__)
 CONTROLLER_NAME = "_serve_controller"
 SERVE_VERSIONS_CHANNEL = "serve_replica_versions"
 PROXY_NAME = "_serve_http_proxy"
+GRPC_PROXY_NAME = "_serve_grpc_proxy"
 
 
 @dataclass
@@ -129,7 +130,7 @@ class ServeController:
                 name: {k: d[k] for k in (
                     "def_blob", "init_args", "init_kwargs", "target",
                     "actor_options", "autoscaling", "max_concurrency",
-                    "def_version")}
+                    "def_version", "app_ingress") if k in d}
                 for name, d in self._deployments.items()},
             "replicas": {name: [r.actor_id for r in rs]
                          for name, rs in self._replicas.items()},
@@ -210,7 +211,8 @@ class ServeController:
     # -------------------------------------------------------------- deploy
     def deploy(self, name: str, def_blob: bytes, init_args, init_kwargs,
                num_replicas: int, actor_options: Optional[dict],
-               autoscaling: Optional[AutoscalingConfig], max_concurrency: int):
+               autoscaling: Optional[AutoscalingConfig], max_concurrency: int,
+               app_ingress: bool = False):
         existing = self._deployments.get(name)
         # Redeploy = ROLLING update (reference DeploymentState version
         # rollout): old replicas keep serving; the reconcile loop replaces
@@ -231,6 +233,7 @@ class ServeController:
             "actor_options": dict(actor_options or {}),
             "autoscaling": autoscaling,
             "max_concurrency": max_concurrency,
+            "app_ingress": bool(app_ingress),
             "last_scale_up": 0.0,
             "last_scale_down": 0.0,
             "def_version": def_version,
@@ -281,6 +284,8 @@ class ServeController:
             "version": self._versions.get(name, 0),
             "incarnation": self._incarnation,
             "replicas": list(self._replicas.get(name, [])),
+            "app_ingress": bool(
+                self._deployments.get(name, {}).get("app_ingress", False)),
         }
 
     def list_deployments(self):
@@ -351,12 +356,32 @@ class ServeController:
             self._replicas[name] = [r for r in replicas if r not in dead]
             self._bump_version(name)
 
+    def _blob_arg(self, d: dict):
+        """Large deployment definitions (model weights baked into the
+        class) ship as ONE plasma object with an owner-directed push
+        broadcast (`ray_tpu.push`, reference push_manager.h:29): every
+        replica node reads a local copy instead of each replica re-shipping
+        the blob from the controller. Small definitions stay by-value."""
+        blob = d["def_blob"]
+        if len(blob) < (1 << 20):
+            return blob
+        ref = d.get("_def_blob_ref")
+        if ref is None:
+            ref = ray_tpu.put(blob)
+            try:
+                ray_tpu.push(ref)
+            except Exception:
+                logger.debug("def-blob push skipped", exc_info=True)
+            d["_def_blob_ref"] = ref
+        return ref
+
     def _new_replica(self, d: dict):
         opts = dict(d["actor_options"])
         opts["max_concurrency"] = max(d["max_concurrency"], 4)
         ver = d.get("def_version", 0)
         replica = _ReplicaActor.options(**opts).remote(
-            d["def_blob"], d["init_args"], d["init_kwargs"], def_version=ver)
+            self._blob_arg(d), d["init_args"], d["init_kwargs"],
+            def_version=ver)
         self._replica_def_version[_replica_key(replica)] = ver
         return replica
 
@@ -585,6 +610,7 @@ class DeploymentHandle:
             if inc != getattr(self, "_incarnation", None):
                 self._incarnation = inc
                 self._version = -1  # new controller: any version is news
+            self._app_ingress = info.get("app_ingress", False)
             if info["version"] != self._version:
                 self._version = info["version"]
                 self._replicas = info["replicas"]
@@ -902,6 +928,7 @@ def run(target: Deployment, *, name: str = "default") -> DeploymentHandle:
             d.ray_actor_options,
             d.autoscaling_config,
             d.max_concurrent_queries,
+            getattr(d.func_or_class, "_serve_app_ingress", False),
         ))
     handle = _cached_handle(target.name)
     handle._refresh()
@@ -1077,6 +1104,37 @@ def start_http_proxies_per_node(port: int = 0):
         except Exception as e:
             logger.warning("per-node proxy on %s failed: %s", node_hex[:8], e)
     return out
+
+
+# ------------------------------------------------------------------ grpc
+
+
+@ray_tpu.remote
+class _GrpcProxyActor:
+    """gRPC ingress actor (reference's gRPC proxy role, serve.proto:235):
+    a grpc.aio edge exposing /rayserve.Ingress/Predict + PredictStream with
+    deployment routing via metadata. Implementation: serve/grpc_ingress.py."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        from ray_tpu.serve.grpc_ingress import GrpcIngress
+
+        self._server = GrpcIngress(
+            host, port,
+            get_handle=_cached_handle,
+            get_stream_handle=lambda name, method="__call__": _cached_handle(
+                name, method, stream=True))
+        self.port = self._server.port
+
+    def get_port(self) -> int:
+        return self.port
+
+
+def start_grpc_proxy(port: int = 0):
+    """Start the gRPC ingress actor; returns (actor_handle, port).
+    Requires grpcio (baked into standard images; raises cleanly without)."""
+    actor = _GrpcProxyActor.options(
+        num_cpus=0, max_concurrency=8, name=GRPC_PROXY_NAME).remote(port)
+    return actor, ray_tpu.get(actor.get_port.remote())
 
 
 # ------------------------------------------------------------------- rpc

@@ -1,0 +1,61 @@
+"""Shared completion plumbing for the Serve edges (HTTP + gRPC).
+
+Both edges run a dedicated asyncio loop thread and resolve request
+lifecycles through the ownership layer's callbacks — object completion via
+`add_done_callback`, stream items via `add_dynamic_return_callback` — so
+no thread is ever parked per in-flight request or live stream. This module
+is the single home for that plumbing; the edges stay thin."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+
+async def await_ref(loop, ref, timeout: float) -> None:
+    """Resolve when the ownership layer reports `ref` terminal."""
+    from ray_tpu.core.api import _global_worker
+
+    fut = loop.create_future()
+
+    def done() -> None:
+        try:
+            loop.call_soon_threadsafe(
+                lambda: fut.done() or fut.set_result(None))
+        except RuntimeError:
+            pass  # loop already stopped
+
+    _global_worker().add_done_callback(ref, done)
+    await asyncio.wait_for(fut, timeout=timeout)
+
+
+async def await_next_stream_item(loop, gen, timeout: float) -> None:
+    """Resolve when the generator's next item (or terminal state) is
+    reported — `next(gen)` is then guaranteed non-blocking."""
+    from ray_tpu.core import worker as _worker_mod
+
+    fut = loop.create_future()
+
+    def ready() -> None:
+        try:
+            loop.call_soon_threadsafe(
+                lambda: fut.done() or fut.set_result(None))
+        except RuntimeError:
+            pass
+
+    _worker_mod.current_worker().add_dynamic_return_callback(
+        gen._task_id, gen._i, ready)
+    await asyncio.wait_for(fut, timeout=timeout)
+
+
+async def fetch_value(loop, pool, ref, timeout: float) -> Any:
+    """Fetch a terminal object's value: inline results resolve on the
+    loop; plasma results (a blocking pull) hop to the pool."""
+    import ray_tpu
+    from ray_tpu.core.api import _global_worker
+
+    out, ok = _global_worker().try_get_local(ref)
+    if not ok:
+        out = await loop.run_in_executor(
+            pool, lambda: ray_tpu.get(ref, timeout=timeout))
+    return out

@@ -161,7 +161,9 @@ class AsyncHTTPProxy:
 
     def _parse_target(self, req: dict):
         """Route `/<deployment>[/<method>]` with `?stream=1` selecting the
-        chunked streaming path (the method must return a generator)."""
+        chunked streaming path (the method must return a generator).
+        Returns (name, method, payload, stream, subpath, query): app-
+        ingress deployments re-route on subpath at dispatch time."""
         parsed = urlparse(req["target"])
         parts = [p for p in parsed.path.split("/") if p]
         query = dict(parse_qsl(parsed.query))
@@ -170,6 +172,7 @@ class AsyncHTTPProxy:
             raise _BadRequest("no deployment in path")
         name = parts[0]
         method = parts[1] if len(parts) > 1 else "__call__"
+        subpath = "/" + "/".join(parts[1:])
         if req["method"] == "GET":
             payload: Any = query
         else:
@@ -189,28 +192,29 @@ class AsyncHTTPProxy:
                     payload = req["body"]
             else:
                 payload = req["body"]  # raw/binary passthrough
-        return name, method, payload, stream
+        return name, method, payload, stream, subpath, query
 
-    async def _await_ref(self, ref) -> None:
-        """Thread-free completion: resolves when the ownership layer reports
-        the object terminal (no parked thread, no polling)."""
-        from ray_tpu.core.api import _global_worker
-
-        fut = self._loop.create_future()
-
-        def done() -> None:
-            self._loop.call_soon_threadsafe(
-                lambda: fut.done() or fut.set_result(None))
-
-        _global_worker().add_done_callback(ref, done)
-        await asyncio.wait_for(fut, timeout=_REQUEST_TIMEOUT_S)
+    async def _is_app_ingress(self, name: str) -> bool:
+        """Whether `name` is an @serve.ingress app deployment. The flag
+        stays CURRENT: the one-shot refresh seeds it and the handle's
+        push-driven refresher keeps tracking redeploys (a deployment can
+        gain or lose its app between versions)."""
+        call_handle = self._get_handle(name, "__call__")
+        if not hasattr(call_handle, "_app_ingress"):
+            await self._loop.run_in_executor(
+                self._pool, lambda: call_handle._refresh(block=False))
+            call_handle._ensure_refresher()
+        return getattr(call_handle, "_app_ingress", False)
 
     async def _dispatch(self, req: dict, writer) -> None:
         from ray_tpu.serve.api import _serve_metrics
+        from ray_tpu.serve.edge_util import await_ref, fetch_value
+        from ray_tpu.serve.ingress import RouteNotFound
 
         t0 = time.monotonic()
         try:
-            name, method, payload, stream = self._parse_target(req)
+            name, method, payload, stream, subpath, query = \
+                self._parse_target(req)
         except _BadRequest as e:
             writer.write(self._response(
                 400, json.dumps({"error": str(e)}).encode(),
@@ -220,9 +224,23 @@ class AsyncHTTPProxy:
         # no requests.inc here: the handle's remote() counts it (this
         # process), exactly as the edge always has
         try:
+            # app-ingress deployments take the FULL request envelope on
+            # __call__ and route the subpath in-replica (serve.ingress)
+            app_ingress = await self._is_app_ingress(name)
             if stream:
+                if app_ingress:
+                    raise _BadRequest(
+                        "app-ingress deployments do not support ?stream=1")
                 await self._dispatch_stream(name, method, payload, req, writer)
             else:
+                if app_ingress:
+                    method = "__call__"
+                    payload = {
+                        "method": req["method"], "path": subpath,
+                        "query": query,
+                        "payload": (None if req["method"] == "GET"
+                                    else payload),
+                    }
                 handle = self._get_handle(name, method)
                 if getattr(handle, "_replicas", None):
                     # warm handle: submission is sample + one socket send —
@@ -231,50 +249,31 @@ class AsyncHTTPProxy:
                 else:
                     ref = await self._loop.run_in_executor(
                         self._pool, handle.remote, payload)
-                await self._await_ref(ref)
-                import ray_tpu
-                from ray_tpu.core.api import _global_worker
-
-                # terminal inline results resolve without leaving the loop;
-                # plasma results (a blocking fetch) hop to the pool
-                out, ok = _global_worker().try_get_local(ref)
-                if not ok:
-                    # plasma result: the pull gets the full request budget
-                    out = await self._loop.run_in_executor(
-                        self._pool, lambda: ray_tpu.get(
-                            ref, timeout=_REQUEST_TIMEOUT_S))
+                await await_ref(self._loop, ref, _REQUEST_TIMEOUT_S)
+                out = await fetch_value(self._loop, self._pool, ref,
+                                        _REQUEST_TIMEOUT_S)
                 body, ctype = self._encode_result(out)
                 writer.write(self._response(200, body, ctype, req["close"]))
                 await writer.drain()
+        except _BadRequest as e:
+            writer.write(self._response(
+                400, json.dumps({"error": str(e)}).encode(),
+                "application/json", req["close"]))
+            await writer.drain()
         except Exception as e:
             _serve_metrics()["errors"].inc(tags={"deployment": name})
+            # unmatched app routes surface as 404, not server errors (the
+            # type check handles both the live exception and its
+            # deserialized-from-the-replica form)
+            status = 404 if (isinstance(e, RouteNotFound)
+                             or type(e).__name__ == "RouteNotFound") else 500
             writer.write(self._response(
-                500, json.dumps({"error": str(e)}).encode(),
+                status, json.dumps({"error": str(e)}).encode(),
                 "application/json", req["close"]))
             await writer.drain()
         finally:
             _serve_metrics()["latency"].observe(
                 time.monotonic() - t0, tags={"deployment": name})
-
-    async def _await_next_stream_item(self, gen) -> None:
-        """Event-driven wait for the generator's next item: resolves when
-        the ownership layer reports item `gen._i` (or the stream terminal),
-        after which `next(gen)` is guaranteed non-blocking. No parked
-        thread — a node can hold thousands of live token streams."""
-        from ray_tpu.core import worker as _worker_mod
-
-        w = _worker_mod.current_worker()
-        fut = self._loop.create_future()
-
-        def ready() -> None:
-            try:
-                self._loop.call_soon_threadsafe(
-                    lambda: fut.done() or fut.set_result(None))
-            except RuntimeError:
-                pass  # loop already stopped
-
-        w.add_dynamic_return_callback(gen._task_id, gen._i, ready)
-        await asyncio.wait_for(fut, timeout=_REQUEST_TIMEOUT_S)
 
     async def _dispatch_stream(self, name: str, method: str, payload: Any,
                                req: dict, writer) -> None:
@@ -284,8 +283,8 @@ class AsyncHTTPProxy:
         Item arrival rides the same add_done_callback mechanism as the
         non-streaming path (reference http_proxy.py's async streaming
         model), so there is NO thread-per-live-stream and no stream cap."""
-        import ray_tpu
-        from ray_tpu.core.api import _global_worker
+        from ray_tpu.serve.edge_util import (await_next_stream_item,
+                                             fetch_value)
 
         # submit BEFORE the 200 goes out: submission failures (no replicas,
         # unknown deployment) still produce a clean 500 via the caller
@@ -309,18 +308,14 @@ class AsyncHTTPProxy:
         try:
             while True:
                 if not gen._done:
-                    await self._await_next_stream_item(gen)
+                    await await_next_stream_item(self._loop, gen,
+                                                 _REQUEST_TIMEOUT_S)
                 try:
                     ref = next(gen)
                 except StopIteration:
                     break
-                # the reported item is already terminal: inline values
-                # resolve on the loop; plasma values hop to the pool
-                item, ok = _global_worker().try_get_local(ref)
-                if not ok:
-                    item = await self._loop.run_in_executor(
-                        self._pool, lambda r=ref: ray_tpu.get(
-                            r, timeout=_REQUEST_TIMEOUT_S))
+                item = await fetch_value(self._loop, self._pool, ref,
+                                         _REQUEST_TIMEOUT_S)
                 if isinstance(item, (bytes, bytearray, memoryview)):
                     chunk = bytes(item)
                 elif isinstance(item, str):

@@ -1,0 +1,236 @@
+"""Serve ingress parity: multi-route app mounting (serve.ingress), the
+gRPC edge (Predict + PredictStream), and push-backed weight fan-out."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster(ray_start_regular):
+    yield ray_start_regular
+    serve.shutdown()
+
+
+def _http(port, method, path, body=None):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    headers = {"Content-Type": "application/json"} if body is not None else {}
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers=headers)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+# ----------------------------------------------------------- app ingress
+
+
+def test_app_ingress_routes_params_middleware(serve_cluster):
+    """@serve.ingress mounts a multi-route app: path params, per-route
+    methods, middleware wrapping, query args, and 404s for missing routes
+    (reference python/ray/serve/api.py:160 serve.ingress)."""
+    app = serve.App()
+
+    @app.middleware
+    def stamp(request, call_next):
+        out = call_next(request)
+        if isinstance(out, dict):
+            out["via"] = out.get("via", "") + "mw"
+        return out
+
+    @serve.deployment
+    @serve.ingress(app)
+    class Store:
+        def __init__(self):
+            self.items = {"1": "apple"}
+
+        @app.get("/items/{item_id}")
+        def get_item(self, request, item_id):
+            if item_id not in self.items:
+                raise KeyError(item_id)
+            return {"item": self.items[item_id]}
+
+        @app.post("/items/{item_id}")
+        def put_item(self, request, item_id):
+            self.items[item_id] = request.payload["value"]
+            return {"stored": item_id}
+
+        @app.get("/search")
+        def search(self, request):
+            q = request.query.get("q", "")
+            return {"hits": [k for k, v in self.items.items() if q in v]}
+
+    serve.run(Store.bind())
+    _, port = serve.start_http_proxy()
+
+    status, body = _http(port, "GET", "/Store/items/1")
+    assert status == 200
+    assert json.loads(body)["result"] == {"item": "apple", "via": "mw"}
+
+    status, body = _http(port, "POST", "/Store/items/2", {"value": "pear"})
+    assert status == 200
+    assert json.loads(body)["result"]["stored"] == "2"
+
+    status, body = _http(port, "GET", "/Store/search?q=pear")
+    assert status == 200
+    assert json.loads(body)["result"]["hits"] == ["2"]
+
+    status, body = _http(port, "GET", "/Store/nope/deeper")
+    assert status == 404, body
+    assert "matched no route" in json.loads(body)["error"]
+
+
+def test_app_dispatch_unit():
+    """Router semantics without a cluster: method filtering, parameter
+    extraction, middleware ordering."""
+    from ray_tpu.serve.ingress import App, Request, RouteNotFound
+
+    app = App()
+    calls = []
+
+    @app.middleware
+    def outer(req, nxt):
+        calls.append("outer")
+        return nxt(req)
+
+    @app.middleware
+    def inner(req, nxt):
+        calls.append("inner")
+        return nxt(req)
+
+    @app.get("/a/{x}/b/{y}")
+    def handler(request, x, y):
+        return (x, y)
+
+    assert app.dispatch(None, Request("GET", "/a/1/b/2")) == ("1", "2")
+    assert calls == ["outer", "inner"]  # outermost first
+    with pytest.raises(RouteNotFound):
+        app.dispatch(None, Request("POST", "/a/1/b/2"))  # wrong method
+
+
+# ------------------------------------------------------------------ gRPC
+
+
+def test_grpc_ingress_echo_and_stream(serve_cluster):
+    """gRPC edge parity (reference serve.proto:235): unary Predict routes
+    by metadata; PredictStream relays a generator deployment's items as
+    server-stream messages arriving incrementally."""
+    grpc = pytest.importorskip("grpc")
+
+    @serve.deployment
+    def echo(payload):
+        return {"echo": payload}
+
+    @serve.deployment
+    def ticker(payload):
+        for i in range(4):
+            time.sleep(0.3)
+            yield {"tok": i}
+
+    serve.run(echo.bind())
+    serve.run(ticker.bind(), name="t")
+    _, port = serve.start_grpc_proxy()
+
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    predict = ch.unary_unary("/rayserve.Ingress/Predict")
+    out = predict(json.dumps({"x": 41}).encode(),
+                  metadata=(("deployment", "echo"),), timeout=30)
+    assert json.loads(out)["result"] == {"echo": {"x": 41}}
+
+    stream = ch.unary_stream("/rayserve.Ingress/PredictStream")
+    t0 = time.monotonic()
+    stamps, items = [], []
+    for msg in stream(json.dumps({}).encode(),
+                      metadata=(("deployment", "ticker"),), timeout=60):
+        stamps.append(time.monotonic() - t0)
+        items.append(json.loads(msg)["result"])
+    assert items == [{"tok": i} for i in range(4)]
+    # messages arrive while the replica still produces (streaming, not
+    # buffer-then-flush)
+    assert stamps[0] < stamps[-1] - 0.4, stamps
+    ch.close()
+
+
+def test_grpc_ingress_missing_deployment_metadata(serve_cluster):
+    grpc = pytest.importorskip("grpc")
+
+    _, port = serve.start_grpc_proxy()
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    predict = ch.unary_unary("/rayserve.Ingress/Predict")
+    with pytest.raises(grpc.RpcError):
+        predict(b"{}", timeout=10)
+    ch.close()
+
+
+# ------------------------------------------------------- push fan-out
+
+
+def test_broadcast_weights_push_fanout():
+    """Learner-weight broadcast rides ray_tpu.push: one plasma object, one
+    owner-directed broadcast, every worker applies the same weights — and
+    the push shows in the transfer metrics."""
+    from ray_tpu.core.cluster import Cluster
+    from ray_tpu.rllib.learner import broadcast_weights
+    from ray_tpu.util.metrics import snapshot
+
+    cluster = Cluster()
+    for _ in range(3):
+        cluster.add_node(num_cpus=1)
+    cluster.connect()
+    try:
+
+        @ray_tpu.remote
+        class Worker:
+            def __init__(self):
+                self.w = None
+
+            def set_weights(self, w):
+                self.w = {k: np.asarray(v) for k, v in w.items()}
+                return True
+
+            def checksum(self):
+                return float(sum(v.sum() for v in self.w.values()))
+
+        workers = [Worker.options(num_cpus=1).remote() for _ in range(3)]
+        weights = {"w0": np.random.default_rng(0).standard_normal(
+            (512, 1024)).astype(np.float32)}
+        before = snapshot().get("ray_tpu_push_requests_total", {})
+        n_before = sum(before.get("values", {}).values()) if before else 0
+        broadcast_weights(weights, workers)
+        after = snapshot()["ray_tpu_push_requests_total"]
+        assert sum(after["values"].values()) >= n_before + 1
+        want = float(weights["w0"].sum())
+        got = ray_tpu.get([w.checksum.remote() for w in workers], timeout=60)
+        assert all(abs(g - want) < 1e-3 * abs(want) for g in got)
+    finally:
+        cluster.shutdown()
+
+
+def test_serve_deploy_pushes_large_definition(serve_cluster):
+    """A >1MiB deployment definition ships as ONE pushed plasma object:
+    every replica still builds correctly (functional proof that the
+    ref-arg path resolves), and redeploys roll as before."""
+    big = np.random.default_rng(1).standard_normal(300_000).astype(
+        np.float32)  # ~1.2MB baked into the definition blob
+
+    @serve.deployment(num_replicas=2)
+    class Model:
+        def __init__(self):
+            self.w = big
+
+        def __call__(self, payload):
+            return {"dot": float(self.w[:8].sum()), "n": len(self.w)}
+
+    handle = serve.run(Model.bind())
+    out = ray_tpu.get(handle.remote({}), timeout=60)
+    assert out["n"] == 300_000
+    assert abs(out["dot"] - float(big[:8].sum())) < 1e-4
