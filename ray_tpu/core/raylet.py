@@ -441,7 +441,8 @@ class Raylet:
                 try:
                     ctx = self._env_manager.context_for(runtime_env)
                     env.update(ctx.env_vars)  # plugin-contributed worker env
-                    self._launch_worker(ctx.python, env)
+                    self._launch_worker(ctx.python, env,
+                                        command_prefix=ctx.command_prefix)
                 except Exception as e:  # ANY plugin/spawn failure fails tasks
                     logger.warning("%s", e)
                     self._env_manager.release(env_key)
@@ -455,13 +456,27 @@ class Raylet:
             return
         self._launch_worker(python, env)
 
-    def _launch_worker(self, python: str, env: Dict[str, str]) -> None:
-        proc = subprocess.Popen(
-            [python, "-m", "ray_tpu.core.worker_main",
-             "--raylet", self._server.address, "--gcs", self.gcs_address,
-             "--node-id", self.node_id.hex()],
-            env=env,
-        )
+    def _launch_worker(self, python: str, env: Dict[str, str],
+                       command_prefix=None) -> None:
+        argv = [python, "-m", "ray_tpu.core.worker_main",
+                "--raylet", self._server.address, "--gcs", self.gcs_address,
+                "--node-id", self.node_id.hex()]
+        if command_prefix:
+            prefix = list(command_prefix)
+            if "{ENVFILE}" in prefix:
+                # container boundary: the worker env crosses via an env
+                # file (Popen's env= only reaches the engine CLI itself)
+                import tempfile
+
+                fd, envfile = tempfile.mkstemp(prefix="rtpu-worker-",
+                                               suffix=".env")
+                with os.fdopen(fd, "w") as f:
+                    for k, v in env.items():
+                        if "\n" not in v:
+                            f.write(f"{k}={v}\n")
+                prefix = [envfile if a == "{ENVFILE}" else a for a in prefix]
+            argv = prefix + argv
+        proc = subprocess.Popen(argv, env=env)
         with self._lock:
             self._starting.append(proc)
             key = env.get("RAY_TPU_RUNTIME_ENV_KEY")

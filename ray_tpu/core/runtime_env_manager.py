@@ -35,10 +35,17 @@ _DEFAULT_BASE = "/tmp/ray_tpu/runtime_envs"
 
 @dataclass
 class EnvContext:
-    """What a plugin contributes to worker startup."""
+    """What a plugin contributes to worker startup.
+
+    command_prefix wraps the worker's argv (container engines, launchers):
+    the raylet runs `command_prefix + [python, -m, worker_main, ...]`.
+    A literal "{ENVFILE}" element is replaced at spawn time with the path
+    of a KEY=VALUE file holding the worker's environment (how env vars
+    cross a container boundary)."""
 
     python: str = sys.executable
     env_vars: Dict[str, str] = field(default_factory=dict)
+    command_prefix: List[str] = field(default_factory=list)
 
 
 class RuntimeEnvPlugin:
@@ -213,9 +220,88 @@ class _PyModulesPlugin(RuntimeEnvPlugin):
                       for m in value or [])
 
 
+def build_container_command(spec: dict, *, engine: str,
+                            pkg_root: Optional[str] = None,
+                            base_dir: str = _DEFAULT_BASE) -> List[str]:
+    """Assemble the `docker|podman run` prefix that wraps a worker
+    (reference python/ray/_private/runtime_env/container.py
+    `get_container_option` → worker command wrapping). Pure function so
+    request shape is unit-testable without a container daemon.
+
+    The container shares the host network (raylet/GCS run on host TCP
+    ports), the shared-memory arena (/dev/shm bind mount), the runtime-env
+    base dir (session artifacts), and a read-only mount of the framework
+    source; the worker env crosses the boundary via --env-file (the
+    "{ENVFILE}" placeholder is materialized at spawn)."""
+    image = spec.get("image")
+    if not image:
+        raise ValueError("container runtime_env needs an 'image'")
+    if pkg_root is None:
+        import ray_tpu
+
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+    cmd = [engine, "run", "--rm", "--network=host",
+           "-v", "/dev/shm:/dev/shm",
+           "-v", f"{base_dir}:{base_dir}",
+           "-v", f"{pkg_root}:{pkg_root}:ro",
+           "--env-file", "{ENVFILE}"]
+    cmd += [str(o) for o in spec.get("run_options", [])]
+    cmd.append(image)
+    return cmd
+
+
+class ContainerPlugin(RuntimeEnvPlugin):
+    """`{"container": {"image": ..., "run_options": [...], "engine": ...,
+    "python": ...}}` runs the worker inside a container (reference
+    `python/ray/_private/runtime_env/container.py`). Requires docker or
+    podman on PATH at create time; `python` names the interpreter INSIDE
+    the image (default python3)."""
+
+    name = "container"
+
+    @staticmethod
+    def _norm(value) -> dict:
+        if isinstance(value, str):
+            return {"image": value}
+        return dict(value or {})
+
+    def key_spec(self, value):
+        return json.dumps(self._norm(value), sort_keys=True)
+
+    @staticmethod
+    def _engine(spec: dict) -> str:
+        eng = spec.get("engine")
+        if eng:
+            if shutil.which(eng) is None:
+                raise RuntimeError(
+                    f"container engine {eng!r} not found on PATH")
+            return eng
+        for cand in ("podman", "docker"):
+            if shutil.which(cand):
+                return cand
+        raise RuntimeError(
+            "runtime_env 'container' requires docker or podman on PATH")
+
+    def create(self, value, env_dir: str) -> None:
+        spec = self._norm(value)
+        if not spec.get("image"):
+            raise RuntimeError("container runtime_env needs an 'image'")
+        self._engine(spec)  # fail fast where no container runtime exists
+        os.makedirs(env_dir, exist_ok=True)
+
+    def modify_context(self, value, env_dir: str, ctx: EnvContext) -> None:
+        spec = self._norm(value)
+        ctx.command_prefix = build_container_command(
+            spec, engine=self._engine(spec))
+        # the interpreter path must resolve INSIDE the image
+        ctx.python = spec.get("python", "python3")
+
+
 register_plugin(PipPlugin())
 register_plugin(CondaPlugin())
 register_plugin(_PyModulesPlugin())
+register_plugin(ContainerPlugin())
 
 
 # ------------------------------------------------------------------- keys
@@ -373,6 +459,12 @@ class RuntimeEnvManager:
             raise RuntimeError(
                 "runtime_env 'pip' and 'conda' are mutually exclusive "
                 "(put pip packages inside the conda dependencies instead)")
+        if runtime_env.get("container") and (runtime_env.get("pip")
+                                             or runtime_env.get("conda")):
+            raise RuntimeError(
+                "runtime_env 'container' cannot be combined with "
+                "'pip'/'conda' — bake the packages into the image "
+                "(the reference imposes the same constraint)")
         active = [p for p in _active_plugins(runtime_env) if p.pooled]
 
         def contexts(env_dir: str) -> EnvContext:
