@@ -4,3 +4,6 @@ from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
 from ray_tpu.train.checkpointing import abstract_like, restore_sharded, save_sharded
 from ray_tpu.train.sklearn import SklearnPredictor, SklearnTrainer
 from ray_tpu.train.huggingface import TransformersTrainer
+from ray_tpu.train.gbdt import (GBDTPredictor, GBDTTrainer, LightGBMTrainer,
+                                LightGBMPredictor, XGBoostPredictor,
+                                XGBoostTrainer)
