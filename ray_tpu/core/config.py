@@ -82,8 +82,11 @@ class Config:
     memory_monitor_kill_cooldown_ms: int = 1000
 
     # --- data streaming executor (cf. reference streaming_executor.py:45:
-    # operator-level backpressure; here: bounded in-flight block tasks) ---
+    # operator-level backpressure; here: bounded in-flight block tasks
+    # AND a per-operator byte budget on produced-but-unconsumed blocks,
+    # the reference's per-op resource quota) ---
     data_max_inflight_blocks: int = 8
+    data_op_memory_budget_bytes: int = 256 * 1024 * 1024
 
     # --- object transfer (cf. reference object_manager.h:117 64MiB chunks,
     # pull_manager.h:52 admission control, push_manager.h:29) ---

@@ -1272,6 +1272,16 @@ class CoreWorker:
                     return None, True, None
                 self._obj_cv.wait(timeout=1.0)
 
+    def object_size(self, ref: ObjectRef):
+        """Size in bytes of a TERMINAL owned object (None while pending or
+        unknown) — the streaming executor's byte-budget accounting reads
+        this without fetching values."""
+        with self._obj_lock:
+            st = self._objects.get(ref.id)
+            if st is not None and st.state in ("inline", "plasma"):
+                return st.size
+        return None
+
     def add_dynamic_return_callback(self, task_id: TaskID, i: int,
                                     cb) -> None:
         """Event-driven streaming: invoke `cb()` (from whichever thread

@@ -19,6 +19,8 @@ from ray_tpu.data.datastream import (
     from_arrow,
 )
 
+from ray_tpu.data.expressions import ColumnPredicate, col
+
 from ray_tpu.data.datasources import (
     read_images,
     read_mongo,

@@ -118,6 +118,14 @@ def _apply_ops(block: Block, ops: List[tuple]) -> Block:
         elif kind == "filter":
             fn = op[1]
             block = _rows_to_block([r for r in _block_rows(block) if fn(r)])
+        elif kind == "filter_expr":
+            pred = op[1]
+            if isinstance(block, dict):
+                mask = pred.mask(block)
+                block = {k: np.asarray(v)[mask] for k, v in block.items()}
+            else:
+                block = _rows_to_block(
+                    [r for r in _block_rows(block) if pred(r)])
         elif kind == "limit":
             block = _slice_block(block, 0, op[1])
     return block
@@ -148,14 +156,124 @@ def _apply_batched(fn, batch_size: int, block: Block) -> Block:
     return _concat_blocks(outs)
 
 
+class _SourceSpec:
+    """Lazy, pushdown-capable read (reference `python/ray/data/datasource/
+    parquet_datasource.py:179,214`): the reader tasks are NOT submitted at
+    read_*() time — they launch when blocks are first needed, with the
+    plan's leading select/predicate ops folded into the reader call, so
+    column pruning and row-group filtering happen at the FILE layer.
+
+    Pushed ops stay in the op chain (selects and predicate filters are
+    idempotent), so no plan surgery is needed for correctness."""
+
+    def __init__(self, kind: str, paths: List[str], loader,
+                 supports_columns: bool = False,
+                 supports_filters: bool = False,
+                 columns: Optional[List[str]] = None,
+                 filters: Optional[list] = None):
+        self.kind = kind
+        self.paths = list(paths)
+        self.loader = loader
+        self.supports_columns = supports_columns
+        self.supports_filters = supports_filters
+        self.columns = list(columns) if columns else None
+        self.filters = list(filters) if filters else None
+        # branched pipelines (two streams derived from one read) share one
+        # scan per distinct pushdown instead of re-reading every file
+        self._submitted: Dict[Any, List[ObjectRef]] = {}
+
+    def pushdown(self, ops: List[tuple]):
+        """(columns, filters, pushed_labels) for the optimized chain: the
+        leading run of select-only projections and predicate filters folds
+        into the reader; the scan stops at the first op that could change
+        names or rows in a way the reader can't express."""
+        from ray_tpu.data.plan import optimize
+
+        optimized, _ = optimize(list(ops))
+        columns = self.columns
+        filters = list(self.filters or [])
+        # columns of filters pushed FROM THE CHAIN: the chain re-applies
+        # them (idempotently), so the read must keep those columns even
+        # when a later select drops them
+        chain_filter_cols: List[str] = []
+        pushed: List[str] = []
+        for op in optimized:
+            if op[0] == "project" and self.supports_columns:
+                spec = op[1]
+                steps = spec.get("steps") or [spec]
+                first = steps[0]
+                if "select" not in first:
+                    break  # drop/rename head: column set not derivable
+                if columns is None:
+                    sel = list(first["select"])
+                    columns = sel + [c for c in chain_filter_cols
+                                     if c not in sel]
+                    pushed.append(f"columns={sel}")
+                if not all("select" in s for s in steps):
+                    break  # renames ahead: later predicate names unsafe
+            elif op[0] == "filter_expr" and self.supports_filters:
+                pred = op[1]
+                if columns is not None and pred.column not in columns:
+                    # predicate on a column the pushed select dropped: the
+                    # executor path must raise (as it always did), not the
+                    # reader silently filter on an unread column
+                    break
+                filters.append(pred.as_tuple())
+                chain_filter_cols.append(pred.column)
+                pushed.append(f"filter[{pred!r}]")
+            else:
+                break
+        return columns, (filters or None), pushed
+
+    def submit(self, ops: List[tuple]) -> List[ObjectRef]:
+        columns, filters, _ = self.pushdown(ops)
+        key = (tuple(columns) if columns else None,
+               tuple(filters) if filters else None)
+        if key not in self._submitted:
+            self._submitted[key] = [self.loader.remote(p, columns, filters)
+                                    for p in self.paths]
+        return self._submitted[key]
+
+    def describe(self, ops: List[tuple]) -> str:
+        columns, filters, pushed = self.pushdown(ops)
+        extra = f", pushdown: {' '.join(pushed)}" if pushed else ""
+        return (f"Source[{self.kind}, {len(self.paths)} files{extra}]")
+
+
 class Datastream:
     """A lazy, distributed dataset. (alias: Dataset)"""
 
-    def __init__(self, block_refs: List[ObjectRef], ops: Optional[List[tuple]] = None):
-        self._block_refs = list(block_refs)
+    def __init__(self, block_refs: Optional[List[ObjectRef]],
+                 ops: Optional[List[tuple]] = None,
+                 source: Optional[_SourceSpec] = None):
+        self._refs: Optional[List[ObjectRef]] = (
+            list(block_refs) if block_refs is not None else None)
+        self._source = source
+        if self._refs is None and source is None:
+            raise ValueError("Datastream needs block refs or a source")
         # LOGICAL operator chain (data/plan.py); execution sites lower it
         # through the optimizer passes via _physical_ops
         self._ops: List[tuple] = list(ops or [])
+
+    @property
+    def _block_refs(self) -> List[ObjectRef]:
+        """Materialize the source on first use (reader tasks launch with
+        this stream's pushed-down columns/filters)."""
+        if self._refs is None:
+            self._refs = self._source.submit(self._ops)
+        return self._refs
+
+    @_block_refs.setter
+    def _block_refs(self, refs: List[ObjectRef]) -> None:
+        self._refs = list(refs)
+
+    def _derive(self, extra_ops: List[tuple]) -> "Datastream":
+        """Lazy transform: keep the unsubmitted source flowing so later
+        ops can still push into the readers."""
+        if self._refs is None:
+            return Datastream(None, self._ops + extra_ops,
+                              source=self._source)
+        return Datastream(self._refs, self._ops + extra_ops)
 
 
     @property
@@ -169,16 +287,21 @@ class Datastream:
 
     def explain(self) -> str:
         """Printable logical plan, applied rules, optimized plan, and
-        physical op list (reference Dataset.explain)."""
+        physical op list (reference Dataset.explain). For lazy sources the
+        header shows the reader-level pushdown (columns/filters) without
+        submitting any read."""
         from ray_tpu.data.plan import explain_ops
 
-        text = explain_ops(len(self._block_refs), self._ops)
+        source_desc = (self._source.describe(self._ops)
+                       if self._refs is None else None)
+        text = explain_ops(self.num_blocks(), self._ops,
+                           source_desc=source_desc)
         print(text)
         return text
 
     # ---------------------------------------------------------- transforms
     def map(self, fn: Callable[[Any], Any]) -> "Datastream":
-        return Datastream(self._block_refs, self._ops + [("map", fn)])
+        return self._derive([("map", fn)])
 
     def map_batches(self, fn, *,
                     batch_format: str = "numpy",
@@ -201,7 +324,7 @@ class Datastream:
                 fn, compute, fn_constructor_args, batch_size)
         if batch_size is not None:
             fn = functools.partial(_apply_batched, fn, batch_size)
-        return Datastream(self._block_refs, self._ops + [("map_batches", fn)])
+        return self._derive([("map_batches", fn)])
 
     def _map_batches_actors(self, fn_cls: type,
                             compute: "ActorPoolStrategy",
@@ -243,10 +366,18 @@ class Datastream:
         return Datastream(refs)
 
     def flat_map(self, fn: Callable[[Any], Iterable[Any]]) -> "Datastream":
-        return Datastream(self._block_refs, self._ops + [("flat_map", fn)])
+        return self._derive([("flat_map", fn)])
 
-    def filter(self, fn: Callable[[Any], bool]) -> "Datastream":
-        return Datastream(self._block_refs, self._ops + [("filter", fn)])
+    def filter(self, fn) -> "Datastream":
+        """Row filter. A `col("x") > 5` predicate expression runs as a
+        vectorized mask AND pushes into parquet readers (row-group pruning
+        by statistics); a plain callable filters row-wise in the executor
+        (opaque to pushdown, like the reference's non-expression UDFs)."""
+        from ray_tpu.data.expressions import ColumnPredicate
+
+        if isinstance(fn, ColumnPredicate):
+            return self._derive([("filter_expr", fn)])
+        return self._derive([("filter", fn)])
 
     # stats-aware partitioning: target rows per output block when the
     # caller doesn't pick a count (reference streaming executor's
@@ -374,16 +505,13 @@ class Datastream:
         return self.map_batches(add)
 
     def drop_columns(self, cols: List[str]) -> "Datastream":
-        return Datastream(self._block_refs,
-                          self._ops + [("project", {"drop": list(cols)})])
+        return self._derive([("project", {"drop": list(cols)})])
 
     def select_columns(self, cols: List[str]) -> "Datastream":
-        return Datastream(self._block_refs,
-                          self._ops + [("project", {"select": list(cols)})])
+        return self._derive([("project", {"select": list(cols)})])
 
     def rename_columns(self, mapping: Dict[str, str]) -> "Datastream":
-        return Datastream(self._block_refs,
-                          self._ops + [("project", {"rename": dict(mapping)})])
+        return self._derive([("project", {"rename": dict(mapping)})])
 
     # ----------------------------------------------------------- execution
     def materialize(self) -> "Datastream":
@@ -395,25 +523,45 @@ class Datastream:
     def _executed_refs(self) -> List[ObjectRef]:
         return self.materialize()._block_refs
 
-    def _stream_refs(self, max_inflight: Optional[int] = None) -> Iterator[ObjectRef]:
+    def _stream_refs(self, max_inflight: Optional[int] = None,
+                     memory_budget: Optional[int] = None) -> Iterator[ObjectRef]:
         """Backpressured streaming execution (reference
         `_internal/execution/streaming_executor.py:45`): yield executed block
         refs in order while keeping at most `max_inflight` block tasks
-        submitted-but-unconsumed, so consumption drives submission and a
-        dataset far larger than the object store streams through a bounded
-        window instead of flooding it."""
+        submitted-but-unconsumed AND at most `memory_budget` bytes of
+        PRODUCED-but-unconsumed results (the per-operator memory quota of
+        the reference's streaming executor): an operator whose outputs
+        balloon stops getting new submissions until the consumer drains,
+        regardless of the count window."""
         if not self._ops:
             yield from self._block_refs
             return
-        if max_inflight is None:
-            from ray_tpu.core.config import get_config
+        from ray_tpu.core.config import get_config
 
-            max_inflight = get_config().data_max_inflight_blocks
+        cfg = get_config()
+        if max_inflight is None:
+            max_inflight = cfg.data_max_inflight_blocks
+        if memory_budget is None:
+            memory_budget = cfg.data_op_memory_budget_bytes
+        from ray_tpu.core.api import _global_worker
+
+        w = _global_worker()
+
+        def produced_bytes(refs) -> int:
+            total = 0
+            for r in refs:
+                sz = w.object_size(r)  # None while the task still runs
+                if sz:
+                    total += sz
+            return total
+
         inflight: deque = deque()
+        ops = self._physical_ops
         for r in self._block_refs:
-            if len(inflight) >= max_inflight:
+            while len(inflight) >= max_inflight or (
+                    inflight and produced_bytes(inflight) >= memory_budget):
                 yield inflight.popleft()
-            inflight.append(_exec_block.remote(r, self._physical_ops))
+            inflight.append(_exec_block.remote(r, ops))
         while inflight:
             yield inflight.popleft()
 
@@ -558,7 +706,9 @@ class Datastream:
         return None
 
     def num_blocks(self) -> int:
-        return len(self._block_refs)
+        if self._refs is None:
+            return len(self._source.paths)  # known without reading
+        return len(self._refs)
 
     def iter_rows(self) -> Iterator[Any]:
         for ref in self._stream_refs():
@@ -647,7 +797,9 @@ class Datastream:
         return [DataIterator(coord, i) for i in builtins.range(n)]
 
     def __repr__(self):
-        return (f"Datastream(num_blocks={len(self._block_refs)}, "
+        # num_blocks(), NOT _block_refs: printing a lazy stream (a REPL
+        # echo!) must never launch the distributed read
+        return (f"Datastream(num_blocks={self.num_blocks()}, "
                 f"pending_ops={len(self._ops)})")
 
 
@@ -1060,30 +1212,47 @@ def read_json(paths: Union[str, List[str]]) -> Datastream:
     return Datastream([load.remote(p) for p in paths])
 
 
-def read_csv(paths: Union[str, List[str]]) -> Datastream:
+@ray_tpu.remote
+def _load_csv(path: str, columns, filters) -> Block:
+    import csv
+
+    with open(path) as f:
+        rows = [dict(r) for r in csv.DictReader(f)]
+    if columns:
+        rows = [{c: r[c] for c in columns} for r in rows]
+    return _rows_to_block(rows)
+
+
+def read_csv(paths: Union[str, List[str]], *,
+             columns: Optional[List[str]] = None) -> Datastream:
+    """CSV read; `columns` (given or pushed down from a later select)
+    prunes parsed columns at the reader."""
     paths = [paths] if isinstance(paths, str) else list(paths)
-
-    @ray_tpu.remote
-    def load(path: str) -> Block:
-        import csv
-
-        with open(path) as f:
-            return _rows_to_block([dict(r) for r in csv.DictReader(f)])
-
-    return Datastream([load.remote(p) for p in paths])
+    return Datastream(None, source=_SourceSpec(
+        "csv", paths, _load_csv, supports_columns=True, columns=columns))
 
 
-def read_parquet(paths: Union[str, List[str]]) -> Datastream:
+@ray_tpu.remote
+def _load_parquet(path: str, columns, filters) -> Block:
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(path, columns=columns, filters=filters)
+    return {c: _arrow_to_numpy(table[c]) for c in table.column_names}
+
+
+def read_parquet(paths: Union[str, List[str]], *,
+                 columns: Optional[List[str]] = None,
+                 filters: Optional[list] = None) -> Datastream:
+    """Parquet read with FILE-LAYER pruning (reference
+    parquet_datasource.py:179,214): `columns` decodes only those columns;
+    `filters` ([(col, op, value), ...]) prunes row groups by statistics
+    before decoding. Both also arrive automatically via pushdown from
+    later `select_columns`/`filter(col(...) ...)` calls — the read is
+    lazy until blocks are first consumed."""
     paths = [paths] if isinstance(paths, str) else list(paths)
-
-    @ray_tpu.remote
-    def load(path: str) -> Block:
-        import pyarrow.parquet as pq
-
-        table = pq.read_table(path)
-        return {c: _arrow_to_numpy(table[c]) for c in table.column_names}
-
-    return Datastream([load.remote(p) for p in paths])
+    return Datastream(None, source=_SourceSpec(
+        "parquet", paths, _load_parquet, supports_columns=True,
+        supports_filters=True, columns=columns, filters=filters))
 
 
 def read_numpy(paths: Union[str, List[str]]) -> Datastream:

@@ -10,6 +10,7 @@ be recorded.
 Logical operators (tuples, like the physical ops they extend):
   ("map", fn) ("flat_map", fn) ("filter", fn) ("map_batches", fn)
   ("project", {"select": [..]} | {"drop": [..]} | {"rename": {..}})
+  ("filter_expr", ColumnPredicate)   # pushable into parquet readers
   ("limit", n)
 
 Passes:
@@ -150,6 +151,8 @@ def _op_label(op: tuple) -> str:
         spec = op[1]
         steps = spec.get("steps") or [spec]
         return "Project[%s]" % "+".join(next(iter(s)) for s in steps)
+    if kind == "filter_expr":
+        return f"Filter[{op[1]!r}]"
     if kind == "limit":
         return f"Limit[{op[1]}]"
     fn = op[1]
@@ -157,10 +160,11 @@ def _op_label(op: tuple) -> str:
     return f"{kind.title().replace('_', '')}({name})"
 
 
-def explain_ops(num_blocks: int, logical: List[tuple]) -> str:
+def explain_ops(num_blocks: int, logical: List[tuple],
+                source_desc: str = None) -> str:
     optimized, applied = optimize(list(logical))
     physical = lower(optimized)
-    lines = [f"Source[{num_blocks} blocks]"]
+    lines = [source_desc or f"Source[{num_blocks} blocks]"]
     lines += [f"  -> {_op_label(op)}" for op in logical]
     lines.append("Optimized (rules: %s):" % (", ".join(applied) or "none"))
     lines += [f"  -> {_op_label(op)}" for op in optimized]
