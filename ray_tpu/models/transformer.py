@@ -26,6 +26,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from ray_tpu.ops.attention import attention
 from ray_tpu.ops.layers import apply_rotary, rms_norm, rotary_embedding, swiglu
@@ -43,7 +44,10 @@ class ModelConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
-    remat: str = "full"          # "none" | "full" | "dots" (selective)
+    # "none" | "full" | "dots" (selective) | "dots_sans_qkv" (dots minus the
+    # q/k/v saves — fits bigger models) | "dots_plus_attn" (dots plus the
+    # attention kernel output — no flash-fwd rerun in backward)
+    remat: str = "full"
     loss_chunk: int = 0          # >0: chunked cross-entropy (seq chunk size)
     use_ring_attention: bool = False  # set when mesh sp > 1
     # sequence-parallel scheme when sp > 1: "ring" (K/V rotation via
@@ -51,6 +55,12 @@ class ModelConfig:
     # use_ring_attention=True is kept as an alias for seq_parallel="ring".
     seq_parallel: str = ""
     tie_embeddings: bool = False
+    scan_unroll: int = 1         # lax.scan unroll over layers
+    # concatenate wq|wk|wv and w_gate|w_up at trace time so each pair of
+    # projections is one MXU matmul (params stay separate leaves — the
+    # concat is a per-layer 16 MB re-layout XLA schedules off the critical
+    # path; the backward then emits one fused dx/dW per group)
+    fused_proj: bool = False
     # Mixture of Experts: n_experts > 0 replaces the dense FFN with a
     # top-2-gated MoE (ops/moe.py); experts shard over the "expert" axis.
     n_experts: int = 0
@@ -187,14 +197,26 @@ def _layer(cfg: ModelConfig, mesh, x, layer_params, cos, sin):
     hd = cfg.head_dim
 
     h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
-    q = (h @ p["wq"]).reshape(b, s, cfg.n_heads, hd)
-    k = (h @ p["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
-    v = (h @ p["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
+    nq_d, nkv_d = cfg.n_heads * hd, cfg.n_kv_heads * hd
+    if cfg.fused_proj:
+        qkv = h @ jnp.concatenate([p["wq"], p["wk"], p["wv"]], axis=1)
+        q, k, v = (qkv[..., :nq_d], qkv[..., nq_d:nq_d + nkv_d],
+                   qkv[..., nq_d + nkv_d:])
+    else:
+        q, k, v = h @ p["wq"], h @ p["wk"], h @ p["wv"]
+    q = q.reshape(b, s, cfg.n_heads, hd)
+    k = k.reshape(b, s, cfg.n_kv_heads, hd)
+    v = v.reshape(b, s, cfg.n_kv_heads, hd)
+    q, k, v = (checkpoint_name(t, n) for t, n in
+               ((q, "qkv_q"), (k, "qkv_k"), (v, "qkv_v")))
     q = apply_rotary(q, cos, sin)
     k = apply_rotary(k, cos, sin)
-    # [b, heads, s, hd]
-    q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
     sp_scheme = cfg.seq_parallel or ("ring" if cfg.use_ring_attention else "")
+    # [b, heads, s, hd]. (A packed [b, s, h*hd] path through
+    # ops.attention_packed avoids these transposes, but measured ~1%
+    # SLOWER end-to-end at b1 shapes on v5e: the per-head strided block
+    # DMA costs more than the dense transposes it removes.)
+    q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
     if sp_scheme == "ring":
         from ray_tpu.ops.ring_attention import ring_attention_sharded
 
@@ -213,8 +235,9 @@ def _layer(cfg: ModelConfig, mesh, x, layer_params, cos, sin):
         raise ValueError(f"unknown seq_parallel scheme {sp_scheme!r}")
     else:
         attn = attention(q, k, v, causal=True)
-    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
-    x = x + (attn @ p["wo"]).astype(x.dtype)
+    attn = checkpoint_name(
+        attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd), "attn_out")
+    x = x + checkpoint_name((attn @ p["wo"]).astype(x.dtype), "attn_proj")
 
     h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts > 0:
@@ -224,8 +247,14 @@ def _layer(cfg: ModelConfig, mesh, x, layer_params, cos, sin):
                            p["w_down"], cfg.capacity_factor)
         x = x + out.astype(x.dtype)
         return x, aux
-    h = swiglu(h @ p["w_gate"], h @ p["w_up"])
-    x = x + (h @ p["w_down"]).astype(x.dtype)
+    if cfg.fused_proj:
+        gu = h @ jnp.concatenate([p["w_gate"], p["w_up"]], axis=1)
+        gate, up = gu[..., :cfg.d_ff], gu[..., cfg.d_ff:]
+    else:
+        gate, up = h @ p["w_gate"], h @ p["w_up"]
+    h = swiglu(checkpoint_name(gate, "ffn_gate"),
+               checkpoint_name(up, "ffn_up"))
+    x = x + checkpoint_name((h @ p["w_down"]).astype(x.dtype), "ffn_down")
     return x, jnp.zeros((), jnp.float32)
 
 
@@ -233,13 +262,33 @@ def maybe_remat(layer_fn, cfg: ModelConfig):
     """Wrap a layer body per cfg.remat: "full" recomputes everything in the
     backward pass; "dots" keeps matmul outputs resident and recomputes only
     the cheap elementwise/norm ops — most of full remat's memory win at a
-    fraction of its recompute FLOPs."""
+    fraction of its recompute FLOPs; "dots_sans_qkv" additionally drops the
+    q/k/v projections from the saved set (recomputing them costs ~2% of a
+    step — they're re-derived from the layer input the scan already keeps),
+    which is the difference between dots fitting or not for the ~1.2B
+    config on one 16G chip."""
     if cfg.remat == "full":
         return jax.checkpoint(layer_fn)
     if cfg.remat == "dots":
         return jax.checkpoint(
             layer_fn,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    if cfg.remat == "dots_sans_qkv":
+        return jax.checkpoint(
+            layer_fn,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "attn_proj", "ffn_gate", "ffn_up", "ffn_down"))
+    if cfg.remat == "dots_plus_attn":
+        # dots + the attention kernel output: the backward then never
+        # re-runs the flash forward kernel or the rotary/transpose chain —
+        # worth ~3% step time for one extra [b, s, d_model] save per layer.
+        return jax.checkpoint(
+            layer_fn,
+            policy=jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names("attn_out")))
+    if cfg.remat != "none":
+        raise ValueError(f"unknown remat mode {cfg.remat!r}")
     return layer_fn
 
 
@@ -272,7 +321,8 @@ def forward_features_with_aux(params: Dict[str, Any], tokens: jax.Array,
         return (x, aux + layer_aux), None
 
     (x, aux_total), _ = jax.lax.scan(
-        body, (x, jnp.zeros((), jnp.float32)), params["layers"])
+        body, (x, jnp.zeros((), jnp.float32)), params["layers"],
+        unroll=cfg.scan_unroll)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     return x, aux_total
 

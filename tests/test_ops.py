@@ -147,3 +147,37 @@ def test_ulysses_attention_gqa():
     ref = causal_attention_reference(q, kr, vr)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4,
                                atol=2e-4)
+
+
+def test_remat_modes_agree():
+    """All remat policies ("none"/"full"/"dots"/"dots_sans_qkv"/
+    "dots_plus_attn") and fused_proj produce the same loss and grads —
+    they only trade recompute for saved-activation memory."""
+    import dataclasses
+
+    import numpy as np
+
+    from ray_tpu.models.transformer import ModelConfig, init_params, loss_fn
+
+    base = ModelConfig.tiny()
+    params = init_params(jax.random.PRNGKey(0), base)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 65), 0,
+                                base.vocab_size)
+    batch = {"tokens": tokens}
+
+    def vg(cfg):
+        return jax.value_and_grad(
+            lambda p: loss_fn(p, batch, cfg, None)[0])(params)
+
+    (loss0, g0) = vg(base)
+    for variant in (dataclasses.replace(base, remat="full"),
+                    dataclasses.replace(base, remat="dots"),
+                    dataclasses.replace(base, remat="dots_sans_qkv"),
+                    dataclasses.replace(base, remat="dots_plus_attn"),
+                    dataclasses.replace(base, remat="dots", fused_proj=True),
+                    dataclasses.replace(base, remat="none", scan_unroll=2)):
+        loss1, g1 = vg(variant)
+        np.testing.assert_allclose(loss0, loss1, rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(g0),
+                        jax.tree_util.tree_leaves(g1)):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)

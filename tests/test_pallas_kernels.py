@@ -190,3 +190,98 @@ def test_flash_attention_backward_ragged_and_cache():
     for a, b, name in zip(gp, gr, "qkv"):
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4,
                                    err_msg=f"d{name} mismatch")
+
+
+def test_flash_attention_packed_matches_reference():
+    """Packed [b, s, h*d] GQA layout vs reference: fwd + all grads.
+
+    Exercises the head-as-grid-dim index maps (q head h reads kv head
+    h // n_rep) and the dkv kernel's e = r * n_qb + i_q inner axis that
+    accumulates one kv head's gradient over its n_rep query heads."""
+    from ray_tpu.ops.pallas.flash_attention import (
+        _reference, flash_attention_packed)
+
+    b, n_heads, n_kv, s, d = 2, 4, 2, 96, 32
+    n_rep = n_heads // n_kv
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, n_heads * d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, n_kv * d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, n_kv * d), jnp.float32)
+    scale = 1.0 / (d ** 0.5)
+
+    def ref(q, k, v):
+        q3 = q.reshape(b, s, n_heads, d).transpose(0, 2, 1, 3).reshape(
+            b * n_heads, s, d)
+        k4 = k.reshape(b, s, n_kv, d).transpose(0, 2, 1, 3)
+        v4 = v.reshape(b, s, n_kv, d).transpose(0, 2, 1, 3)
+        k3 = jnp.repeat(k4, n_rep, axis=1).reshape(b * n_heads, s, d)
+        v3 = jnp.repeat(v4, n_rep, axis=1).reshape(b * n_heads, s, d)
+        o = _reference(q3, k3, v3, scale, True)
+        return o.reshape(b, n_heads, s, d).transpose(0, 2, 1, 3).reshape(
+            b, s, n_heads * d)
+
+    out = flash_attention_packed(q, k, v, n_heads, n_kv, scale, True, 32, 32,
+                                 32, 32)
+    np.testing.assert_allclose(out, ref(q, k, v), rtol=2e-4, atol=2e-4)
+
+    g = jax.random.normal(jax.random.PRNGKey(3), out.shape, jnp.float32)
+    gp = jax.grad(lambda *a: jnp.sum(flash_attention_packed(
+        *a, n_heads, n_kv, scale, True, 32, 32, 32, 32) * g),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(ref(*a) * g), argnums=(0, 1, 2))(q, k, v)
+    for a, bb, name in zip(gp, gr, "qkv"):
+        np.testing.assert_allclose(a, bb, rtol=2e-4, atol=2e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_attention_packed_wrapper_cpu_fallback():
+    """ops.attention_packed == ops.attention modulo layout on CPU."""
+    from ray_tpu.ops.attention import attention, attention_packed
+
+    b, h, hkv, s, d = 2, 4, 2, 64, 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h * d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, hkv * d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, hkv * d), jnp.float32)
+    out = attention_packed(q, k, v, n_heads=h, n_kv_heads=hkv)
+    ref = attention(q.reshape(b, s, h, d).transpose(0, 2, 1, 3),
+                    k.reshape(b, s, hkv, d).transpose(0, 2, 1, 3),
+                    v.reshape(b, s, hkv, d).transpose(0, 2, 1, 3))
+    np.testing.assert_allclose(
+        out, ref.transpose(0, 2, 1, 3).reshape(b, s, h * d), rtol=1e-5,
+        atol=1e-5)
+
+
+def test_flash_attention_packed_ragged_tail():
+    """Packed GQA layout with sq % block != 0: the padded q/k tails must
+    contribute zero output and zero gradient through the modular
+    e = r * n_qb + i_q index maps."""
+    from ray_tpu.ops.pallas.flash_attention import (
+        _reference, flash_attention_packed)
+
+    b, n_heads, n_kv, s, d = 1, 4, 2, 80, 32
+    n_rep = n_heads // n_kv
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, n_heads * d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, n_kv * d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, n_kv * d), jnp.float32)
+    scale = 1.0 / (d ** 0.5)
+
+    def ref(q, k, v):
+        q3 = q.reshape(b, s, n_heads, d).transpose(0, 2, 1, 3).reshape(
+            b * n_heads, s, d)
+        k4 = k.reshape(b, s, n_kv, d).transpose(0, 2, 1, 3)
+        v4 = v.reshape(b, s, n_kv, d).transpose(0, 2, 1, 3)
+        k3 = jnp.repeat(k4, n_rep, axis=1).reshape(b * n_heads, s, d)
+        v3 = jnp.repeat(v4, n_rep, axis=1).reshape(b * n_heads, s, d)
+        o = _reference(q3, k3, v3, scale, True)
+        return o.reshape(b, n_heads, s, d).transpose(0, 2, 1, 3).reshape(
+            b, s, n_heads * d)
+
+    out = flash_attention_packed(q, k, v, n_heads, n_kv, scale, True, 32, 32,
+                                 32, 32)
+    np.testing.assert_allclose(out, ref(q, k, v), rtol=2e-4, atol=2e-4)
+    gp = jax.grad(lambda *a: jnp.sum(flash_attention_packed(
+        *a, n_heads, n_kv, scale, True, 32, 32, 32, 32)),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(ref(*a)), argnums=(0, 1, 2))(q, k, v)
+    for a, bb, name in zip(gp, gr, "qkv"):
+        np.testing.assert_allclose(a, bb, rtol=2e-4, atol=2e-4,
+                                   err_msg=f"d{name} mismatch")
