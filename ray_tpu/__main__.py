@@ -101,6 +101,17 @@ def main(argv=None) -> int:
     p_stack = sub.add_parser("stack", help="dump local worker stack traces")
     p_stack.add_argument("--address", required=True)
 
+    p_prof = sub.add_parser(
+        "profile",
+        help="on-demand cpu/memory profile of live workers (py-spy role)")
+    p_prof.add_argument("--address", required=True)
+    p_prof.add_argument("--pid", type=int, default=None,
+                        help="one worker pid (default: every worker)")
+    p_prof.add_argument("--kind", choices=("cpu", "memory"), default="cpu")
+    p_prof.add_argument("--duration", type=float, default=5.0)
+    p_prof.add_argument("--output", default=None,
+                        help="write full JSON here (default: print summary)")
+
     p_health = sub.add_parser("healthcheck", help="exit 0 if GCS responds")
     p_health.add_argument("--address", required=True)
 
@@ -229,7 +240,7 @@ def main(argv=None) -> int:
         return 0
 
     if args.cmd in ("memory", "stack", "healthcheck", "global-gc",
-                    "kill-random-node", "logs"):
+                    "kill-random-node", "logs", "profile"):
         # raw GCS/raylet RPC — no driver registration needed
         from ray_tpu.core import rpc as _rpc
 
@@ -293,6 +304,70 @@ def main(argv=None) -> int:
                     out.append(st)
                     c.close()
                 print(json.dumps(out, indent=2))
+                return 0
+            if args.cmd == "profile":
+                import time as _time
+
+                pending = []  # (node_address, pid, token)
+                for n in alive:
+                    c = _rpc.connect_with_retry(n["address"], timeout=5)
+                    try:
+                        out = c.call("profile_worker", {
+                            "pid": args.pid,
+                            "profile_kind": args.kind,
+                            "duration_s": args.duration,
+                        })
+                    finally:
+                        c.close()
+                    if out.get("error") and args.pid is not None:
+                        continue  # pid lives on another node
+                    for s in out.get("started", []):
+                        pending.append((n["address"], s["pid"], s["token"]))
+                if not pending:
+                    print("no matching workers")
+                    return 1
+                deadline = _time.monotonic() + args.duration + 30
+                reports = []
+                while pending and _time.monotonic() < deadline:
+                    _time.sleep(min(args.duration / 2 + 0.2, 2.0))
+                    still = []
+                    for addr, pid, token in pending:
+                        c = _rpc.connect_with_retry(addr, timeout=5)
+                        try:
+                            r = c.call("profile_result", {"token": token})
+                        finally:
+                            c.close()
+                        if r.get("result") is None:
+                            still.append((addr, pid, token))
+                        else:
+                            reports.append(r["result"])
+                    pending = still
+                if args.output:
+                    with open(args.output, "w") as fh:
+                        json.dump(reports, fh, indent=2)
+                    print(f"wrote {len(reports)} profiles to {args.output}")
+                else:
+                    for rep in reports:
+                        print(f"==== pid {rep.get('pid')} "
+                              f"({rep.get('kind')}) ====")
+                        if rep.get("error"):
+                            print(f"  error: {rep['error']}")
+                        elif rep.get("kind") == "memory":
+                            print(f"  rss {rep.get('rss_before')} -> "
+                                  f"{rep.get('rss_after')}")
+                            for site in rep.get("sites", [])[:10]:
+                                print(f"  {site['size_bytes']:>12,}B "
+                                      f"x{site['count']:<6} "
+                                      f"{site['traceback'][-1].strip()}")
+                        else:
+                            total = sum(s["count"]
+                                        for s in rep.get("stacks", []))
+                            for s in rep.get("stacks", [])[:10]:
+                                leaf = s["stack"].rsplit(";", 1)[-1]
+                                pct = 100 * s["count"] / max(total, 1)
+                                print(f"  {pct:5.1f}% {leaf}")
+                if pending:
+                    print(f"({len(pending)} workers did not report in time)")
                 return 0
             if args.cmd == "stack":
                 import os as _os

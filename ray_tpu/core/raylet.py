@@ -699,6 +699,35 @@ class Raylet:
                 "env_key": w.env_key,
             } for w in self._workers.values() if not w.is_driver]
 
+    def rpc_profile_worker(self, conn, req_id, payload):
+        """Start an on-demand cpu/memory profile in a worker (reference
+        dashboard's py-spy/memray trigger, `profile_manager.py` role).
+        Returns a token; poll rpc_profile_result for the report."""
+        import uuid
+
+        pid = payload.get("pid")
+        token = uuid.uuid4().hex
+        with self._lock:
+            targets = [w for w in self._workers.values()
+                       if not w.is_driver and (pid is None or w.pid == pid)]
+        if pid is not None and not targets:
+            return {"error": f"no worker with pid {pid} on this node"}
+        started = []
+        for w in targets:
+            if w.conn.alive:
+                w.conn.push("profile", {
+                    "token": f"{token}-{w.pid}",
+                    "profile_kind": payload.get("profile_kind", "cpu"),
+                    "duration_s": payload.get("duration_s", 5.0),
+                })
+                started.append({"pid": w.pid, "token": f"{token}-{w.pid}"})
+        return {"started": started}
+
+    def rpc_profile_result(self, conn, req_id, payload):
+        from ray_tpu.util.profiler import read_profile_result
+
+        return {"result": read_profile_result(payload["token"])}
+
     # set True by node_main (standalone daemon): chaos kill may hard-exit.
     # In-process raylets (driver-embedded head, test Cluster) refuse — the
     # exit would take the driver down with it.

@@ -2024,6 +2024,13 @@ class CoreWorker:
             import gc
 
             gc.collect()
+        elif method == "profile":
+            # on-demand cpu/memory profile of this worker (reference
+            # dashboard py-spy/memray role); runs in a daemon thread and
+            # drops its result file for the raylet to serve
+            from ray_tpu.util.profiler import run_profile_request
+
+            run_profile_request(payload)
         elif method == "exit":
             logger.info("worker exiting on raylet request")
             os._exit(0)

@@ -111,6 +111,50 @@ def start_dashboard(port: int = 0) -> Tuple[ThreadingHTTPServer, int]:
                     from ray_tpu import serve as serve_mod
 
                     body, ctype = json.dumps(serve_mod.status()), "application/json"
+                elif self.path.startswith("/api/profile"):
+                    # GET /api/profile?kind=cpu|memory&duration=5[&pid=N]
+                    # starts in-worker sampling on every node and returns
+                    # tokens; GET /api/profile_result?node=ADDR&token=T
+                    # polls (reference dashboard reporter profile trigger)
+                    from urllib.parse import parse_qs, urlparse
+
+                    from ray_tpu.core import rpc as _rpc
+                    from ray_tpu.core.api import get_runtime_context
+
+                    qs = parse_qs(urlparse(self.path).query)
+                    if self.path.startswith("/api/profile_result"):
+                        # poll hot path: talks only to the named raylet
+                        c = _rpc.connect_with_retry(qs["node"][0], timeout=5)
+                        try:
+                            out = c.call("profile_result",
+                                         {"token": qs["token"][0]})
+                        finally:
+                            c.close()
+                    else:
+                        gcs = _rpc.connect_with_retry(
+                            get_runtime_context().gcs_address, timeout=5)
+                        try:
+                            out = []
+                            for n in gcs.call("get_all_nodes", timeout=10):
+                                if not n["alive"]:
+                                    continue
+                                c = _rpc.connect_with_retry(n["address"],
+                                                            timeout=5)
+                                try:
+                                    r = c.call("profile_worker", {
+                                        "pid": (int(qs["pid"][0])
+                                                if "pid" in qs else None),
+                                        "profile_kind":
+                                            qs.get("kind", ["cpu"])[0],
+                                        "duration_s": float(
+                                            qs.get("duration", ["5"])[0]),
+                                    })
+                                finally:
+                                    c.close()
+                                out.append({"node": n["address"], **r})
+                        finally:
+                            gcs.close()
+                    body, ctype = json.dumps(out), "application/json"
                 else:
                     self.send_response(404)
                     self.end_headers()

@@ -72,6 +72,67 @@ def test_stack_dumps_worker_threads(gcs_address, capsys):
     ray_tpu.get(ref, timeout=30)
 
 
+def test_profile_cpu_samples_busy_worker(gcs_address, capsys):
+    """`ray_tpu profile` runs the in-process sampling profiler in a live
+    worker and reports the busy function (reference dashboard's on-demand
+    py-spy role, dep-free)."""
+    import time
+
+    @ray_tpu.remote
+    def busy_loop_for_profiler():
+        t0 = time.monotonic()
+        x = 0
+        while time.monotonic() - t0 < 12:
+            x += 1
+        return x
+
+    ref = busy_loop_for_profiler.remote()
+    deadline = time.monotonic() + 20
+    out = ""
+    while time.monotonic() < deadline:
+        rc, out = _cli(capsys, "profile", "--address", gcs_address,
+                       "--duration", "1.5")
+        assert rc in (0, 1)
+        if "busy_loop_for_profiler" in out:
+            break
+        time.sleep(0.5)
+    assert "busy_loop_for_profiler" in out, out
+    ray_tpu.get(ref, timeout=40)
+
+
+def test_profile_memory_window(gcs_address, capsys, tmp_path):
+    """Memory profile reports allocation sites from the sampled window."""
+    import time
+
+    @ray_tpu.remote
+    def allocate_for_a_while():
+        t0 = time.monotonic()
+        keep = []
+        while time.monotonic() - t0 < 12:
+            keep.append(bytearray(256 << 10))
+            time.sleep(0.01)
+            if len(keep) > 40:
+                keep = keep[-20:]
+        return len(keep)
+
+    ref = allocate_for_a_while.remote()
+    out_file = tmp_path / "mem.json"
+    deadline = time.monotonic() + 25
+    reports = []
+    while time.monotonic() < deadline:
+        rc, _ = _cli(capsys, "profile", "--address", gcs_address,
+                     "--kind", "memory", "--duration", "1.5",
+                     "--output", str(out_file))
+        if out_file.exists():
+            reports = json.loads(out_file.read_text())
+            if any(r.get("sites") for r in reports):
+                break
+        time.sleep(0.5)
+    assert any(r.get("kind") == "memory" and r.get("sites")
+               for r in reports), reports
+    ray_tpu.get(ref, timeout=40)
+
+
 @pytest.mark.slow
 def test_microbenchmark_runs(ray_start_regular, capsys):
     from ray_tpu.microbenchmark import run_microbenchmark

@@ -67,3 +67,44 @@ def test_serve_rest_deploy_rejects_bad_config(dash):
     with pytest.raises(urllib.error.HTTPError) as ei:
         urllib.request.urlopen(req)
     assert ei.value.code == 400
+
+
+def test_dashboard_profile_trigger_and_poll(dash):
+    """REST on-demand profiling: trigger sampling in live workers, poll the
+    result token (reference dashboard reporter/profile_manager surface)."""
+    import time
+
+    @ray_tpu.remote
+    def spin_for_dashboard_profile():
+        t0 = time.monotonic()
+        x = 0
+        while time.monotonic() - t0 < 12:
+            x += 1
+        return x
+
+    ref = spin_for_dashboard_profile.remote()
+    started = []
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and not started:
+        out = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{dash}/api/profile?duration=1").read())
+        started = [(n["node"], s["token"])
+                   for n in out for s in n.get("started", [])]
+        if not started:
+            time.sleep(0.5)
+    assert started, "no workers picked up the profile request"
+    node, token = started[0]
+    from urllib.parse import quote
+
+    result = None
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        r = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{dash}/api/profile_result?"
+            f"node={quote(node)}&token={token}").read())
+        if r.get("result"):
+            result = r["result"]
+            break
+        time.sleep(0.5)
+    assert result and result["kind"] == "cpu" and result["n_samples"] > 0
+    ray_tpu.get(ref, timeout=40)

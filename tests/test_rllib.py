@@ -209,11 +209,29 @@ def test_rl_samples_per_second_microbench(ray_start_regular, tmp_path):
     RLLIB_MICROBENCH.json at the repo root as the recorded artifact."""
     import json
     import os
+    import platform
     import time as _time
 
     from ray_tpu.rllib import ImpalaConfig, PPOConfig
 
-    results = {}
+    # Recorded config makes the numbers reproducible and comparable across
+    # rounds (the reference pins config+thresholds in rllib/tuned_examples).
+    results = {
+        "config": {
+            "env": "CartPole (in-repo dynamics)",
+            "num_rollout_workers": 2,
+            "num_envs_per_worker": 4,
+            "rollout_fragment_length": 64,
+            "timed_iters": 5,
+            "metric": "env steps sampled / wall-clock s, warm workers+jit",
+        },
+        "hardware": {
+            "cpus": os.cpu_count(),
+            "machine": platform.machine(),
+            "note": "1-core shared CI box; absolute numbers are lower "
+                    "bounds, compare run-over-run on like hardware",
+        },
+    }
     for name, build in (
         ("ppo", lambda: PPOConfig().rollouts(
             num_rollout_workers=2, num_envs_per_worker=4,
@@ -240,4 +258,5 @@ def test_rl_samples_per_second_microbench(ray_start_regular, tmp_path):
     with open(out_path, "w") as f:
         json.dump(results, f)
     print("rl microbench:", results)
-    assert all(v > 0 for v in results.values())
+    assert all(v > 0 for k, v in results.items()
+               if k.endswith("_samples_per_s"))
