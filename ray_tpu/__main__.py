@@ -308,40 +308,17 @@ def main(argv=None) -> int:
             if args.cmd == "profile":
                 import time as _time
 
-                pending = []  # (node_address, pid, token)
-                for n in alive:
-                    c = _rpc.connect_with_retry(n["address"], timeout=5)
-                    try:
-                        out = c.call("profile_worker", {
-                            "pid": args.pid,
-                            "profile_kind": args.kind,
-                            "duration_s": args.duration,
-                        })
-                    finally:
-                        c.close()
-                    if out.get("error") and args.pid is not None:
-                        continue  # pid lives on another node
-                    for s in out.get("started", []):
-                        pending.append((n["address"], s["pid"], s["token"]))
+                from ray_tpu.util.profiler import (poll_profile_results,
+                                                   trigger_profile)
+
+                pending = trigger_profile(gcs, args.pid, args.kind,
+                                          args.duration)
                 if not pending:
                     print("no matching workers")
                     return 1
-                deadline = _time.monotonic() + args.duration + 30
-                reports = []
-                while pending and _time.monotonic() < deadline:
-                    _time.sleep(min(args.duration / 2 + 0.2, 2.0))
-                    still = []
-                    for addr, pid, token in pending:
-                        c = _rpc.connect_with_retry(addr, timeout=5)
-                        try:
-                            r = c.call("profile_result", {"token": token})
-                        finally:
-                            c.close()
-                        if r.get("result") is None:
-                            still.append((addr, pid, token))
-                        else:
-                            reports.append(r["result"])
-                    pending = still
+                reports, pending = poll_profile_results(
+                    pending, _time.monotonic() + args.duration + 30,
+                    poll_interval_s=min(args.duration / 2 + 0.2, 2.0))
                 if args.output:
                     with open(args.output, "w") as fh:
                         json.dump(reports, fh, indent=2)

@@ -211,8 +211,8 @@ class GcsServer:
         if self._snapshot_path and self._dirty:
             try:
                 self._write_snapshot()
-            except Exception:
-                pass
+            except OSError:
+                logger.exception("final snapshot flush failed")
         for c in self._raylet_clients.values():
             c.close()
         self._server.stop()
@@ -790,8 +790,10 @@ class GcsServer:
         if client is not None:
             try:
                 client.notify("kill_actor_worker", {"actor_id": actor_id})
-            except Exception:
-                pass
+            except OSError as e:
+                # the raylet hosting the actor is gone — the kill outcome
+                # it was asked for has already happened
+                logger.debug("kill_actor notify to dead raylet: %s", e)
         if no_restart:
             self._publish(CH_ACTORS, {"actor_id": actor_id, "state": "DEAD",
                                       "address": "", "death_cause": "killed via ray.kill()"})
@@ -828,7 +830,10 @@ class GcsServer:
             try:
                 r = client.call("prepare_bundle", {
                     "pg_id": pg_id, "bundle_index": idx, "resources": bundles[idx]}, timeout=10)
-            except Exception:
+            except (OSError, TimeoutError, rpc.RpcCallError,
+                    rpc.RpcDisconnected) as e:
+                logger.info("prepare_bundle on %s failed: %s",
+                            node_id.hex()[:8], e)
                 r = False
             if not r:
                 ok = False
@@ -840,8 +845,8 @@ class GcsServer:
                 if c:
                     try:
                         c.notify("return_bundle", {"pg_id": pg_id, "bundle_index": idx})
-                    except Exception:
-                        pass
+                    except OSError as e:
+                        logger.debug("return_bundle to dead raylet: %s", e)
             return {"ok": False, "error": "prepare failed"}
         # Phase 2: commit.
         for idx, node_id in prepared:
@@ -873,8 +878,8 @@ class GcsServer:
                 if c:
                     try:
                         c.notify("return_bundle", {"pg_id": pg_id, "bundle_index": idx})
-                    except Exception:
-                        pass
+                    except OSError as e:
+                        logger.debug("return_bundle to dead raylet: %s", e)
         return pg is not None
 
     def rpc_list_placement_groups(self, conn, req_id, payload):

@@ -221,23 +221,23 @@ class Raylet:
         for p in starting:
             try:
                 p.terminate()
-            except Exception:
-                pass
+            except OSError:
+                pass  # already exited
         for w in workers:
             if w.proc is not None:
                 try:
                     w.proc.terminate()
-                except Exception:
-                    pass
+                except OSError:
+                    pass  # already exited
         for w in workers:
             if w.proc is not None:
                 try:
                     w.proc.wait(timeout=2)
-                except Exception:
+                except (OSError, subprocess.TimeoutExpired):
                     try:
                         w.proc.kill()
-                    except Exception:
-                        pass
+                    except OSError:
+                        pass  # exited between wait and kill
         if self._gcs:
             self._gcs.close()
         for c in self._raylet_clients.values():
@@ -312,7 +312,8 @@ class Raylet:
                 "object_store_used": self.store.stats().get("used_bytes", 0),
                 "num_workers": len(self._workers),
             }
-        except Exception:
+        except (OSError, ValueError, KeyError) as e:
+            logger.debug("node stats unavailable: %s", e)
             return {}
 
     def _heartbeat_loop(self) -> None:
@@ -351,8 +352,8 @@ class Raylet:
                 "node_id": self.node_id.binary(),
                 "available": dict(self.resources_available),
             })
-        except Exception:
-            pass
+        except OSError as e:
+            logger.debug("resource broadcast to GCS failed: %s", e)
 
     # ------------------------------------------------------- worker lifecycle
     def rpc_register_worker(self, conn, req_id, payload):
@@ -500,8 +501,8 @@ class Raylet:
             try:
                 self._gcs.notify("actor_failed", {
                     "actor_id": s.actor_id, "reason": msg})
-            except Exception:
-                pass
+            except OSError as e:
+                logger.warning("actor_failed notify lost (GCS down?): %s", e)
 
     def _on_worker_disconnect(self, wid: WorkerID) -> None:
         with self._lock:
@@ -529,8 +530,8 @@ class Raylet:
             try:
                 self._gcs.notify("actor_failed", {
                     "actor_id": actor_id, "reason": f"worker process {handle.pid} died"})
-            except Exception:
-                pass
+            except OSError as e:
+                logger.warning("actor_failed notify lost (GCS down?): %s", e)
         self._schedule()
 
     def _notify_owner_task_failed(self, spec: TaskSpec, msg: str) -> None:
@@ -566,7 +567,8 @@ class Raylet:
         while not self._shutdown.wait(period):
             try:
                 usage = self._memory_usage_fraction(psutil)
-            except Exception:
+            except (OSError, ValueError) as e:
+                logger.debug("memory probe failed: %s", e)
                 continue
             if usage <= cfg.memory_usage_threshold:
                 continue
@@ -611,7 +613,8 @@ class Raylet:
                     victim.proc.kill()
                 else:
                     os.kill(victim.pid, 9)
-            except Exception:
+            except OSError:
+                # it exited on its own between pick and kill
                 self._oom_killed.discard(victim.worker_id)
                 return False
         return True
@@ -630,8 +633,8 @@ class Raylet:
             for pid in pids:
                 try:
                     total += psutil.Process(pid).memory_info().rss
-                except Exception:
-                    pass
+                except psutil.Error:
+                    pass  # raced a worker exit
             return total / budget
         return psutil.virtual_memory().percent / 100.0
 
@@ -680,8 +683,8 @@ class Raylet:
                     self._env_manager.release(w.env_key)
                 try:
                     w.conn.push("exit", {})
-                except Exception:
-                    pass
+                except OSError:
+                    pass  # connection already dropped; process reaper owns it
 
     # -------------------------------------------------------- observability
     def rpc_object_store_stats(self, conn, req_id, payload):

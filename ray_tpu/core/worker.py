@@ -360,18 +360,18 @@ class CoreWorker:
         if self.mode == "driver":
             try:
                 self.gcs.call("mark_job_finished", {"job_id": self.job_id.binary()}, timeout=2)
-            except Exception:
-                pass
+            except (OSError, TimeoutError, rpc.RpcDisconnected) as e:
+                logger.debug("mark_job_finished lost at shutdown: %s", e)
         for c in list(self._peers.values()):
             c.close()
         try:
             self.raylet.close()
-        except Exception:
-            pass
+        except OSError:
+            pass  # connection already dead
         try:
             self.gcs.close()
-        except Exception:
-            pass
+        except OSError:
+            pass  # connection already dead
         self._server.stop()
 
     # ------------------------------------------------------------ submission
@@ -435,8 +435,8 @@ class CoreWorker:
                 self.gcs.notify("profile_events", {
                     "events": [{**e, "_src": src} for e in fresh]})
                 self._profile_events_sent += len(fresh)
-            except Exception:
-                pass
+            except OSError as e:
+                logger.debug("profile event flush failed: %s", e)
 
     def _emit_task_event(self, spec: TaskSpec, state: str) -> None:
         """Best-effort task lifecycle record to the control plane
@@ -1127,8 +1127,8 @@ class CoreWorker:
         for conn, req_id in waiters:
             try:
                 conn.reply(req_id, payload)
-            except Exception:
-                pass
+            except OSError as e:
+                logger.debug("waiter connection dropped before reply: %s", e)
         for cb in callbacks:
             try:
                 cb()
@@ -1622,8 +1622,9 @@ class CoreWorker:
                     self.raylet.notify("obj_delete", {"object_id": oid})
                 else:
                     self.peer(loc).notify("obj_delete", {"object_id": oid})
-            except Exception:
-                pass
+            except OSError as e:
+                # location holder died; its store died with it
+                logger.debug("obj_delete to %s lost: %s", loc, e)
 
     # ------------------------------------------------------------- push
     def push_object(self, ref: ObjectRef, node_ids=None) -> int:
@@ -1673,8 +1674,8 @@ class CoreWorker:
             get_or_create("counter", "ray_tpu_push_targets_total",
                           "cumulative push fan-out targets").inc(
                               len(targets))
-        except Exception:
-            pass
+        except (ValueError, KeyError) as e:
+            logger.debug("push metrics unavailable: %s", e)
         return len(targets)
 
     def _notify_owner_async(self, owner: str, method: str, payload: dict) -> None:
@@ -1944,8 +1945,8 @@ class CoreWorker:
         if empty:
             try:  # drop the GCS-side fan-out entry too
                 self.gcs.notify("unsubscribe", {"channels": [channel]})
-            except Exception:
-                pass
+            except OSError as e:
+                logger.debug("unsubscribe lost (GCS down?): %s", e)
 
     def publish(self, channel: str, message) -> None:
         self.gcs.notify("publish", {"channel": channel, "message": message})
@@ -2299,8 +2300,8 @@ class CoreWorker:
         if spec.task_type != TaskType.ACTOR_TASK:
             try:
                 self.raylet.notify("task_done", {"worker_id": self.worker_id})
-            except Exception:
-                pass
+            except OSError as e:
+                logger.debug("task_done notify lost (raylet down?): %s", e)
 
     def _stream_dynamic_returns(self, spec: TaskSpec, value) -> ObjectRefGenerator:
         """Executor side of num_returns="dynamic": iterate the task's

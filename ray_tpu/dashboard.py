@@ -131,29 +131,24 @@ def start_dashboard(port: int = 0) -> Tuple[ThreadingHTTPServer, int]:
                         finally:
                             c.close()
                     else:
+                        from ray_tpu.util.profiler import trigger_profile
+
                         gcs = _rpc.connect_with_retry(
                             get_runtime_context().gcs_address, timeout=5)
                         try:
-                            out = []
-                            for n in gcs.call("get_all_nodes", timeout=10):
-                                if not n["alive"]:
-                                    continue
-                                c = _rpc.connect_with_retry(n["address"],
-                                                            timeout=5)
-                                try:
-                                    r = c.call("profile_worker", {
-                                        "pid": (int(qs["pid"][0])
-                                                if "pid" in qs else None),
-                                        "profile_kind":
-                                            qs.get("kind", ["cpu"])[0],
-                                        "duration_s": float(
-                                            qs.get("duration", ["5"])[0]),
-                                    })
-                                finally:
-                                    c.close()
-                                out.append({"node": n["address"], **r})
+                            started = trigger_profile(
+                                gcs,
+                                int(qs["pid"][0]) if "pid" in qs else None,
+                                qs.get("kind", ["cpu"])[0],
+                                float(qs.get("duration", ["5"])[0]))
                         finally:
                             gcs.close()
+                        by_node: dict = {}
+                        for addr, pid, token in started:
+                            by_node.setdefault(addr, []).append(
+                                {"pid": pid, "token": token})
+                        out = [{"node": addr, "started": s}
+                               for addr, s in by_node.items()]
                     body, ctype = json.dumps(out), "application/json"
                 else:
                     self.send_response(404)

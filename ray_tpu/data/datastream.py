@@ -695,6 +695,64 @@ class Datastream:
         return out
 
     def schema(self) -> Optional[Dict[str, Any]]:
+        """Column name -> dtype. For an unsubmitted parquet source whose op
+        chain can't invent columns, this reads only the file FOOTER
+        (reference ParquetDatasource metadata-only schema) — no data task
+        runs. Otherwise the first non-empty block is peeked."""
+        if (self._refs is None and self._source.kind == "parquet"
+                and all(op[0] in ("project", "filter_expr", "limit", "filter")
+                        for op in self._ops)):
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+
+            arrow_schema = pq.read_schema(self._source.paths[0])
+            # reader-level column pruning (source columns= plus pushed-down
+            # selects/filter columns) applies to TOP-LEVEL file columns
+            read_cols, _, _ = self._source.pushdown(self._ops)
+            top = [n for n in arrow_schema.names
+                   if read_cols is None or n in read_cols]
+
+            def leaves(name, typ):
+                """Mirror _table_to_block: structs flatten to dotted keys;
+                leaf dtypes match what the numpy block will hold."""
+                if pa.types.is_struct(typ):
+                    for field in typ:
+                        yield from leaves(f"{name}.{field.name}", field.type)
+                    return
+                if pa.types.is_dictionary(typ):
+                    typ = typ.value_type
+                while pa.types.is_fixed_size_list(typ):
+                    typ = typ.value_type
+                if (pa.types.is_list(typ) or pa.types.is_large_list(typ)
+                        or pa.types.is_string(typ)
+                        or pa.types.is_large_string(typ)
+                        or pa.types.is_binary(typ)):
+                    yield name, np.dtype(object)
+                    return
+                try:
+                    yield name, np.dtype(typ.to_pandas_dtype())
+                except (NotImplementedError, TypeError):
+                    yield name, np.dtype(object)
+
+            names, types = [], {}
+            for n in top:
+                for leaf, dt in leaves(n, arrow_schema.field(n).type):
+                    names.append(leaf)
+                    types[leaf] = dt
+            # replay the op chain's projections over the flattened names
+            for op in self._ops:
+                if op[0] != "project":
+                    continue
+                st = op[1]
+                if "select" in st:
+                    names = [n for n in names if n in st["select"]]
+                elif "drop" in st:
+                    names = [n for n in names if n not in st["drop"]]
+                elif "rename" in st:
+                    names = [st["rename"].get(n, n) for n in names]
+                    types = {st["rename"].get(n, n): t
+                             for n, t in types.items()}
+            return {n: types[n] for n in names}
         for ref in self._stream_refs():
             b = ray_tpu.get(ref)
             if _block_len(b):
@@ -912,11 +970,15 @@ def _tensor_to_arrow(arr: np.ndarray):
 
 def _arrow_to_numpy(column) -> np.ndarray:
     """Arrow column -> numpy; (nested) FixedSizeList columns reassemble to
-    a contiguous [N, ...] tensor instead of degrading to object arrays."""
+    a contiguous [N, ...] tensor instead of degrading to object arrays;
+    dictionary-encoded columns decode to their values; variable-length
+    lists become object arrays of per-row numpy arrays (lossless)."""
     import pyarrow as pa
 
     col = column.combine_chunks() if hasattr(column, "combine_chunks") \
         else column
+    if pa.types.is_dictionary(col.type):
+        col = col.dictionary_decode()
     shape = [len(col)]
     typ = col.type
     while pa.types.is_fixed_size_list(typ):
@@ -928,7 +990,37 @@ def _arrow_to_numpy(column) -> np.ndarray:
                 flat.type):
             flat = flat.flatten()
         return flat.to_numpy(zero_copy_only=False).reshape(shape)
+    if pa.types.is_list(typ) or pa.types.is_large_list(typ):
+        out = np.empty(len(col), dtype=object)
+        for i, item in enumerate(col):
+            out[i] = (None if not item.is_valid
+                      else np.asarray(item.as_py()))
+        return out
     return col.to_numpy(zero_copy_only=False)
+
+
+def _table_to_block(table) -> Block:
+    """Arrow table -> dict-of-numpy block, losslessly: struct columns
+    flatten to dotted ``parent.child`` keys (the reference keeps structs
+    arrow-side in ArrowBlockAccessor; the TPU-native block model is
+    columnar numpy — device-feedable — so structs decompose instead of
+    degrading to object arrays)."""
+    import pyarrow as pa
+
+    out: Dict[str, np.ndarray] = {}
+
+    def add(name: str, col):
+        chunked = col.combine_chunks() if hasattr(col, "combine_chunks") \
+            else col
+        if pa.types.is_struct(chunked.type):
+            for field in chunked.type:
+                add(f"{name}.{field.name}", chunked.field(field.name))
+        else:
+            out[name] = _arrow_to_numpy(chunked)
+
+    for c in table.column_names:
+        add(c, table[c])
+    return out
 
 
 def _write_block_parquet(block: Block, path: str) -> None:
@@ -1237,7 +1329,7 @@ def _load_parquet(path: str, columns, filters) -> Block:
     import pyarrow.parquet as pq
 
     table = pq.read_table(path, columns=columns, filters=filters)
-    return {c: _arrow_to_numpy(table[c]) for c in table.column_names}
+    return _table_to_block(table)
 
 
 def read_parquet(paths: Union[str, List[str]], *,
@@ -1330,7 +1422,7 @@ def from_arrow(tables) -> Datastream:
     if isinstance(tables, pa.Table):
         tables = [tables]
     return Datastream([
-        ray_tpu.put({c: t[c].to_numpy() for c in t.column_names})
+        ray_tpu.put(_table_to_block(t))
         for t in tables])
 
 

@@ -186,8 +186,4 @@ class A3C(Algorithm):
         ray_tpu.get([wk.set_weights.remote(w) for wk in self.workers])
 
     def stop(self) -> None:
-        for w in self.workers:
-            try:
-                ray_tpu.kill(w)
-            except Exception:
-                pass
+        self._kill_workers(self.workers)

@@ -50,3 +50,19 @@ class Algorithm:
 
     def stop(self) -> None:
         pass
+
+    @staticmethod
+    def _kill_workers(workers) -> None:
+        """Best-effort teardown of a worker-actor fleet: an already-dead or
+        unreachable worker is the expected case during shutdown and is
+        logged, not raised — but programming errors still propagate."""
+        import logging
+
+        import ray_tpu
+
+        for w in workers:
+            try:
+                ray_tpu.kill(w)
+            except (ConnectionError, ValueError, KeyError, RuntimeError) as e:
+                logging.getLogger(__name__).debug(
+                    "stop(): worker already gone (%s)", e)

@@ -218,11 +218,7 @@ class ApexDQN(Algorithm):
         self._broadcast()
 
     def stop(self) -> None:
-        for a in self.workers + self.replays:
-            try:
-                ray_tpu.kill(a)
-            except Exception:
-                pass
+        self._kill_workers(self.workers + self.replays)
 
 
 class ApexDDPGConfig:

@@ -150,8 +150,4 @@ class APPO(Algorithm):
         self.learner.set_weights(weights)
 
     def stop(self) -> None:
-        for w in self.workers:
-            try:
-                ray_tpu.kill(w)
-            except Exception:
-                pass
+        self._kill_workers(self.workers)

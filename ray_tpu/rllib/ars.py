@@ -175,8 +175,4 @@ class ARS(Algorithm):
             self.obs_stats.merge(weights["obs_stats"])
 
     def stop(self) -> None:
-        for w in self.workers:
-            try:
-                ray_tpu.kill(w)
-            except Exception:
-                pass
+        self._kill_workers(self.workers)

@@ -318,11 +318,7 @@ class DDPG(Algorithm):
         self._broadcast_weights()
 
     def stop(self) -> None:
-        for w in self.workers:
-            try:
-                ray_tpu.kill(w)
-            except Exception:
-                pass
+        self._kill_workers(self.workers)
 
 
 class TD3(DDPG):

@@ -278,14 +278,10 @@ class DDPPO(Algorithm):
         ray_tpu.get([w.set_weights.remote(weights) for w in self.workers])
 
     def stop(self) -> None:
-        for w in self.workers:
-            try:
-                ray_tpu.kill(w)
-            except Exception:
-                pass
+        self._kill_workers(self.workers)
         # the rendezvous actor was created inside rank 0's process, so the
         # driver-side registry doesn't know it — kill it by name
         try:
             ray_tpu.kill(ray_tpu.get_actor(f"_collective:{self._group}"))
-        except Exception:
-            pass
+        except (ValueError, KeyError, ConnectionError):
+            pass  # group actor already gone (normal teardown order)

@@ -292,8 +292,4 @@ class DQN(Algorithm):
         self._broadcast()
 
     def stop(self) -> None:
-        for w in self.workers:
-            try:
-                ray_tpu.kill(w)
-            except Exception:
-                pass
+        self._kill_workers(self.workers)

@@ -151,8 +151,4 @@ class ES(Algorithm):
         self.shapes = weights["shapes"]
 
     def stop(self) -> None:
-        for w in self.workers:
-            try:
-                ray_tpu.kill(w)
-            except Exception:
-                pass
+        self._kill_workers(self.workers)

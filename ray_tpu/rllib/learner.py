@@ -27,11 +27,14 @@ fresh PRNG key per update for stochastic losses); optionally maintain
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
 import ray_tpu
+
+logger = logging.getLogger(__name__)
 
 __all__ = ["Learner", "LearnerGroup", "broadcast_weights", "delayed"]
 
@@ -383,11 +386,9 @@ class LearnerGroup:
                     from ray_tpu.util import collective
 
                     collective.destroy_collective_group(self._group_name)
-                except Exception:
-                    pass
-            for a in self._actors:
-                try:
-                    ray_tpu.kill(a)
-                except Exception:
-                    pass
+                except (ValueError, KeyError, ConnectionError) as e:
+                    logger.debug("collective group already gone: %s", e)
+            from ray_tpu.rllib.algorithm import Algorithm
+
+            Algorithm._kill_workers(self._actors)
             self._actors = []

@@ -159,8 +159,4 @@ class PG(Algorithm):
         self._broadcast_weights()
 
     def stop(self) -> None:
-        for w in self.workers:
-            try:
-                ray_tpu.kill(w)
-            except Exception:
-                pass
+        self._kill_workers(self.workers)

@@ -376,8 +376,4 @@ class PPO(Algorithm):
     def stop(self) -> None:
         if self.learner_group is not None:
             self.learner_group.shutdown()
-        for w in self.workers:
-            try:
-                ray_tpu.kill(w)
-            except Exception:
-                pass
+        self._kill_workers(self.workers)

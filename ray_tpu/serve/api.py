@@ -176,8 +176,10 @@ class ServeController:
                     info = _global_worker().get_actor_info(actor_id=aid)
                     if info and info.get("state") == "ALIVE":
                         live.append(ActorHandle(aid, "_ReplicaActor"))
-                except Exception:
-                    pass
+                except (OSError, RuntimeError, TimeoutError, KeyError,
+                        ValueError) as e:
+                    logger.debug("replica %s liveness probe failed: %s",
+                                 aid, e)
             if live:
                 self._replicas[name] = live
         for name in self._deployments:
@@ -264,8 +266,8 @@ class ServeController:
 
             _global_worker().gcs.call("kv_del", {
                 "namespace": "serve", "key": self._KV_KEY}, timeout=5)
-        except Exception:
-            pass
+        except (OSError, TimeoutError) as e:
+            logger.debug("serve KV cleanup lost: %s", e)
         return True
 
     # ----------------------------------------------------------- discovery
@@ -418,8 +420,8 @@ class ServeController:
         self._evict_stats_client(r)
         try:
             ray_tpu.kill(r)
-        except Exception:
-            pass
+        except (OSError, RuntimeError, ValueError, KeyError):
+            pass  # replica already dead — the goal state
 
     def _reconcile_one(self, name: str):
         d = self._deployments.get(name)
@@ -506,8 +508,8 @@ class ServeController:
         if client is not None:
             try:
                 client.close()
-            except Exception:
-                pass
+            except OSError:
+                pass  # socket already dropped
 
     def _worker_stats(self, replica) -> dict:
         """actor_stats RPC to the worker hosting `replica` (address cached;
@@ -657,8 +659,8 @@ class DeploymentHandle:
 
                         _global_worker().unsubscribe_channel(
                             SERVE_VERSIONS_CHANNEL, on_bump)
-                    except Exception:
-                        pass
+                    except (OSError, KeyError, ValueError):
+                        pass  # worker shutting down; channel dies with it
                     return
                 if msg.get("name") == s._name:
                     s._bumped.set()
@@ -723,8 +725,8 @@ class DeploymentHandle:
 
                 _global_worker().unsubscribe_channel(
                     SERVE_VERSIONS_CHANNEL, self._sub_cb)
-            except Exception:
-                pass
+            except (OSError, KeyError, ValueError):
+                pass  # worker shutting down; channel dies with it
             self._sub_cb = None
 
     def options(self, method_name: str = "__call__",
@@ -972,11 +974,16 @@ def _update_serve_gauges() -> None:
     try:
         from ray_tpu import state as _state
 
+        # unnamed actors list name=None — the .get default only covers a
+        # MISSING key (this hid as an AttributeError under a broad except
+        # until r04, silently dropping every per-node proxy from scrapes)
         proxy_names += [a["name"] for a in _state.list_actors()
-                        if a.get("name", "").startswith(PROXY_NAME + ":")
+                        if (a.get("name") or "").startswith(PROXY_NAME + ":")
                         and a.get("state") == "ALIVE"]
-    except Exception:
-        pass
+    except (OSError, RuntimeError, TimeoutError, KeyError, ValueError) as e:
+        # RuntimeError covers RpcCallError: scrapes can race teardown, and
+        # per-node proxies are optional — the driver proxy still collects
+        logger.debug("proxy discovery via state API failed: %s", e)
     for name in proxy_names:
         try:
             proxy = ray_tpu.get_actor(name)
@@ -1028,8 +1035,8 @@ def shutdown() -> None:
     try:
         ray_tpu.get(controller.shutdown.remote(), timeout=30)
         ray_tpu.kill(controller)
-    except Exception:
-        pass
+    except (OSError, TimeoutError, ValueError, KeyError, RuntimeError) as e:
+        logger.debug("controller teardown best-effort: %s", e)
 
 
 # ------------------------------------------------------------------ http

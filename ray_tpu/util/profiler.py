@@ -131,6 +131,64 @@ def run_profile_request(payload: Dict[str, Any]) -> None:
     threading.Thread(target=work, name="profile-request", daemon=True).start()
 
 
+def trigger_profile(gcs, pid, kind: str, duration_s: float):
+    """Fan a profile_worker request out to every alive raylet; returns
+    [(node_address, pid, token)]. Shared by the CLI and the dashboard —
+    a node dying between the GCS listing and the connect is survived
+    (its workers simply don't report)."""
+    from ray_tpu.core import rpc as _rpc
+
+    started = []
+    for n in gcs.call("get_all_nodes", timeout=10):
+        if not n["alive"]:
+            continue
+        try:
+            c = _rpc.connect_with_retry(n["address"], timeout=5)
+        except ConnectionError:
+            continue  # raced a node death; the alive list was stale
+        try:
+            out = c.call("profile_worker", {
+                "pid": pid, "profile_kind": kind, "duration_s": duration_s})
+        except (ConnectionError, OSError, TimeoutError):
+            continue
+        finally:
+            c.close()
+        for s in out.get("started", []):
+            started.append((n["address"], s["pid"], s["token"]))
+    return started
+
+
+def poll_profile_results(pending, deadline_monotonic: float,
+                         poll_interval_s: float = 1.0):
+    """Collect finished profiles for [(addr, pid, token)] tuples until all
+    report or the deadline passes; returns (reports, still_pending).
+    A node dying mid-profile costs only its own reports."""
+    from ray_tpu.core import rpc as _rpc
+
+    reports = []
+    pending = list(pending)
+    while pending and time.monotonic() < deadline_monotonic:
+        time.sleep(poll_interval_s)
+        still = []
+        for addr, pid, token in pending:
+            try:
+                c = _rpc.connect_with_retry(addr, timeout=5)
+            except ConnectionError:
+                continue  # node died; drop its token
+            try:
+                r = c.call("profile_result", {"token": token})
+            except (ConnectionError, OSError, TimeoutError):
+                continue
+            finally:
+                c.close()
+            if r.get("result") is None:
+                still.append((addr, pid, token))
+            else:
+                reports.append(r["result"])
+        pending = still
+    return reports, pending
+
+
 def _sweep_stale(max_age_s: float = 600.0) -> None:
     """Reclaim result files whose caller never collected them (timed out,
     crashed): without this, periodic dashboard profiling grows the dir

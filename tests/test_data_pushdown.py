@@ -131,28 +131,34 @@ def test_per_operator_memory_budget_throttles(ray_start_regular):
         def get(self) -> int:
             return self.n
 
-    counter = Counter.remote()
-    n_blocks = 20
-    ds = rdata.from_numpy({"x": np.arange(n_blocks)}, parallelism=n_blocks)
+    def run_once(memory_budget):
+        counter = Counter.remote()
+        n_blocks = 20
+        ds = rdata.from_numpy({"x": np.arange(n_blocks)},
+                              parallelism=n_blocks)
 
-    def inflate(block):
-        ray_tpu.get(counter.inc.remote())
-        return {"x": np.zeros((1 << 20,), np.float64)}  # 8 MB out
+        def inflate(block):
+            ray_tpu.get(counter.inc.remote())
+            return {"x": np.zeros((1 << 20,), np.float64)}  # 8 MB out
 
-    ds = ds.map_batches(inflate)
-    it = ds._stream_refs(max_inflight=8, memory_budget=20 << 20)
-    consumed = 0
-    for ref in it:
-        ray_tpu.get(ref)
-        consumed += 1
-        time.sleep(0.25)  # settle: submitted tasks reach terminal state
-        if consumed == 6:
-            break
-    executed = ray_tpu.get(counter.get.remote())
-    # count-window-only behavior would sit at consumed + 8 = 14; the byte
-    # budget caps produced-not-consumed at ~2 blocks + 1 in flight past
-    # the initial burst of 8
-    assert executed <= 12, executed
+        ds = ds.map_batches(inflate)
+        it = ds._stream_refs(max_inflight=8, memory_budget=memory_budget)
+        consumed = 0
+        for ref in it:
+            ray_tpu.get(ref)
+            consumed += 1
+            time.sleep(0.25)  # settle: submitted tasks reach terminal state
+            if consumed == 6:
+                break
+        return ray_tpu.get(counter.get.remote())
+
+    # Self-calibrating under shared-runner load: the same pipeline with
+    # only the 8-deep count window sets this box's baseline; the ~2-block
+    # byte budget must hold production measurably below it.
+    unbudgeted = run_once(None)
+    budgeted = run_once(20 << 20)
+    assert budgeted <= unbudgeted - 2, (budgeted, unbudgeted)
+    assert budgeted <= 13, (budgeted, unbudgeted)  # ~consumed + 2 + in-flight
 
 
 def test_filter_then_select_keeps_filter_column_readable(

@@ -89,3 +89,82 @@ def test_webdataset_roundtrip(ray_start_regular, tmp_path):
 def test_read_mongo_gated(ray_start_regular):
     with pytest.raises(ImportError):
         rt_data.read_mongo("mongodb://x", "db", "c")
+
+
+def test_arrow_nested_types_roundtrip(ray_start_regular, tmp_path):
+    """Struct / var-length list / dictionary / string columns survive
+    ingestion losslessly (reference ArrowBlockAccessor coverage): structs
+    flatten to dotted columns, lists stay per-row arrays, dictionary
+    encoding decodes."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    table = pa.table({
+        "s": pa.array(["a", "b", "c"]),
+        "d": pa.array(["x", "y", "x"]).dictionary_encode(),
+        "lst": pa.array([[1, 2], [3], [4, 5, 6]]),
+        "pt": pa.array([{"x": 1.0, "y": 2.0}, {"x": 3.0, "y": 4.0},
+                        {"x": 5.0, "y": 6.0}],
+                       type=pa.struct([("x", pa.float64()),
+                                       ("y", pa.float64())])),
+    })
+    path = str(tmp_path / "nested.parquet")
+    pq.write_table(table, path)
+
+    ds = rt_data.read_parquet(path)
+    rows = ds.take_all()
+    assert [r["s"] for r in rows] == ["a", "b", "c"]
+    assert [r["d"] for r in rows] == ["x", "y", "x"]
+    assert list(rows[2]["lst"]) == [4, 5, 6]
+    assert rows[1]["pt.x"] == 3.0 and rows[1]["pt.y"] == 4.0
+
+    # from_arrow takes the same conversion path
+    rows2 = rt_data.from_arrow(table).take_all()
+    assert rows2[0]["pt.x"] == 1.0 and list(rows2[0]["lst"]) == [1, 2]
+
+
+def test_parquet_schema_reads_footer_only(ray_start_regular, tmp_path):
+    """ds.schema() on a lazy parquet read + select answers from the file
+    footer without submitting reader tasks."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    pq.write_table(pa.table({"a": [1, 2], "b": [1.5, 2.5], "c": ["x", "y"]}),
+                   str(tmp_path / "s.parquet"))
+    ds = rt_data.read_parquet(str(tmp_path / "s.parquet")).select_columns(
+        ["a", "b"])
+    schema = ds.schema()
+    assert list(schema) == ["a", "b"]
+    # same value contract as the block-peek path: numpy dtypes
+    assert schema["a"] == np.int64 and schema["b"] == np.float64
+    assert ds._refs is None, "schema() must not submit reader tasks"
+    # and execution still agrees
+    assert set(ds.take(1)[0]) == {"a", "b"}
+
+
+def test_parquet_footer_schema_matches_executed_blocks(ray_start_regular,
+                                                       tmp_path):
+    """The footer fast path and the executed blocks must agree on names
+    (struct flattening, source columns= pruning) and on numpy-dtype
+    values (review regression: footer path returned arrow types and
+    unflattened structs the blocks never contain)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    pq.write_table(pa.table({
+        "a": [1, 2],
+        "s": ["x", "y"],
+        "pt": pa.array([{"x": 1.0, "y": 2}, {"x": 3.0, "y": 4}],
+                       type=pa.struct([("x", pa.float64()),
+                                       ("y", pa.int64())])),
+    }), str(tmp_path / "f.parquet"))
+
+    ds = rt_data.read_parquet(str(tmp_path / "f.parquet"),
+                              columns=["a", "pt"])
+    footer = ds.schema()
+    assert ds._refs is None
+    assert footer == {"a": np.int64, "pt.x": np.float64, "pt.y": np.int64}
+    block_keys = set(ds.take(1)[0])
+    assert block_keys == set(footer)
+    # the executed-path schema() agrees too
+    assert ds.schema() == footer
