@@ -680,6 +680,108 @@ class Datastream:
             prev = idx
         return out
 
+    def split_proportionately(self, proportions: List[float]
+                              ) -> List["Datastream"]:
+        """Split by fractions; a final stream carries the remainder
+        (reference Dataset.split_proportionately). [0.7, 0.2] -> three
+        streams of ~70%/20%/10%."""
+        if not proportions or any(p <= 0 for p in proportions):
+            raise ValueError("proportions must be positive")
+        if sum(proportions) >= 1.0:
+            raise ValueError("proportions must sum to < 1 "
+                             "(the remainder forms the last split)")
+        n = self.count()
+        indices, acc = [], 0.0
+        for p in proportions:
+            acc += p
+            # round, not truncate: float accumulation (0.7+0.2=0.8999…)
+            # must not shave a row off a split boundary
+            indices.append(round(n * acc))
+        return self.split_at_indices(indices)
+
+    def random_sample(self, fraction: float,
+                      *, seed: Optional[int] = None) -> "Datastream":
+        """Bernoulli row sample at `fraction` (reference
+        Dataset.random_sample): each block filters locally with a
+        per-block rng — no shuffle, no driver materialization."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1], got {fraction}")
+
+        import random as _random
+
+        base = seed if seed is not None else _random.randrange(2**31)
+
+        def sample(block, _base=base, _frac=fraction):
+            rows = _block_rows(block)
+            # per-block rng derived from (seed, block content checksum):
+            # distinct blocks sample independently, and a retried/lineage-
+            # re-executed block reproduces its original sample
+            csum = len(rows)
+            if isinstance(block, dict) and block:
+                first = np.ascontiguousarray(next(iter(block.values())))
+                if first.size and first.dtype != object:
+                    csum = int(first.view(np.uint8).sum())
+                elif first.size:  # object columns: hash a stable prefix
+                    csum = hash(repr(first.ravel()[0])) & 0x7FFFFFFF
+            rng = np.random.default_rng((_base, csum))
+            keep = rng.random(len(rows)) < _frac
+            return _rows_to_block([r for r, k in zip(rows, keep) if k])
+
+        return self.map_batches(sample)
+
+    def randomize_block_order(self, *, seed: Optional[int] = None
+                              ) -> "Datastream":
+        """Shuffle the BLOCK order only — O(1) metadata, no data moves
+        (reference Dataset.randomize_block_order; the cheap shuffle used
+        between epochs when a full row shuffle is too expensive)."""
+        import copy
+        import random as _random
+
+        if self._refs is None:
+            # lazy source: block order IS file order — shuffle the paths and
+            # stay lazy (pushdown, input_files, footer schema all survive)
+            source = copy.copy(self._source)
+            source.paths = list(source.paths)
+            _random.Random(seed).shuffle(source.paths)
+            source._submitted = {}
+            return Datastream(None, self._ops, source=source)
+        refs = list(self._refs)
+        _random.Random(seed).shuffle(refs)
+        return Datastream(refs, self._ops)
+
+    def take_batch(self, batch_size: int = 20) -> Block:
+        """First up-to-batch_size rows as one columnar batch (reference
+        Dataset.take_batch)."""
+        return _rows_to_block(self.take(batch_size))
+
+    def show(self, limit: int = 20) -> None:
+        for row in self.take(limit):
+            print(row)
+
+    def size_bytes(self) -> int:
+        """Total materialized block bytes (reference Dataset.size_bytes)."""
+        total = 0
+        for ref in self._stream_refs():
+            b = ray_tpu.get(ref)
+            if isinstance(b, dict):
+                total += sum(np.asarray(v).nbytes for v in b.values())
+            else:
+                import sys as _sys
+
+                total += sum(_sys.getsizeof(r) for r in b)
+        return total
+
+    def input_files(self) -> List[str]:
+        """Source files feeding this stream, [] for in-memory sources
+        (reference Dataset.input_files)."""
+        return list(self._source.paths) if self._source is not None else []
+
+    def to_numpy_refs(self) -> List["ObjectRef"]:
+        """Object refs of the executed blocks (dict-of-numpy form),
+        without pulling them to the driver (reference
+        Dataset.to_numpy_refs)."""
+        return list(self._stream_refs())
+
     def take(self, limit: int = 20) -> List[Any]:
         out: List[Any] = []
         for ref in self._stream_refs():
@@ -902,7 +1004,14 @@ def _block_col(block: Block, col: str) -> Optional[np.ndarray]:
         return None
     if isinstance(block, dict):
         return np.asarray(block[col])
-    return np.asarray([r[col] for r in _block_rows(block)])
+    vals = [r[col] for r in _block_rows(block)]
+    try:
+        return np.asarray(vals)
+    except ValueError:  # ragged values (per-row lists): keep them as rows
+        out = np.empty(len(vals), dtype=object)
+        for i, v in enumerate(vals):
+            out[i] = v
+        return out
 
 
 def _block_col_sum(block: Block, col: str):

@@ -63,6 +63,7 @@ class Algorithm:
         for w in workers:
             try:
                 ray_tpu.kill(w)
-            except (ConnectionError, ValueError, KeyError, RuntimeError) as e:
+            except (OSError, TimeoutError, ValueError, KeyError,
+                    RuntimeError) as e:
                 logging.getLogger(__name__).debug(
                     "stop(): worker already gone (%s)", e)

@@ -185,3 +185,41 @@ def test_datastream_stats(ray_start_regular):
 
     empty = rd.range(4).materialize()
     assert "fully materialized" in empty.stats()
+
+
+def test_data_api_widening(ray_start_regular, tmp_path):
+    """random_sample / randomize_block_order / take_batch / show /
+    size_bytes / input_files / split_proportionately / to_numpy_refs
+    (reference Dataset API surface)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    ds = rd.from_numpy({"x": np.arange(1000)}, parallelism=10)
+
+    s = ds.random_sample(0.3, seed=7)
+    n = s.count()
+    assert 150 < n < 450, n
+    assert s.count() == ds.random_sample(0.3, seed=7).count()  # deterministic
+
+    ro = ds.randomize_block_order(seed=3)
+    assert ro.count() == 1000
+    assert sorted(r["x"] for r in ro.take_all()) == list(range(1000))
+
+    batch = ds.take_batch(32)
+    assert isinstance(batch, dict) and len(batch["x"]) == 32
+
+    assert ds.size_bytes() == 1000 * np.arange(1000).itemsize
+    assert ds.input_files() == []
+    pq.write_table(pa.table({"a": [1]}), str(tmp_path / "i.parquet"))
+    assert rd.read_parquet(
+        str(tmp_path / "i.parquet")).input_files() == [
+            str(tmp_path / "i.parquet")]
+
+    a, b, c = ds.split_proportionately([0.7, 0.2])
+    assert (a.count(), b.count(), c.count()) == (700, 200, 100)
+    with pytest.raises(ValueError):
+        ds.split_proportionately([0.7, 0.5])
+
+    refs = ds.to_numpy_refs()
+    assert len(refs) == 10
+    assert len(ray_tpu.get(refs[0])["x"]) == 100

@@ -148,3 +148,60 @@ def test_unseeded_shuffles_differ(rt):
     b = [int(r["id"]) for r in ds.random_shuffle().take_all()]
     assert sorted(a) == sorted(b) == list(range(50))
     assert a != b  # astronomically unlikely to collide if truly unseeded
+
+
+def test_new_preprocessors(ray_start_regular):
+    """Imputer/Normalizer/Robust+MaxAbs scalers/KBins/Ordinal/MultiHot/
+    Tokenizer/CountVectorizer/FeatureHasher/PowerTransformer (reference
+    python/ray/data/preprocessors coverage)."""
+    from ray_tpu.data.preprocessors import (
+        CountVectorizer, FeatureHasher, KBinsDiscretizer, MaxAbsScaler,
+        MultiHotEncoder, Normalizer, OrdinalEncoder, PowerTransformer,
+        RobustScaler, SimpleImputer, Tokenizer)
+
+    ds = rdata.from_numpy({
+        "x": np.array([1.0, 2.0, np.nan, 4.0]),
+        "y": np.array([-2.0, 0.0, 2.0, 4.0]),
+        "cat": np.array(["a", "b", "a", "c"], dtype=object),
+        "txt": np.array(["red fox", "red dog", "dog", "fox fox"],
+                        dtype=object),
+    }, parallelism=2)
+
+    out = SimpleImputer(["x"], strategy="mean").fit_transform(ds).take_all()
+    filled = [r["x"] for r in out]
+    assert filled[2] == pytest.approx((1 + 2 + 4) / 3)
+
+    out = RobustScaler(["y"]).fit_transform(ds).take_all()
+    assert [r["y"] for r in out][1] == pytest.approx((0.0 - 1.0) / 3.0)
+
+    out = MaxAbsScaler(["y"]).fit_transform(ds).take_all()
+    assert max(abs(r["y"]) for r in out) == pytest.approx(1.0)
+
+    out = Normalizer(["x", "y"], norm="l2").transform(ds).take_all()
+    r1 = out[1]
+    assert r1["x"] ** 2 + r1["y"] ** 2 == pytest.approx(1.0)
+
+    out = KBinsDiscretizer(["y"], bins=2,
+                           strategy="quantile").fit_transform(ds).take_all()
+    assert sorted({r["y"] for r in out}) == [0, 1]
+
+    out = OrdinalEncoder(["cat"]).fit_transform(ds).take_all()
+    assert [r["cat"] for r in out] == [0, 1, 0, 2]
+
+    lists = rdata.from_items([{"tags": ["a", "b"]}, {"tags": ["b"]}],
+                          parallelism=1)
+    out = MultiHotEncoder(["tags"]).fit_transform(lists).take_all()
+    assert list(out[0]["tags"]) == [1, 1] and list(out[1]["tags"]) == [0, 1]
+
+    out = Tokenizer(["txt"]).transform(ds).take_all()
+    assert out[0]["txt"] == ["red", "fox"]
+
+    out = CountVectorizer(["txt"]).fit_transform(ds).take_all()
+    assert out[3]["txt_fox"] == 2 and out[3]["txt_red"] == 0
+
+    out = FeatureHasher(["txt"], num_features=8).transform(
+        Tokenizer(["txt"]).transform(ds)).take_all()
+    assert out[3]["hashed_features"].sum() == 2  # "fox fox" -> 2 tokens
+
+    pt = PowerTransformer(["y"], power=0.5).transform(ds).take_all()
+    assert pt[0]["y"] < 0 and pt[3]["y"] > 0
