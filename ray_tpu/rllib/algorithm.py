@@ -37,7 +37,33 @@ class Algorithm:
         self.iteration += 1
         metrics = self.training_step()
         metrics["training_iteration"] = self.iteration
+        interval = getattr(getattr(self, "cfg", None),
+                           "evaluation_interval", None)
+        if interval and self.iteration % interval == 0:
+            metrics["evaluation"] = self.evaluate()
         return metrics
+
+    def evaluate(self, num_episodes: Optional[int] = None) -> Dict[str, Any]:
+        """Deterministic evaluation episodes spread over the rollout
+        workers (reference Algorithm.evaluate, algorithm.py:847; the
+        in-place evaluation_num_workers=0 mode — workers run fresh envs,
+        training state untouched)."""
+        import ray_tpu
+        from ray_tpu.rllib.evaluation import summarize_eval
+
+        workers = getattr(self, "workers", None)
+        if not workers:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no rollout workers to evaluate "
+                "with; override evaluate()")
+        n = num_episodes or getattr(getattr(self, "cfg", None),
+                                    "evaluation_duration", 5)
+        per = [n // len(workers)] * len(workers)
+        for i in range(n % len(workers)):
+            per[i] += 1
+        refs = [w.eval_episodes.remote(k, seed=1000 + 7 * i)
+                for i, (w, k) in enumerate(zip(workers, per)) if k > 0]
+        return summarize_eval(ray_tpu.get(refs))
 
     def save(self) -> Checkpoint:
         return Checkpoint.from_dict({

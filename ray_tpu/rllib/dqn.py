@@ -15,6 +15,7 @@ import numpy as np
 import ray_tpu
 from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
+from ray_tpu.rllib.evaluation import EvalConfigMixin
 from ray_tpu.rllib.learner import Learner
 from ray_tpu.rllib.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 
@@ -67,6 +68,12 @@ class EpsilonGreedyWorker:
     def set_weights(self, params) -> bool:
         self.params = {k: np.asarray(v) for k, v in params.items()}
         return True
+
+    def eval_episodes(self, num_episodes: int, seed: int = 0):
+        from ray_tpu.rllib.evaluation import run_eval_episodes
+
+        return run_eval_episodes(self.vec.env_maker, self.module,
+                                 self.params, num_episodes, seed)
 
     def sample(self, num_steps: int, epsilon: float) -> Dict[str, np.ndarray]:
         cols = {k: [] for k in ("obs", "actions", "rewards", "next_obs", "dones")}
@@ -164,7 +171,7 @@ class DQNLearner(Learner):
         return self.extra
 
 
-class DQNConfig:
+class DQNConfig(EvalConfigMixin):
     def __init__(self):
         self.env_maker: Callable[[int], Any] = lambda seed: CartPoleEnv(seed)
         self.obs_dim = CartPoleEnv.observation_dim

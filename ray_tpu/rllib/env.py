@@ -126,6 +126,7 @@ class VectorEnv:
     def __init__(self, env_fn: Callable[[int], Any], num_envs: int,
                  seed: int = 0, discrete: bool = True):
         self.envs = [env_fn(seed + i) for i in range(num_envs)]
+        self.env_maker = env_fn  # evaluation spins fresh envs from this
         self.num_envs = num_envs
         self._cast = int if discrete else (lambda a: a)
 

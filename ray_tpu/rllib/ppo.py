@@ -20,6 +20,7 @@ import numpy as np
 import ray_tpu
 from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
+from ray_tpu.rllib.evaluation import EvalConfigMixin
 from ray_tpu.rllib.learner import Learner
 
 
@@ -86,6 +87,14 @@ class RolloutWorkerImpl:
     def set_weights(self, params: dict) -> bool:
         self.params = {k: np.asarray(v) for k, v in params.items()}
         return True
+
+    def eval_episodes(self, num_episodes: int, seed: int = 0):
+        """Deterministic evaluation on a FRESH env (training episode state
+        untouched) — reference Algorithm.evaluate's worker-side role."""
+        from ray_tpu.rllib.evaluation import run_eval_episodes
+
+        return run_eval_episodes(self.vec.env_maker, self.module,
+                                 self.params, num_episodes, seed)
 
     def _act(self) -> Dict[str, Any]:
         data = {"obs": self.obs, "rng": self.rng, "module": self.module,
@@ -213,7 +222,7 @@ class PPOLearner(Learner):
 # --------------------------------------------------------------- algorithm
 
 
-class PPOConfig:
+class PPOConfig(EvalConfigMixin):
     """Builder-pattern config (reference rllib/algorithms/ppo/ppo.py)."""
 
     def __init__(self):

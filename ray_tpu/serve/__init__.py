@@ -15,3 +15,4 @@ from ray_tpu.serve.api import (
 )
 from ray_tpu.serve.config import deploy_config_file, load_config
 from ray_tpu.serve.ingress import App, Request, RouteNotFound, ingress
+from ray_tpu.serve.batching import batch

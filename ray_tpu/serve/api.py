@@ -47,6 +47,19 @@ class AutoscalingConfig:
     downscale_delay_s: float = 5.0
 
 
+def _cfg_eq(a, b) -> bool:
+    """Structural equality robust to ndarray-bearing configs (== on those
+    raises) and to handle-bearing init args: compare pickled bytes, treat
+    any serialization asymmetry as 'changed' (the safe direction — it
+    falls back to a full rolling update)."""
+    if a is b:
+        return True
+    try:
+        return cloudpickle.dumps(a) == cloudpickle.dumps(b)
+    except Exception:
+        return False
+
+
 def _replica_key(r) -> bytes:
     """Stable identity for a replica handle: the ACTOR id, not id(handle) —
     handle objects are recreated (and their id() reused by the allocator),
@@ -58,12 +71,14 @@ def _replica_key(r) -> bytes:
 @ray_tpu.remote
 class _ReplicaActor:
     def __init__(self, def_blob: bytes, init_args, init_kwargs,
-                 def_version: int = 0):
+                 def_version: int = 0, user_config: Any = None):
         target = cloudpickle.loads(def_blob)
         if isinstance(target, type):
             self._callable = target(*init_args, **(init_kwargs or {}))
         else:
             self._callable = target
+        if user_config is not None:
+            self.reconfigure(user_config)
         self._inflight = 0
         # The deployment-definition version this replica was built from
         # lives ON the replica: a restarted controller recovers it by
@@ -74,6 +89,16 @@ class _ReplicaActor:
 
     def def_version(self) -> int:
         return self._def_version
+
+    def reconfigure(self, user_config) -> bool:
+        """Apply a new user_config in place (reference replica
+        reconfigure): class deployments implement reconfigure(cfg)."""
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is None:
+            raise ValueError(
+                "deployment got user_config but defines no reconfigure()")
+        fn(user_config)
+        return True
 
     def handle_request(self, method_name: str, args, kwargs):
         self._inflight += 1
@@ -130,7 +155,7 @@ class ServeController:
                 name: {k: d[k] for k in (
                     "def_blob", "init_args", "init_kwargs", "target",
                     "actor_options", "autoscaling", "max_concurrency",
-                    "def_version", "app_ingress") if k in d}
+                    "def_version", "app_ingress", "user_config") if k in d}
                 for name, d in self._deployments.items()},
             "replicas": {name: [r.actor_id for r in rs]
                          for name, rs in self._replicas.items()},
@@ -214,8 +239,42 @@ class ServeController:
     def deploy(self, name: str, def_blob: bytes, init_args, init_kwargs,
                num_replicas: int, actor_options: Optional[dict],
                autoscaling: Optional[AutoscalingConfig], max_concurrency: int,
-               app_ingress: bool = False):
+               app_ingress: bool = False, user_config: Any = None):
         existing = self._deployments.get(name)
+        if (existing is not None
+                and not _cfg_eq(existing.get("user_config"), user_config)
+                and existing["def_blob"] == def_blob
+                and _cfg_eq(existing["init_args"], init_args)
+                and _cfg_eq(existing["init_kwargs"], init_kwargs)
+                and _cfg_eq(existing["actor_options"],
+                            dict(actor_options or {}))
+                and _cfg_eq(existing["autoscaling"], autoscaling)
+                and existing["max_concurrency"] == max_concurrency
+                and existing.get("app_ingress", False) == bool(app_ingress)):
+            # user_config-only redeploy: push reconfigure() into live
+            # replicas in place — no version bump, no rolling restart
+            # (reference lightweight-update path, deployment_state.py).
+            # The in-flight rolling candidate (if any) must get the new
+            # config too — it may be promoted to serving next.
+            targets = list(self._replicas.get(name, []))
+            if existing.get("_rolling") is not None:
+                targets.append(existing["_rolling"][0])
+            try:
+                ray_tpu.get([r.reconfigure.remote(user_config)
+                             for r in targets], timeout=30)
+            except Exception as e:
+                # a replica rejected the config (no reconfigure(), or it
+                # raised): fall through to a ROLLING redeploy so state
+                # and reality re-converge instead of silently diverging
+                logger.warning(
+                    "in-place reconfigure of %s failed (%s); falling back "
+                    "to rolling update", name, e)
+            else:
+                existing["user_config"] = user_config
+                existing["target"] = (num_replicas if autoscaling is None
+                                      else autoscaling.min_replicas)
+                self._reconcile_one(name)
+                return True
         # Redeploy = ROLLING update (reference DeploymentState version
         # rollout): old replicas keep serving; the reconcile loop replaces
         # them one at a time with health-checked new-definition replicas.
@@ -236,6 +295,7 @@ class ServeController:
             "autoscaling": autoscaling,
             "max_concurrency": max_concurrency,
             "app_ingress": bool(app_ingress),
+            "user_config": user_config,
             "last_scale_up": 0.0,
             "last_scale_down": 0.0,
             "def_version": def_version,
@@ -383,7 +443,7 @@ class ServeController:
         ver = d.get("def_version", 0)
         replica = _ReplicaActor.options(**opts).remote(
             self._blob_arg(d), d["init_args"], d["init_kwargs"],
-            def_version=ver)
+            def_version=ver, user_config=d.get("user_config"))
         self._replica_def_version[_replica_key(replica)] = ver
         return replica
 
@@ -804,6 +864,10 @@ class Deployment:
     max_concurrent_queries: int = 8
     init_args: tuple = ()
     init_kwargs: Optional[dict] = None
+    # pushed to replicas via their reconfigure() method; changing ONLY
+    # this on redeploy updates live replicas in place, no restart
+    # (reference deployment user_config / Deployment.reconfigure)
+    user_config: Optional[Any] = None
 
     def bind(self, *args, **kwargs) -> "Deployment":
         import dataclasses as dc
@@ -819,7 +883,8 @@ class Deployment:
 def deployment(_func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1, ray_actor_options: Optional[dict] = None,
                autoscaling_config: Optional[dict] = None,
-               max_concurrent_queries: int = 8):
+               max_concurrent_queries: int = 8,
+               user_config: Optional[Any] = None):
     """`@serve.deployment` (reference python/ray/serve/api.py:261)."""
 
     def wrap(target):
@@ -834,6 +899,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             ray_actor_options=dict(ray_actor_options or {}),
             autoscaling_config=auto,
             max_concurrent_queries=max_concurrent_queries,
+            user_config=user_config,
         )
 
     return wrap(_func_or_class) if _func_or_class is not None else wrap
@@ -931,6 +997,7 @@ def run(target: Deployment, *, name: str = "default") -> DeploymentHandle:
             d.autoscaling_config,
             d.max_concurrent_queries,
             getattr(d.func_or_class, "_serve_app_ingress", False),
+            d.user_config,
         ))
     handle = _cached_handle(target.name)
     handle._refresh()

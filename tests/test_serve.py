@@ -695,3 +695,77 @@ def test_http_closed_loop_throughput(ray_start_regular):
     floor = 1000 if load1 <= 1.5 else 900
     assert best >= floor, (f"HTTP throughput {best:.0f} req/s < {floor} "
                            f"(load1={load1:.2f})")
+
+
+def test_serve_batch_decorator(serve_cluster):
+    """@serve.batch: concurrent single-item calls coalesce into list-batch
+    invocations of the underlying method (reference serve/batching.py:206),
+    with per-call results in order."""
+    @serve.deployment(max_concurrent_queries=16)
+    class Doubler:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.1)
+        def __call__(self, xs):
+            self.batch_sizes.append(len(xs))
+            return [x * 2 for x in xs]
+
+        def sizes(self):
+            return self.batch_sizes
+
+    handle = serve.run(Doubler.bind())
+    refs = [handle.remote(i) for i in range(16)]
+    assert ray_tpu.get(refs, timeout=60) == [i * 2 for i in range(16)]
+    sizes = ray_tpu.get(handle.options(method_name="sizes").remote(),
+                        timeout=30)
+    assert sum(sizes) == 16
+    assert max(sizes) > 1, f"no batching happened: {sizes}"
+
+
+def test_serve_batch_error_propagates(serve_cluster):
+    @serve.deployment(max_concurrent_queries=8)
+    class Boom:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        def __call__(self, xs):
+            raise RuntimeError("batch failed")
+
+    handle = serve.run(Boom.bind())
+    with pytest.raises(RuntimeError, match="batch failed"):
+        ray_tpu.get(handle.remote(1), timeout=30)
+
+
+def test_user_config_reconfigure_without_restart(serve_cluster):
+    """A user_config-only redeploy pushes reconfigure() into LIVE replicas
+    (same actor pids, no rolling restart) — the reference's lightweight
+    update path."""
+    import os as _os
+
+    @serve.deployment(num_replicas=2, user_config={"factor": 10})
+    class Scaler:
+        def __init__(self):
+            self.factor = 1
+
+        def reconfigure(self, cfg):
+            self.factor = cfg["factor"]
+
+        def __call__(self, x):
+            import os
+
+            return {"pid": os.getpid(), "y": x * self.factor}
+
+    handle = serve.run(Scaler.bind())
+    outs = [ray_tpu.get(handle.remote(1), timeout=30) for _ in range(8)]
+    assert all(o["y"] == 10 for o in outs)
+    pids_before = {o["pid"] for o in outs}
+
+    serve.run(Scaler.options(user_config={"factor": 99}).bind())
+    deadline = time.monotonic() + 20
+    outs = []
+    while time.monotonic() < deadline:
+        outs = [ray_tpu.get(handle.remote(1), timeout=30) for _ in range(8)]
+        if all(o["y"] == 99 for o in outs):
+            break
+        time.sleep(0.3)
+    assert all(o["y"] == 99 for o in outs), outs
+    assert {o["pid"] for o in outs} <= pids_before, "replicas restarted"
