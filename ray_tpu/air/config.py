@@ -9,7 +9,7 @@ fields: `use_tpu` + `chips_per_worker` instead of `use_gpu`, and
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
 
 @dataclass
@@ -55,3 +55,6 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     verbose: int = 0
+    # stop criteria: a tune.Stopper, {"metric": threshold} dict, or
+    # callable(trial_id, result) -> bool (reference RunConfig/tune.run stop)
+    stop: Any = None

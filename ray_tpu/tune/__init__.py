@@ -5,7 +5,10 @@ from ray_tpu.tune.schedulers import (
 from ray_tpu.tune.searchers import (
     BayesOptSearcher, ConcurrencyLimiter, RandomSearcher, Searcher,
     TPESearcher, TuneBOHB)
-from ray_tpu.tune.tuner import TuneConfig, Tuner, ResultGrid
+from ray_tpu.tune.stopper import (CombinedStopper, FunctionStopper,
+                                  MaximumIterationStopper, Stopper,
+                                  TimeoutStopper, TrialPlateauStopper)
+from ray_tpu.tune.tuner import TuneConfig, Tuner, ResultGrid, with_parameters
 from ray_tpu.tune.session import report, get_checkpoint
 
 __all__ = [
@@ -15,4 +18,7 @@ __all__ = [
     "MedianStoppingRule", "PopulationBasedTraining", "PB2",
     "Searcher", "RandomSearcher", "TPESearcher", "BayesOptSearcher",
     "ConcurrencyLimiter", "TuneBOHB", "BOHBScheduler",
+    "Stopper", "MaximumIterationStopper", "TimeoutStopper",
+    "TrialPlateauStopper", "FunctionStopper", "CombinedStopper",
+    "with_parameters",
 ]

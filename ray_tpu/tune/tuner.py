@@ -25,6 +25,7 @@ import ray_tpu
 from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.result import Result
 from ray_tpu.tune import session as tune_session
+from ray_tpu.tune.stopper import make_stopper
 from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
 from ray_tpu.tune.search import generate_configs
 
@@ -189,7 +190,8 @@ class TrialRunner:
                  tune_config: TuneConfig,
                  experiment_dir: Optional[str] = None,
                  failure_config=None,
-                 restored_trials: Optional[List[Trial]] = None):
+                 restored_trials: Optional[List[Trial]] = None,
+                 stopper=None, stop_spec=None):
         self.fn = fn
         if restored_trials is not None:
             self.trials = restored_trials
@@ -204,6 +206,10 @@ class TrialRunner:
                         else len(self.trials))
         self.experiment_dir = experiment_dir
         self.failure_config = failure_config
+        self.stopper = stopper
+        # raw RunConfig.stop, persisted so Tuner.restore re-arms the same
+        # criteria (stateful stopper WINDOWS reset; criteria do not)
+        self.stop_spec = stop_spec
         self._last_snapshot = 0.0
         # persisted-checkpoint cache: trial_id -> (id of in-memory ckpt,
         # directory-backed Checkpoint written under the experiment dir)
@@ -244,6 +250,7 @@ class TrialRunner:
             "tune_config": self.cfg,
             "scheduler": self.scheduler,
             "failure_config": self.failure_config,
+            "stop": self.stop_spec,
             "target": self._target,
         }
         os.makedirs(self.experiment_dir, exist_ok=True)
@@ -358,6 +365,13 @@ class TrialRunner:
     def run(self) -> None:
         idle_retries = 0
         while True:
+            if self.stopper is not None and self.stopper.stop_all():
+                for t in list(self.trials):
+                    if t.state in ("RUNNING", "PENDING"):
+                        self._finalize_checkpoint(t)
+                        self._stop_trial(t, "TERMINATED")
+                self._snapshot(force=True)
+                return
             self._maybe_suggest_trials()
             running = [t for t in self.trials if t.state == "RUNNING"]
             pending = [t for t in self.trials if t.state == "PENDING"]
@@ -423,6 +437,14 @@ class TrialRunner:
             trial.last_checkpoint = ckpt
         trial.last_result = result
         trial.history.append(result)
+        if self.stopper is not None and self.stopper(trial.trial_id, result):
+            # stop criteria trump the scheduler entirely: a trial at the
+            # stop bar must terminate even if PBT would have exploited it
+            # on this same result
+            self._finalize_checkpoint(trial)
+            self._stop_trial(trial, "TERMINATED")
+            self._notify_searcher(trial)
+            return
         decision = self.scheduler.on_trial_result(self, trial, result)
         if trial.state != "RUNNING":
             return  # scheduler exploited/restarted this trial
@@ -503,7 +525,10 @@ class Tuner:
             name=os.path.basename(path.rstrip("/")),
             storage_path=os.path.dirname(path.rstrip("/")),
             # the retry budget must survive the crash it exists for
-            failure_config=state.get("failure_config") or FailureConfig())
+            failure_config=state.get("failure_config") or FailureConfig(),
+            # so must the stop criteria (stateful stopper windows reset;
+            # the criteria themselves re-arm)
+            stop=state.get("stop"))
         t._restored_trials = [Trial.from_snapshot(s, resume_errored)
                               for s in state["trials"]]
         return t
@@ -520,6 +545,8 @@ class Tuner:
             self._fn, configs, self._cfg,
             experiment_dir=self.experiment_dir(),
             failure_config=getattr(self._run_config, "failure_config", None),
+            stopper=make_stopper(getattr(self._run_config, "stop", None)),
+            stop_spec=getattr(self._run_config, "stop", None),
             restored_trials=self._restored_trials)
         runner.run()
         results = []
@@ -531,3 +558,18 @@ class Tuner:
                                   error=err, metrics_history=t.history))
         return ResultGrid(results, default_metric=self._cfg.metric,
                           default_mode=self._cfg.mode)
+
+
+def with_parameters(trainable: Callable, **kwargs):
+    """Bind large constant objects to a trainable via the object store
+    (reference `tune.with_parameters`): each bound value is `put()` once
+    and every trial resolves the same ref instead of re-pickling the
+    payload into each trial actor's spec."""
+    refs = {k: ray_tpu.put(v) for k, v in kwargs.items()}
+
+    def wrapped(config):
+        resolved = {k: ray_tpu.get(r) for k, r in refs.items()}
+        return trainable(config, **resolved)
+
+    wrapped.__name__ = getattr(trainable, "__name__", "trainable")
+    return wrapped

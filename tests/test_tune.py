@@ -296,3 +296,77 @@ def test_tuner_failure_config_retries_from_checkpoint(ray_start_regular,
     assert grid.get_best_result().metrics["score"] == 3.0
     with open(marker) as f:
         assert f.read().count("attempt") == 2  # first run + one retry
+
+
+def test_stop_criteria_dict_and_plateau(ray_start_regular):
+    """RunConfig(stop=...): dict thresholds stop a trial at the metric bar;
+    TrialPlateauStopper stops converged trials early (reference
+    tune/stopper/)."""
+    from ray_tpu.air.config import RunConfig
+    from ray_tpu.tune import TrialPlateauStopper
+    from ray_tpu.tune import session
+
+    def train_fn(config):
+        for i in range(50):
+            session.report({"score": min(i, 10)})  # plateaus at 10
+
+    # dict: stop at training_iteration >= 5
+    grid = tune.Tuner(
+        train_fn, param_space={"x": tune.grid_search([1])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(stop={"training_iteration": 5}),
+    ).fit()
+    assert grid[0].metrics["training_iteration"] == 5
+
+    # plateau: converges at score=10, stops well before 50 iterations
+    grid = tune.Tuner(
+        train_fn, param_space={"x": tune.grid_search([1])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(stop=TrialPlateauStopper(
+            "score", std=0.0, num_results=3, grace_period=3)),
+    ).fit()
+    it = grid[0].metrics["training_iteration"]
+    assert 10 <= it < 30, it
+
+
+def test_timeout_stopper_stops_experiment(ray_start_regular):
+    from ray_tpu.air.config import RunConfig
+    from ray_tpu.tune import TimeoutStopper
+    from ray_tpu.tune import session
+
+    def slow_fn(config):
+        import time as _t
+
+        for i in range(1000):
+            _t.sleep(0.05)
+            session.report({"score": i})
+
+    import time as _t
+
+    t0 = _t.monotonic()
+    tune.Tuner(
+        slow_fn, param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    max_concurrent_trials=2),
+        run_config=RunConfig(stop=TimeoutStopper(3.0)),
+    ).fit()
+    assert _t.monotonic() - t0 < 30
+
+
+def test_with_parameters_binds_via_object_store(ray_start_regular):
+    import numpy as np
+
+    from ray_tpu.tune import session
+
+    big = np.arange(100_000, dtype=np.float64)
+
+    def train_fn(config, data=None):
+        session.report({"score": float(data.sum()) + config["x"]})
+
+    grid = tune.Tuner(
+        tune.with_parameters(train_fn, data=big),
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.metrics["score"] == big.sum() + 2.0
