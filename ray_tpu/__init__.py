@@ -22,6 +22,8 @@ from ray_tpu._version import __version__
 
 # Core public API (lazy-bound to avoid importing jax at `import ray_tpu` time).
 from ray_tpu.core.api import (
+    get_gpu_ids,
+    get_tpu_ids,
     init,
     shutdown,
     is_initialized,

@@ -203,6 +203,7 @@ class RemoteFunction:
             max_retries=o.get("max_retries", 0),
             retry_exceptions=o.get("retry_exceptions", False),
             runtime_env=o.get("runtime_env"),
+            max_calls=int(o.get("max_calls") or 0),
         )
         if num_returns == -1:
             return w.make_dynamic_generator(refs[0])
@@ -369,6 +370,27 @@ def get_runtime_context() -> RuntimeContext:
 
     w = current_worker() or _global_worker()
     return RuntimeContext(w)
+
+
+def get_gpu_ids() -> List[int]:
+    """Reference `ray.get_gpu_ids`. This framework targets TPU hosts —
+    there are never CUDA devices to enumerate; the accelerator analog is
+    `get_tpu_ids()`."""
+    return []
+
+
+def get_tpu_ids() -> List[int]:
+    """Chip indices the raylet granted the current task or actor (the
+    TPU-native `ray.get_gpu_ids`): DISJOINT across concurrent tasks on a
+    node — whole chips for integer demands, a shared chip index for
+    fractional ones. [] when nothing is reserved."""
+    from ray_tpu.core.worker import current_worker
+
+    w = current_worker() or _global_worker()
+    ids = getattr(getattr(w, "_tls", None), "tpu_ids", None)
+    if ids is None:
+        ids = list(getattr(w, "_actor_tpu_ids", []) or [])
+    return list(ids)
 
 
 def timeline() -> List[dict]:

@@ -49,6 +49,8 @@ class WorkerHandle:
     is_driver: bool = False
     # resources held for the actor's lifetime: (bundle_key | None, demand)
     actor_charge: Optional[Tuple[Optional[Tuple], Dict[str, float]]] = None
+    # chip indices granted for the current task / actor lifetime
+    tpu_grant: Optional[Tuple[Optional[List[int]], float]] = None
 
 
 @dataclass
@@ -151,6 +153,13 @@ class Raylet:
         self._pull_budget = _PullBudget(cfg.pull_admission_max_bytes)
 
         self._gcs: Optional[rpc.RpcClient] = None
+        # Per-chip TPU index assignment (reference worker GPU-id grants):
+        # index -> remaining capacity. Integer demands take whole chips;
+        # fractional demands pack onto one chip (best fit). Assigned ids
+        # ship with the execute_task/become_actor push so get_tpu_ids()
+        # reports DISJOINT devices across concurrent tasks.
+        self._tpu_slots: Dict[int, float] = {
+            i: 1.0 for i in range(int(self.resources_total.get("TPU", 0)))}
         self._start_time = time.time()
         # workers we SIGKILLed for memory pressure: their death notification
         # carries reason="oom" so exhausted retries surface OutOfMemoryError
@@ -522,6 +531,9 @@ class Raylet:
             return
         was_oom = wid in self._oom_killed
         self._oom_killed.discard(wid)
+        if handle.tpu_grant is not None:
+            self._release_tpus(*handle.tpu_grant)
+            handle.tpu_grant = None
         if spec is not None:
             self._release_resources(spec)
             self._notify_owner_worker_died(spec, reason="oom" if was_oom else "")
@@ -768,6 +780,43 @@ class Raylet:
             self._queue.append(_QueuedTask(spec, spillback_count))
         self._schedule()
 
+    def _assign_tpus(self, amount: float) -> Optional[List[int]]:
+        """Caller holds self._lock. Returns chip indices for `amount` TPU
+        (whole chips for integer demands; one shared chip for fractions),
+        or None when accounting says yes but no slot fits (fragmentation —
+        fall back to unindexed execution rather than deadlock)."""
+        if amount <= 0 or not self._tpu_slots:
+            return []
+        if amount < 1.0:
+            # best fit: the most-used slot that still has room
+            best = None
+            for i, rem in self._tpu_slots.items():
+                if rem >= amount and (best is None
+                                      or rem < self._tpu_slots[best]):
+                    best = i
+            if best is None:
+                return None
+            self._tpu_slots[best] -= amount
+            return [best]
+        need = int(amount)
+        free = [i for i, rem in self._tpu_slots.items() if rem >= 1.0]
+        if len(free) < need:
+            return None
+        for i in free[:need]:
+            self._tpu_slots[i] = 0.0
+        return free[:need]
+
+    def _release_tpus(self, ids: Optional[List[int]], amount: float) -> None:
+        if not ids:
+            return
+        with self._lock:
+            if amount < 1.0:
+                self._tpu_slots[ids[0]] = min(
+                    1.0, self._tpu_slots.get(ids[0], 0.0) + amount)
+            else:
+                for i in ids:
+                    self._tpu_slots[i] = 1.0
+
     def _schedule(self) -> None:
         """Drain the queue: dispatch locally or spill to a better node.
 
@@ -809,7 +858,11 @@ class Raylet:
                 self._charge_resources(spec, demand)
                 handle.current_task = spec
                 handle.task_started = time.monotonic()
-                handle.conn.push("execute_task", {"spec": spec})
+                tpu_amount = demand.get("TPU", 0.0)
+                tpu_ids = self._assign_tpus(tpu_amount)
+                handle.tpu_grant = (tpu_ids, tpu_amount)
+                handle.conn.push("execute_task", {
+                    "spec": spec, "tpu_ids": tpu_ids or []})
                 dispatched_any = True
             self._queue = pending
             for ekey, (count, renv) in spawn_wants.items():
@@ -924,14 +977,30 @@ class Raylet:
 
     def rpc_task_done(self, conn, req_id, payload):
         wid: WorkerID = payload["worker_id"]
+        retiring = bool(payload.get("retiring"))
         with self._lock:
             w = self._workers.get(wid)
             if w is None:
                 return True
             spec = w.current_task
             w.current_task = None
+            grant, w.tpu_grant = w.tpu_grant, None
+            if retiring:
+                # max_calls recycling: the worker exits after this notify.
+                # Drop it NOW so no task is dispatched into the closing
+                # process, and so its disconnect reads as clean (reference
+                # worker_pool DisconnectWorker on max-calls exit).
+                self._workers.pop(wid, None)
         if spec is not None:
             self._release_resources(spec)
+        if grant is not None:
+            self._release_tpus(*grant)
+        if retiring:
+            if w.env_key:
+                self._env_manager.release(w.env_key)
+            self._schedule()
+            self._report_resources()
+            return True
         with self._lock:
             if w.actor_id is None and w.conn.alive:
                 w.idle_since = time.monotonic()
@@ -980,13 +1049,20 @@ class Raylet:
         for r, q in demand.items():
             pool[r] = pool.get(r, 0.0) - q
         handle.actor_charge = (key, demand)
-        handle.conn.push("become_actor", {"spec": spec})
+        tpu_amount = demand.get("TPU", 0.0)
+        tpu_ids = self._assign_tpus(tpu_amount)
+        handle.tpu_grant = (tpu_ids, tpu_amount)
+        handle.conn.push("become_actor", {
+            "spec": spec, "tpu_ids": tpu_ids or []})
 
     def _release_actor_charge(self, handle: WorkerHandle) -> None:
         charge = handle.actor_charge
         if charge is None:
             return
         handle.actor_charge = None
+        if handle.tpu_grant is not None:
+            self._release_tpus(*handle.tpu_grant)
+            handle.tpu_grant = None
         key, demand = charge
         with self._lock:
             pool = self._bundles.get(key) if key is not None else self.resources_available

@@ -74,6 +74,11 @@ class TaskSpec:
     # runtime env (conda/pip not supported; env vars + working dir are)
     runtime_env: Optional[dict] = None
 
+    # worker recycling: the executing worker exits after running this
+    # function max_calls times (reference remote_function.py _max_calls —
+    # bounds leaks from native libraries); 0 = unlimited
+    max_calls: int = 0
+
     def return_object_ids(self) -> List[ObjectID]:
         n = 1 if self.num_returns == -1 else self.num_returns
         return [ObjectID.for_task_return(self.task_id, i + 1) for i in range(n)]

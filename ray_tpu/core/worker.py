@@ -294,6 +294,10 @@ class CoreWorker:
         # are daemons sized once at creation)
         self._default_exec_threads: List[threading.Thread] = []
         self._executing_count = 0
+        self._fn_call_counts: Dict[int, int] = {}
+        # chip indices granted by the raylet (get_tpu_ids surface)
+        self._task_tpu_ids: Dict[TaskID, List[int]] = {}
+        self._actor_tpu_ids: List[int] = []
         # executing+queued actor tasks excluding control-plane probes, so a
         # load reading is never inflated by the health checks that sample it
         self._load_count = 0
@@ -387,6 +391,7 @@ class CoreWorker:
         max_retries: int = 0,
         retry_exceptions: bool = False,
         runtime_env: Optional[dict] = None,
+        max_calls: int = 0,
     ) -> List[ObjectRef]:
         from ray_tpu.core.task_spec import SchedulingStrategy
 
@@ -411,6 +416,7 @@ class CoreWorker:
             owner_address=self.address,
             owner_worker_id=self.worker_id,
             runtime_env=runtime_env,
+            max_calls=max_calls,
         )
         refs = self._register_returns(spec)
         with self._pending_lock:
@@ -2018,8 +2024,13 @@ class CoreWorker:
     # ------------------------------------------------------------- execution
     def _on_raylet_push(self, method: str, payload) -> None:
         if method == "execute_task":
-            self._task_queue.put(payload["spec"])
+            spec = payload["spec"]
+            ids = payload.get("tpu_ids")
+            if ids:
+                self._task_tpu_ids[spec.task_id] = list(ids)
+            self._task_queue.put(spec)
         elif method == "become_actor":
+            self._actor_tpu_ids = list(payload.get("tpu_ids") or [])
             self._become_actor(payload["spec"])
         elif method == "global_gc":
             import gc
@@ -2206,6 +2217,9 @@ class CoreWorker:
         self._tls.job_id = spec.job_id  # log attribution (tee -> driver)
         prev_pg = getattr(self._tls, "placement_group_id", None)
         self._tls.placement_group_id = spec.scheduling.placement_group_id
+        # chip grant for get_tpu_ids(): the task's own, else the actor's
+        self._tls.tpu_ids = self._task_tpu_ids.pop(
+            spec.task_id, None) or list(self._actor_tpu_ids)
         self._emit_task_event(spec, "RUNNING")
         with self._exec_count_lock:
             self._executing_count += 1
@@ -2298,10 +2312,27 @@ class CoreWorker:
             logger.warning("could not deliver results of %s to owner %s",
                            spec.method_name, spec.owner_address)
         if spec.task_type != TaskType.ACTOR_TASK:
+            recycle = False
+            if spec.max_calls > 0 and self.mode == "worker":
+                # worker recycling (reference max_calls): if this function
+                # just hit its budget, retire — the task_done notify tells
+                # the raylet to drop us from the pool FIRST so the next
+                # task can't be dispatched into the exiting process
+                key = hash(spec.function_blob)
+                with self._exec_count_lock:
+                    self._fn_call_counts[key] = (
+                        self._fn_call_counts.get(key, 0) + 1)
+                    recycle = self._fn_call_counts[key] >= spec.max_calls
             try:
-                self.raylet.notify("task_done", {"worker_id": self.worker_id})
+                self.raylet.notify("task_done", {
+                    "worker_id": self.worker_id, "retiring": recycle})
             except OSError as e:
                 logger.debug("task_done notify lost (raylet down?): %s", e)
+            if recycle:
+                logger.info("max_calls=%d reached for %s; recycling worker",
+                            spec.max_calls, spec.method_name)
+                self.flush_profile_events(min_events=1)
+                os._exit(0)
 
     def _stream_dynamic_returns(self, spec: TaskSpec, value) -> ObjectRefGenerator:
         """Executor side of num_returns="dynamic": iterate the task's

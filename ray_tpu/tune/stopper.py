@@ -20,6 +20,11 @@ class Stopper:
     def stop_all(self) -> bool:
         return False
 
+    def reset(self) -> None:
+        """Clear accumulated state (plateau windows, armed deadlines).
+        Called by Tuner.restore so a resumed experiment re-arms the
+        CRITERIA without inheriting pre-crash state."""
+
 
 class MaximumIterationStopper(Stopper):
     def __init__(self, max_iter: int):
@@ -49,6 +54,9 @@ class TimeoutStopper(Stopper):
 
     def stop_all(self) -> bool:
         return time.monotonic() >= self._armed_deadline()
+
+    def reset(self) -> None:
+        self._deadline = None  # monotonic clocks don't survive restarts
 
 
 class TrialPlateauStopper(Stopper):
@@ -87,6 +95,10 @@ class TrialPlateauStopper(Stopper):
         var = sum((x - mean) ** 2 for x in w) / len(w)
         return var ** 0.5 <= self.std
 
+    def reset(self) -> None:
+        self._window.clear()
+        self._seen.clear()
+
 
 class FunctionStopper(Stopper):
     def __init__(self, fn: Callable[[str, Dict[str, Any]], bool]):
@@ -107,6 +119,10 @@ class CombinedStopper(Stopper):
 
     def stop_all(self) -> bool:
         return any(s.stop_all() for s in self.stoppers)
+
+    def reset(self) -> None:
+        for s in self.stoppers:
+            s.reset()
 
 
 class _DictStopper(Stopper):

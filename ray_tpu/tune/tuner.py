@@ -25,7 +25,13 @@ import ray_tpu
 from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.result import Result
 from ray_tpu.tune import session as tune_session
-from ray_tpu.tune.stopper import make_stopper
+from ray_tpu.tune.stopper import Stopper, make_stopper
+
+
+def _restored_stop(spec):
+    if isinstance(spec, Stopper):
+        spec.reset()
+    return spec
 from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
 from ray_tpu.tune.search import generate_configs
 
@@ -526,9 +532,9 @@ class Tuner:
             storage_path=os.path.dirname(path.rstrip("/")),
             # the retry budget must survive the crash it exists for
             failure_config=state.get("failure_config") or FailureConfig(),
-            # so must the stop criteria (stateful stopper windows reset;
-            # the criteria themselves re-arm)
-            stop=state.get("stop"))
+            # so must the stop criteria; stateful stopper internals
+            # (plateau windows, armed deadlines) are explicitly reset
+            stop=_restored_stop(state.get("stop")))
         t._restored_trials = [Trial.from_snapshot(s, resume_errored)
                               for s in state["trials"]]
         return t

@@ -411,3 +411,75 @@ def test_actor_concurrency_groups(ray_start_regular, tmp_path):
     with open(flag, "w"):
         pass
     assert ray_tpu.get(blocked, timeout=30) == "unblocked"
+
+
+def test_max_calls_recycles_worker(ray_start_regular):
+    """A function with max_calls=2 never runs more than twice in one worker
+    process (reference remote_function.py _max_calls worker recycling)."""
+    import time
+
+    @ray_tpu.remote(max_calls=2)
+    def whoami():
+        import os
+
+        return os.getpid()
+
+    pids = [ray_tpu.get(whoami.remote(), timeout=60) for _ in range(6)]
+    from collections import Counter
+
+    counts = Counter(pids)
+    assert max(counts.values()) <= 2, counts
+    assert len(counts) >= 3
+
+
+def test_max_calls_results_survive_recycling(ray_start_regular):
+    @ray_tpu.remote(max_calls=1)
+    def val(i):
+        return i * 10
+
+    refs = [val.remote(i) for i in range(4)]
+    assert ray_tpu.get(refs, timeout=120) == [0, 10, 20, 30]
+
+
+def test_tpu_and_gpu_id_accessors(ray_start_regular):
+    """get_gpu_ids() is always [] (TPU framework); get_tpu_ids() returns
+    raylet-granted chip indices: DISJOINT across concurrent tasks, held
+    for an actor's lifetime, shared index for fractional demands."""
+    import time
+
+    assert ray_tpu.get_gpu_ids() == []
+
+    @ray_tpu.remote(num_tpus=2)
+    def on_tpus():
+        import time as _t
+
+        ids = ray_tpu.get_tpu_ids()
+        _t.sleep(1.0)  # overlap the two tasks so grants must be disjoint
+        return ids, ray_tpu.get_gpu_ids()
+
+    r1, r2 = on_tpus.remote(), on_tpus.remote()
+    (ids1, gpus), (ids2, _) = ray_tpu.get([r1, r2], timeout=120)
+    assert len(ids1) == 2 and len(ids2) == 2 and gpus == []
+    assert not (set(ids1) & set(ids2)), (ids1, ids2)
+
+    @ray_tpu.remote
+    def plain():
+        return ray_tpu.get_tpu_ids()
+
+    assert ray_tpu.get(plain.remote(), timeout=60) == []
+
+    @ray_tpu.remote(num_tpus=1)
+    class Holder:
+        def ids(self):
+            return ray_tpu.get_tpu_ids()
+
+    h = Holder.remote()
+    a = ray_tpu.get(h.ids.remote(), timeout=60)
+    assert len(a) == 1 and a == ray_tpu.get(h.ids.remote(), timeout=60)
+    ray_tpu.kill(h)
+
+    @ray_tpu.remote(num_tpus=0.5)
+    def frac():
+        return ray_tpu.get_tpu_ids()
+
+    assert len(ray_tpu.get(frac.remote(), timeout=60)) == 1
