@@ -133,6 +133,12 @@ def main(argv=None) -> int:
     p_sv_status.add_argument("--address", required=True)
     p_sv_down = serve_sub.add_parser("shutdown")
     p_sv_down.add_argument("--address", required=True)
+    p_sv_run = serve_sub.add_parser(
+        "run", help="import module:deployment and serve.run it")
+    p_sv_run.add_argument("import_path", help="module.sub:attr")
+    p_sv_run.add_argument("--address", required=True)
+    p_sv_run.add_argument("--port", type=int, default=8000)
+    p_sv_run.add_argument("--blocking", action="store_true")
 
     p_job = sub.add_parser("job", help="job submission")
     job_sub = p_job.add_subparsers(dest="job_cmd", required=True)
@@ -142,6 +148,24 @@ def main(argv=None) -> int:
     p_job_submit.add_argument("entrypoint", nargs=argparse.REMAINDER)
     p_job_list = job_sub.add_parser("list")
     p_job_list.add_argument("--address", required=True)
+    for cmdname in ("status", "logs", "stop"):
+        p = job_sub.add_parser(cmdname)
+        p.add_argument("job_id")
+        p.add_argument("--address", required=True)
+
+    p_rllib = sub.add_parser("rllib", help="RL training (reference rllib CLI)")
+    rllib_sub = p_rllib.add_subparsers(dest="rllib_cmd", required=True)
+    p_rl_train = rllib_sub.add_parser("train")
+    p_rl_train.add_argument("--algo", required=True,
+                            help="registered algorithm, e.g. ppo/dqn/impala")
+    p_rl_train.add_argument("--stop-iters", type=int, default=10)
+    p_rl_train.add_argument("--stop-reward", type=float, default=None)
+    p_rl_train.add_argument("--num-workers", type=int, default=2)
+    p_rl_train.add_argument("--checkpoint-path", default=None)
+    p_rl_eval = rllib_sub.add_parser("evaluate")
+    p_rl_eval.add_argument("--algo", required=True)
+    p_rl_eval.add_argument("--checkpoint-path", required=True)
+    p_rl_eval.add_argument("--episodes", type=int, default=5)
 
     p_debug = sub.add_parser("debug",
                              help="attach to a remote rpdb breakpoint")
@@ -389,6 +413,24 @@ def main(argv=None) -> int:
         _connect(args.address)
         from ray_tpu import serve
 
+        if args.serve_cmd == "run":
+            import importlib
+
+            mod_name, _, attr = args.import_path.partition(":")
+            if not attr:
+                print("import_path must be module:attribute", file=sys.stderr)
+                return 2
+            sys.path.insert(0, "")
+            target = getattr(importlib.import_module(mod_name), attr)
+            serve.run(target.bind() if hasattr(target, "bind") else target)
+            _, port = serve.start_http_proxy(port=args.port)
+            print(f"serving on http://127.0.0.1:{port}")
+            if args.blocking:
+                import time as _time
+
+                while True:
+                    _time.sleep(3600)
+            return 0
         if args.serve_cmd == "deploy":
             print(json.dumps(serve.deploy_config_file(args.config_file)))
         elif args.serve_cmd == "status":
@@ -409,8 +451,62 @@ def main(argv=None) -> int:
             job_id = client.submit_job(
                 entrypoint=" ".join(entry), working_dir=args.working_dir)
             print(job_id)
+        elif args.job_cmd == "status":
+            print(client.get_job_status(args.job_id))
+        elif args.job_cmd == "logs":
+            print(client.get_job_logs(args.job_id))
+        elif args.job_cmd == "stop":
+            ok = client.stop_job(args.job_id)
+            print("stopped" if ok else "not running")
+            return 0 if ok else 1
         else:
             print(json.dumps(client.list_jobs(), indent=2, default=str))
+        return 0
+
+    if args.cmd == "rllib":
+        _connect(args.address) if hasattr(args, "address") else None
+        import ray_tpu as _rt
+
+        if not _rt.is_initialized():
+            _rt.init(num_cpus=4)
+        from ray_tpu import rllib as _rllib
+
+        by_name = {n[:-6].lower(): getattr(_rllib, n) for n in dir(_rllib)
+                   if n.endswith("Config")}
+        cfg_cls = by_name.get(args.algo.lower())
+        if cfg_cls is None:
+            print(f"unknown algorithm {args.algo!r}; "
+                  f"available: {' '.join(sorted(by_name))}")
+            return 1
+        cfg = cfg_cls()
+        if hasattr(cfg, "rollouts") and args.rllib_cmd == "train":
+            try:
+                cfg.rollouts(num_rollout_workers=args.num_workers)
+            except TypeError:
+                pass
+        algo = cfg.build()
+        try:
+            if args.rllib_cmd == "train":
+                last = {}
+                for i in range(args.stop_iters):
+                    last = algo.train()
+                    reward = last.get("episode_reward_mean")
+                    print(f"iter {i + 1}: reward={reward}")
+                    if (args.stop_reward is not None and reward is not None
+                            and reward >= args.stop_reward):
+                        break
+                if args.checkpoint_path:
+                    ckpt = algo.save()
+                    ckpt.to_directory(args.checkpoint_path)
+                    print(f"checkpoint: {args.checkpoint_path}")
+            else:  # evaluate
+                from ray_tpu.air.checkpoint import Checkpoint
+
+                algo.restore(Checkpoint.from_directory(args.checkpoint_path))
+                ev = algo.evaluate(num_episodes=args.episodes)
+                print(json.dumps(ev, indent=2))
+        finally:
+            algo.stop()
         return 0
 
     return 1

@@ -330,3 +330,61 @@ def test_nodes_report_physical_stats(ray_start_regular):
     assert stats["mem_total"] > 0
     assert 0 <= stats["cpu_percent"] <= 100 * 64
     assert stats["num_workers"] >= 0
+
+
+def test_job_cli_status_logs_stop(gcs_address, capsys, tmp_path):
+    """ray_tpu job status/logs/stop round-trip (reference `ray job` CLI)."""
+    import time
+
+    script = tmp_path / "job_script.py"
+    script.write_text(
+        "import time\nprint('hello-job', flush=True)\ntime.sleep(30)\n")
+    rc, out = _cli(capsys, "job", "submit", "--address", gcs_address, "--",
+                   sys.executable, str(script))
+    assert rc == 0
+    job_id = out.strip().splitlines()[-1]
+
+    deadline = time.monotonic() + 30
+    status = ""
+    while time.monotonic() < deadline:
+        rc, status = _cli(capsys, "job", "status", job_id,
+                          "--address", gcs_address)
+        if "RUNNING" in status:
+            break
+        time.sleep(0.5)
+    assert "RUNNING" in status, status
+
+    deadline = time.monotonic() + 20
+    logs = ""
+    while time.monotonic() < deadline and "hello-job" not in logs:
+        rc, logs = _cli(capsys, "job", "logs", job_id,
+                        "--address", gcs_address)
+        time.sleep(0.5)
+    assert "hello-job" in logs
+
+    rc, out = _cli(capsys, "job", "stop", job_id, "--address", gcs_address)
+    assert rc == 0 and "stopped" in out
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        rc, status = _cli(capsys, "job", "status", job_id,
+                          "--address", gcs_address)
+        if "STOPPED" in status or "FAILED" in status:
+            break
+        time.sleep(0.5)
+    assert "STOPPED" in status or "FAILED" in status, status
+
+
+def test_rllib_cli_train_and_evaluate(ray_start_regular, capsys, tmp_path):
+    """ray_tpu rllib train --algo ppo trains and checkpoints; evaluate
+    restores and reports (reference `rllib train/evaluate` CLI)."""
+    ckpt = str(tmp_path / "ppo_ckpt")
+    rc, out = _cli(capsys, "rllib", "train", "--algo", "ppo",
+                   "--stop-iters", "2", "--num-workers", "1",
+                   "--checkpoint-path", ckpt)
+    assert rc == 0 and "iter 2" in out and "checkpoint:" in out
+
+    rc, out = _cli(capsys, "rllib", "evaluate", "--algo", "ppo",
+                   "--checkpoint-path", ckpt, "--episodes", "2")
+    assert rc == 0
+    ev = json.loads(out[out.index("{"):])
+    assert ev["num_episodes"] == 2
