@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import os
 import subprocess
+import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional
@@ -30,7 +31,10 @@ class JobSupervisor:
         self.working_dir = working_dir
         self.env_vars = env_vars or {}
         self._proc: Optional[subprocess.Popen] = None
-        self._log = b""
+        self._log = bytearray()
+        self._log_lock = threading.Lock()
+        self._log_cap = 16 * 1024 * 1024  # rolling: keep the newest 16 MiB
+        self._reader: Optional[threading.Thread] = None
         self._status = "PENDING"
 
     def start(self, gcs_address: str) -> str:
@@ -40,8 +44,31 @@ class JobSupervisor:
         self._proc = subprocess.Popen(
             self.entrypoint, shell=True, cwd=self.working_dir,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+        # Drain stdout continuously so (a) `logs()` works while the job is
+        # RUNNING and (b) a chatty job can never block on a full pipe
+        # (reference streams logs while running: job_manager.py:820).
+        self._reader = threading.Thread(
+            target=self._drain, args=(self._proc.stdout,), daemon=True)
+        self._reader.start()
         self._status = "RUNNING"
         return self._status
+
+    def _drain(self, pipe) -> None:
+        try:
+            # read1: returns as soon as any bytes are available (plain
+            # read(n) would block until the full n bytes or EOF).
+            for chunk in iter(lambda: pipe.read1(65536), b""):
+                with self._log_lock:
+                    self._log += chunk
+                    if len(self._log) > self._log_cap:
+                        del self._log[:len(self._log) - self._log_cap]
+        except (OSError, ValueError):
+            pass  # pipe closed mid-read during stop()
+        finally:
+            try:
+                pipe.close()
+            except OSError:
+                pass
 
     def poll(self) -> str:
         if self._proc is None:
@@ -50,14 +77,15 @@ class JobSupervisor:
         if rc is None:
             return "RUNNING"
         if self._status in ("RUNNING",):
-            out, _ = self._proc.communicate()
-            self._log += out or b""
+            if self._reader is not None:
+                self._reader.join(timeout=5)
             self._status = "SUCCEEDED" if rc == 0 else "FAILED"
         return self._status
 
     def logs(self) -> str:
         self.poll()
-        return self._log.decode(errors="replace")
+        with self._log_lock:
+            return self._log.decode(errors="replace")
 
     def stop(self) -> bool:
         if self._proc is not None and self._proc.poll() is None:
