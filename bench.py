@@ -92,7 +92,9 @@ def main() -> None:
         # most recompute; the smaller batch keeps activations inside HBM
         cfg = ModelConfig(
             vocab_size=32768, d_model=2048, n_layers=12, n_heads=16,
-            n_kv_heads=8, d_ff=6144, max_seq_len=2048, remat="dots")
+            n_kv_heads=8, d_ff=6144, max_seq_len=2048, remat="dots",
+            fused_ffn=True, fused_attn=True)  # r05: custom-vjp FFN+attn
+        # backward (save-don't-recompute): 301.5 -> 287.5 ms
         batch_size, seq = 4 * n_chips, 2048  # 4 per chip (dp shards batch)
         peak_flops_per_chip = 197e12  # v5e bf16 peak
     else:  # CI smoke path
@@ -125,12 +127,13 @@ def main() -> None:
         # one chip) — reported as b1_* fields of the same single JSON line
         # the driver parses. Config retuned r04: batch 2/chip with selective
         # (dots) remat + unchunked fp32 logits beats batch 4 with full remat
-        # + chunked loss by ~3 MFU points (0.605 vs 0.575) — the smaller
-        # batch's saved-activation set fits HBM without recomputing the
-        # matmuls, and at b2 the whole [b,s,V] logits tensor is cheaper than
-        # the chunked scan's lm-head recompute.
+        # + chunked loss by ~3 MFU points (0.605 vs 0.575). r05: fused_ffn
+        # + fused_attn (custom-vjp FFN and attention blocks whose backward
+        # saves instead of recomputing; BASELINE.md r05 note) take the
+        # step from 249.9 to 235.1 ms (+3.6 MFU points).
         b1 = dataclasses.replace(
-            ModelConfig.b1(), max_seq_len=2048, remat="dots", loss_chunk=0)
+            ModelConfig.b1(), max_seq_len=2048, remat="dots", loss_chunk=0,
+            fused_ffn=True, fused_attn=True)
         try:
             b1_tok, b1_mfu, b1_dt, _, _, b1_params = _bench_config(
                 b1, 2 * n_chips, 2048, peak_flops_per_chip, iters)

@@ -15,7 +15,8 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ray_tpu.models.transformer import ModelConfig
+from ray_tpu.models.transformer import (ModelConfig, _deq_tree,
+                                        _embed_lookup, lm_head_weights)
 from ray_tpu.ops.layers import apply_rotary, rms_norm, rotary_embedding, swiglu
 
 
@@ -67,12 +68,13 @@ def prefill(params: Dict, tokens: jax.Array, cfg: ModelConfig,
     positions = jnp.arange(s)
     cos, sin = rotary_embedding(positions, hd, cfg.rope_theta)
     cos, sin = cos[None], sin[None]
-    x = params["embed"][tokens].astype(cfg.dtype)
+    x = _embed_lookup(params["embed"], tokens, cfg.dtype)
     causal = jnp.tril(jnp.ones((s, s), bool))
     pad = jnp.zeros((s, max_len - s), bool)
     mask = jnp.concatenate([causal, pad], axis=1)
 
     def body(x, lp):
+        lp = _deq_tree(lp, cfg.dtype)
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q, k, v = _project_qkv(cfg, lp, h, cos, sin)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
@@ -89,7 +91,7 @@ def prefill(params: Dict, tokens: jax.Array, cfg: ModelConfig,
 
     x, (k_all, v_all) = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    head = lm_head_weights(params, cfg)
     if logits_index is None:
         sel = x[:, -1]
     else:
@@ -109,11 +111,12 @@ def decode_step(params: Dict, cache: Dict, token: jax.Array,
     max_len = cache["k"].shape[-2]
     cos, sin = rotary_embedding(pos[None], hd, cfg.rope_theta)
     cos, sin = cos[None], sin[None]
-    x = params["embed"][token[:, None]].astype(cfg.dtype)  # [b,1,d]
+    x = _embed_lookup(params["embed"], token[:, None], cfg.dtype)  # [b,1,d]
     mask = (jnp.arange(max_len) <= pos)[None, :]  # [1, max_len]
 
     def body(x, inputs):
         lp, k_cache, v_cache = inputs
+        lp = _deq_tree(lp, cfg.dtype)
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q, k, v = _project_qkv(cfg, lp, h, cos, sin)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
@@ -131,7 +134,7 @@ def decode_step(params: Dict, cache: Dict, token: jax.Array,
     x, (k_all, v_all) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    head = lm_head_weights(params, cfg)
     logits = (x[:, 0] @ head.astype(cfg.dtype)).astype(jnp.float32)
     new_cache = {"k": k_all, "v": v_all, "length": pos + 1}
     return logits, new_cache

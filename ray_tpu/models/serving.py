@@ -25,8 +25,37 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.models.inference import _masked_attention, _mlp, _project_qkv
-from ray_tpu.models.transformer import ModelConfig, lm_head_weights
+from ray_tpu.models.transformer import (ModelConfig, _deq_tree,
+                                        _embed_lookup, lm_head_weights)
 from ray_tpu.ops.layers import rms_norm, rotary_embedding
+
+
+_QUANT_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_model_params(params: Dict, cfg: ModelConfig) -> Dict:
+    """w8a16 load-time quantization (the serving-engine consumer of
+    `ops.pallas.quant.quantize_int8`): every projection matrix, the
+    embedding table, and the lm head become `{"int8", "scale"}` leaves with
+    per-row absmax scales — ~2x less weight HBM and 2x less weight traffic
+    per decode step (decode is HBM-bound). Norm vectors stay in bf16: they
+    are 0.01% of the bytes and norm math is fp32 anyway. The model's
+    forward paths dequantize on read inside the layer scan."""
+    from ray_tpu.ops.pallas.quant import quantize_int8
+
+    def q(w):
+        values, scales = quantize_int8(w)
+        return {"int8": values, "scale": scales}
+
+    out = dict(params)
+    out["layers"] = {
+        k: (q(v) if k in _QUANT_LEAVES else v)
+        for k, v in params["layers"].items()
+    }
+    out["embed"] = q(params["embed"])
+    if "lm_head" in params:
+        out["lm_head"] = q(params["lm_head"])
+    return out
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "max_len"))
@@ -65,7 +94,7 @@ def decode_slots(params: Dict, k_all: jax.Array, v_all: jax.Array,
     hd = cfg.head_dim
     max_len = k_all.shape[-2]
     cos, sin = rotary_embedding(lengths[:, None], hd, cfg.rope_theta)  # [B,1,hd/2]
-    x = params["embed"][tokens[:, None]].astype(cfg.dtype)  # [B,1,d]
+    x = _embed_lookup(params["embed"], tokens[:, None], cfg.dtype)  # [B,1,d]
     mask = jnp.arange(max_len)[None, None, :] <= lengths[:, None, None]  # [B,1,L]
 
     def write_row(cache, new, pos):
@@ -78,6 +107,7 @@ def decode_slots(params: Dict, k_all: jax.Array, v_all: jax.Array,
 
     def body(x, inputs):
         lp, k_cache, v_cache = inputs  # caches [B, kvh, max_len, hd]
+        lp = _deq_tree(lp, cfg.dtype)
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q, k, v = _project_qkv(cfg, lp, h, cos, sin)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
@@ -110,7 +140,10 @@ class ContinuousBatchingEngine:
     """Host-side slot manager over the jitted prefill/decode kernels."""
 
     def __init__(self, params: Dict, cfg: ModelConfig, *, num_slots: int = 4,
-                 max_len: int = 512, eos_token: Optional[int] = None):
+                 max_len: int = 512, eos_token: Optional[int] = None,
+                 quantize_weights: bool = False):
+        if quantize_weights:
+            params = quantize_model_params(params, cfg)
         self.params = params
         self.cfg = cfg
         self.num_slots = num_slots
@@ -257,7 +290,8 @@ class ContinuousBatchingEngine:
 
 
 def LLMDeployment(params, cfg: ModelConfig, *, num_slots: int = 4,
-                  max_len: int = 512, eos_token: Optional[int] = None):
+                  max_len: int = 512, eos_token: Optional[int] = None,
+                  quantize_weights: bool = False):
     """A serve-ready callable class hosting one engine per replica.
 
     Usage:
@@ -271,7 +305,7 @@ def LLMDeployment(params, cfg: ModelConfig, *, num_slots: int = 4,
         def __init__(self):
             self.engine = ContinuousBatchingEngine(
                 params, cfg, num_slots=num_slots, max_len=max_len,
-                eos_token=eos_token)
+                eos_token=eos_token, quantize_weights=quantize_weights)
 
         def __call__(self, payload):
             prompt = list(payload["prompt"])

@@ -66,6 +66,19 @@ class ModelConfig:
     n_experts: int = 0
     capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # Fused FFN backward (ops/pallas/fused_ffn.py): the FFN block runs as a
+    # custom_vjp with Pallas dW/dx kernels that fuse the swiglu/rmsnorm
+    # chains into the matmuls; remat then covers only the attention half
+    # (the block saves its own dots-policy-equivalent residuals, and a
+    # custom_vjp inside jax.checkpoint would re-run its forward matmuls).
+    # Dense-FFN, non-sequence-parallel path only.
+    fused_ffn: bool = False
+    # Fused attention backward (ops/pallas/fused_attn.py): the attention
+    # half runs as a custom_vjp saving post-rotary q/k, v, the flash
+    # output and its logsumexp, so the backward skips the rotary/transpose/
+    # flash-forward recompute remat would do. Requires fused_ffn (the layer
+    # then runs with no jax.checkpoint at all).
+    fused_attn: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -190,9 +203,32 @@ def count_params(params: Any) -> int:
 # ---------------------------------------------------------------- forward
 
 
-def _layer(cfg: ModelConfig, mesh, x, layer_params, cos, sin):
-    """One transformer block. x: [b, s, d] (s possibly sp-sharded)."""
-    p = layer_params
+def _deq(leaf: Any, dtype) -> Any:
+    """Pass arrays through; dequantize `{"int8", "scale"}` leaves produced
+    by `models.serving.quantize_model_params` (w8a16 serving: weights live
+    in HBM as int8 + per-row fp32 scales; the cast happens on read, inside
+    the scan body, so only one layer's bf16 copy is ever transient)."""
+    if isinstance(leaf, dict) and "int8" in leaf:
+        return (leaf["int8"].astype(jnp.float32) * leaf["scale"]).astype(dtype)
+    return leaf
+
+
+def _deq_tree(p: Dict[str, Any], dtype) -> Dict[str, Any]:
+    return {k: _deq(v, dtype) for k, v in p.items()}
+
+
+def _embed_lookup(emb: Any, tokens: jax.Array, dtype) -> jax.Array:
+    """Token-embedding gather; for int8-quantized tables the gather happens
+    in int8 (the bf16 [vocab, d] table never materializes)."""
+    if isinstance(emb, dict) and "int8" in emb:
+        return (emb["int8"][tokens].astype(jnp.float32)
+                * emb["scale"][tokens]).astype(dtype)
+    return emb[tokens].astype(dtype)
+
+
+def _attn_half(cfg: ModelConfig, mesh, x, p, cos, sin):
+    """Attention sub-block: x + Wo(attn(rotary(qkv(rmsnorm(x)))))."""
+    p = _deq_tree(p, cfg.dtype)
     b, s, d = x.shape
     hd = cfg.head_dim
 
@@ -237,7 +273,13 @@ def _layer(cfg: ModelConfig, mesh, x, layer_params, cos, sin):
         attn = attention(q, k, v, causal=True)
     attn = checkpoint_name(
         attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd), "attn_out")
-    x = x + checkpoint_name((attn @ p["wo"]).astype(x.dtype), "attn_proj")
+    return x + checkpoint_name((attn @ p["wo"]).astype(x.dtype), "attn_proj")
+
+
+def _layer(cfg: ModelConfig, mesh, x, layer_params, cos, sin):
+    """One transformer block. x: [b, s, d] (s possibly sp-sharded)."""
+    x = _attn_half(cfg, mesh, x, layer_params, cos, sin)
+    p = _deq_tree(layer_params, cfg.dtype)
 
     h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts > 0:
@@ -294,7 +336,8 @@ def maybe_remat(layer_fn, cfg: ModelConfig):
 
 def lm_head_weights(params: Dict[str, Any], cfg: ModelConfig) -> jax.Array:
     """[d_model, vocab] output-projection weights in activation dtype."""
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    head = (_deq(params["embed"], cfg.dtype).T if cfg.tie_embeddings
+            else _deq(params["lm_head"], cfg.dtype))
     return head.astype(cfg.dtype)
 
 
@@ -309,16 +352,40 @@ def forward_features_with_aux(params: Dict[str, Any], tokens: jax.Array,
     """
     if positions is None:
         positions = jnp.arange(tokens.shape[1])
-    x = params["embed"][tokens].astype(cfg.dtype)  # gather: [b, s, d]
+    x = _embed_lookup(params["embed"], tokens, cfg.dtype)  # gather: [b, s, d]
     cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
     cos, sin = cos[None], sin[None]  # add batch dim
 
-    layer_fn = maybe_remat(functools.partial(_layer, cfg, mesh), cfg)
+    if cfg.fused_attn and not cfg.fused_ffn:
+        raise ValueError("fused_attn requires fused_ffn")
+    if cfg.fused_ffn:
+        if cfg.n_experts > 0 or cfg.seq_parallel or cfg.use_ring_attention:
+            raise ValueError("fused_ffn supports the dense, non-sp path only")
+        from ray_tpu.ops.pallas.fused_ffn import ffn_block
 
-    def body(carry, lp):
-        x, aux = carry
-        x, layer_aux = layer_fn(x, lp, cos, sin)
-        return (x, aux + layer_aux), None
+        if cfg.fused_attn:
+            from ray_tpu.ops.pallas.fused_attn import attn_block
+
+            def attn_fn(x, lp, cos, sin):
+                return attn_block(x, lp["attn_norm"], lp["wq"], lp["wk"],
+                                  lp["wv"], lp["wo"], cos, sin, cfg.n_heads,
+                                  cfg.n_kv_heads, cfg.norm_eps)
+        else:
+            attn_fn = maybe_remat(functools.partial(_attn_half, cfg, mesh), cfg)
+
+        def body(carry, lp):
+            x, aux = carry
+            x = attn_fn(x, lp, cos, sin)
+            x = ffn_block(x, lp["mlp_norm"], lp["w_gate"], lp["w_up"],
+                          lp["w_down"], cfg.norm_eps)
+            return (x, aux), None
+    else:
+        layer_fn = maybe_remat(functools.partial(_layer, cfg, mesh), cfg)
+
+        def body(carry, lp):
+            x, aux = carry
+            x, layer_aux = layer_fn(x, lp, cos, sin)
+            return (x, aux + layer_aux), None
 
     (x, aux_total), _ = jax.lax.scan(
         body, (x, jnp.zeros((), jnp.float32)), params["layers"],

@@ -83,3 +83,37 @@ def test_generate_stream_matches_generate():
     eng2 = ContinuousBatchingEngine(PARAMS, CFG, num_slots=2, max_len=MAX_LEN)
     streamed = list(eng2.generate_stream(prompt, max_new_tokens=8))
     assert prompt + streamed == full
+
+
+def test_int8_quantized_engine_quality_and_memory():
+    """w8a16 serving (VERDICT r04 #8): quantize_model_params halves weight
+    bytes; prefill logits stay close to the bf16 model; the engine runs
+    end to end with quantize_weights=True."""
+    from ray_tpu.models.inference import prefill
+    from ray_tpu.models.serving import quantize_model_params
+
+    qparams = quantize_model_params(PARAMS, CFG)
+
+    def leaf_bytes(tree):
+        return sum(x.size * x.dtype.itemsize
+                   for x in jax.tree_util.tree_leaves(tree))
+
+    big = {k: v for k, v in PARAMS["layers"].items() if v.ndim == 3}
+    big_q = {k: qparams["layers"][k] for k in big}
+    # fp32 tiny-model weights -> int8 + fp32 row scales: ~4x smaller
+    assert leaf_bytes(big_q) < 0.3 * leaf_bytes(big)
+
+    tokens = jnp.asarray([[5, 17, 400, 3, 9, 22, 7, 1]], jnp.int32)
+    ref_logits, _ = prefill(PARAMS, tokens, CFG, MAX_LEN)
+    q_logits, _ = prefill(qparams, tokens, CFG, MAX_LEN)
+    ref = np.asarray(ref_logits, np.float32)
+    qn = np.asarray(q_logits, np.float32)
+    scale = np.abs(ref).max() + 1e-6
+    assert np.abs(ref - qn).max() / scale < 0.08, \
+        np.abs(ref - qn).max() / scale
+
+    eng = ContinuousBatchingEngine(PARAMS, CFG, num_slots=2, max_len=MAX_LEN,
+                                   quantize_weights=True)
+    out = eng.generate([5, 17, 400, 3], max_new_tokens=8)
+    assert len(out) == 4 + 8  # prompt + generated
+    assert all(0 <= t < CFG.vocab_size for t in out)
