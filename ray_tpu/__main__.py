@@ -124,6 +124,12 @@ def main(argv=None) -> int:
 
     sub.add_parser("microbenchmark", help="core-primitive ops/s suite")
 
+    p_env = sub.add_parser(
+        "envelope", help="scalability-envelope suite (tasks/actors/PGs/"
+        "broadcast + microbenchmark), writes a JSON artifact")
+    p_env.add_argument("--out", default=None)
+    p_env.add_argument("--scale", type=float, default=1.0)
+
     p_serve = sub.add_parser("serve", help="model serving")
     serve_sub = p_serve.add_subparsers(dest="serve_cmd", required=True)
     p_sv_deploy = serve_sub.add_parser("deploy")
@@ -246,6 +252,15 @@ def main(argv=None) -> int:
         from ray_tpu.microbenchmark import main as micro_main
 
         return micro_main()
+
+    if args.cmd == "envelope":
+        from ray_tpu.envelope import main as env_main
+
+        argv = []
+        if args.out:
+            argv += ["--out", args.out]
+        argv += ["--scale", str(args.scale)]
+        return env_main(argv)
 
     if args.cmd == "timeline":
         from ray_tpu.util import tracing

@@ -1,0 +1,198 @@
+"""Scalability-envelope benchmark: a scaled-to-one-box analog of the
+reference's release envelope (`release/benchmarks/README.md:5-31` — many
+tasks/actors/PGs, 1 GiB broadcast, deep task queues) plus the core
+primitive-rate suite (`python/ray/_private/ray_perf.py:93-282`).
+
+One command (`ray_tpu envelope` or `python -m ray_tpu.envelope`) writes a
+JSON artifact with config + hardware metadata so the numbers can be read
+against the reference's table. The reference runs its envelope on 64×64-core
+nodes; the scaled counts here are chosen to finish in minutes on one small
+box — the artifact records the scale so nothing silently pretends otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import sys
+import time
+from typing import Dict, List
+
+import numpy as np
+
+
+def _hardware() -> Dict:
+    return {
+        "host": platform.node(),
+        "platform": platform.platform(),
+        "cpus": os.cpu_count(),
+        "mem_gib": round(os.sysconf("SC_PAGE_SIZE")
+                         * os.sysconf("SC_PHYS_PAGES") / 2**30, 1),
+        "python": platform.python_version(),
+    }
+
+
+def bench_queued_tasks(n_tasks: int = 20_000) -> Dict:
+    """Deep task queue on one node (reference: 1M+ queued on m4.16xlarge).
+    Measures submission rate (queue ingest) and end-to-end drain rate."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n_tasks)]
+    t_submit = time.perf_counter() - t0
+    ray_tpu.get(refs)
+    t_total = time.perf_counter() - t0
+    return {
+        "n_tasks": n_tasks,
+        "submit_per_s": round(n_tasks / t_submit, 1),
+        "end_to_end_per_s": round(n_tasks / t_total, 1),
+    }
+
+
+def bench_concurrent_actors(n_actors: int = 200) -> Dict:
+    """Concurrent alive actors (reference: 40k+ across 2000 nodes). All
+    created at once, then one round-trip call to every actor while all are
+    alive proves liveness rather than just registration."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return os.getpid()
+
+    t0 = time.perf_counter()
+    actors = [A.options(num_cpus=0).remote() for _ in range(n_actors)]
+    pids = ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+    t_up = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+    t_round = time.perf_counter() - t0
+    for a in actors:
+        ray_tpu.kill(a)
+    return {
+        "n_actors": n_actors,
+        "distinct_workers": len(set(pids)),
+        "create_to_first_ping_s": round(t_up, 2),
+        "alive_roundtrip_calls_per_s": round(n_actors / t_round, 1),
+    }
+
+
+def bench_placement_groups(n_pgs: int = 30) -> Dict:
+    """Simultaneous placement groups (reference: 1,000+ across the fleet)."""
+    import ray_tpu
+    from ray_tpu.core.placement_group import placement_group, remove_placement_group
+
+    t0 = time.perf_counter()
+    pgs = [placement_group([{"CPU": 0.01}], strategy="PACK")
+           for _ in range(n_pgs)]
+    for pg in pgs:
+        pg.ready(timeout=120)
+    t_up = time.perf_counter() - t0
+    for pg in pgs:
+        remove_placement_group(pg)
+    return {"n_pgs": n_pgs, "create_per_s": round(n_pgs / t_up, 1)}
+
+
+def bench_broadcast(size_mib: int = 1024, n_receivers: int = 3) -> Dict:
+    """1 GiB object broadcast over an in-process multi-raylet Cluster
+    (reference: 1 GiB to 50+ nodes). The object is PUSHed from the owning
+    node to every receiver's store (the `ray_tpu.push` plane serve/rllib
+    use for weight fan-out)."""
+    import ray_tpu.core.rpc as rpc
+    from ray_tpu.core.cluster import Cluster
+    from ray_tpu.core.ids import ObjectID
+
+    store_bytes = 2 * (size_mib << 20)
+    cluster = Cluster()
+    src = cluster.add_node(num_cpus=1, object_store_memory=store_bytes)
+    dsts = [cluster.add_node(num_cpus=1, object_store_memory=store_bytes)
+            for _ in range(n_receivers)]
+    try:
+        oid = ObjectID.from_random()
+        src.store.put_bytes(
+            oid, np.ones(size_mib << 20, dtype=np.uint8).data)
+        t0 = time.perf_counter()
+        clients, futures = [], []
+        for node in dsts:
+            cli = rpc.connect_with_retry(node.address, timeout=10)
+            clients.append(cli)
+            futures.append(cli.call_future(
+                "pull_object", {"object_id": oid, "source": src.address}))
+        for fut, cli in zip(futures, clients):
+            fut.result(timeout=600)
+            cli.close()
+        dt = time.perf_counter() - t0
+        moved_bits = size_mib * (1 << 20) * 8 * n_receivers
+        return {
+            "size_mib": size_mib,
+            "n_receivers": n_receivers,
+            "wall_s": round(dt, 2),
+            "aggregate_gbps": round(moved_bits / dt / 1e9, 2),  # decimal Gbit/s
+        }
+    finally:
+        cluster.shutdown()
+
+
+def run_envelope(scale: float = 1.0) -> Dict:
+    """Run every envelope bench inside one fresh runtime; returns the
+    artifact dict (committed as ENVELOPE_r{N}.json)."""
+    import ray_tpu
+    from ray_tpu.microbenchmark import run_microbenchmark
+
+    results: Dict = {
+        "suite": "scalability-envelope (scaled to one box)",
+        "reference": "release/benchmarks/README.md:5-31; ray_perf.py:93-282",
+        "hardware": _hardware(),
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    def log(msg):
+        print(f"[envelope] {msg}", file=sys.stderr, flush=True)
+
+    own = not ray_tpu.is_initialized()
+    if own:
+        ray_tpu.init(num_cpus=8)
+    try:
+        log("queued_tasks...")
+        results["queued_tasks"] = bench_queued_tasks(int(20_000 * scale))
+        log("concurrent_actors...")
+        results["concurrent_actors"] = bench_concurrent_actors(int(200 * scale))
+        log("placement_groups...")
+        results["placement_groups"] = bench_placement_groups(
+            max(1, int(30 * scale)))
+        log("microbenchmark...")
+        results["microbenchmark"] = run_microbenchmark()
+    finally:
+        if own:
+            ray_tpu.shutdown()
+    # broadcast boots its own multi-raylet cluster
+    log("broadcast...")
+    results["broadcast"] = bench_broadcast(int(1024 * scale) or 24)
+    log("done")
+    return results
+
+
+def main(argv: List[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None, help="write artifact JSON here")
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="scale factor on every count (CI smoke uses 0.01)")
+    args = ap.parse_args(argv)
+    art = run_envelope(scale=args.scale)
+    text = json.dumps(art, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,22 @@
+"""Envelope suite smoke (scaled 1%): the full-scale run is the committed
+ENVELOPE_r{N}.json artifact; this keeps the harness itself green in CI."""
+
+import math
+
+
+def test_envelope_smoke(tmp_path):
+    from ray_tpu.envelope import run_envelope
+
+    art = run_envelope(scale=0.01)
+    assert art["queued_tasks"]["n_tasks"] == 200
+    assert art["queued_tasks"]["end_to_end_per_s"] > 0
+    actors = art["concurrent_actors"]
+    assert actors["n_actors"] == 2
+    assert actors["distinct_workers"] == 2
+    assert actors["alive_roundtrip_calls_per_s"] > 0
+    assert art["placement_groups"]["n_pgs"] == 1  # max(1, scale*30)
+    assert art["placement_groups"]["create_per_s"] > 0
+    assert art["broadcast"]["aggregate_gbps"] > 0
+    rates = {r["benchmark"]: r["rate"] for r in art["microbenchmark"]}
+    assert all(math.isfinite(v) and v > 0 for v in rates.values())
+    assert "hardware" in art and art["hardware"]["cpus"] >= 1
