@@ -9,7 +9,7 @@ fields: `use_tpu` + `chips_per_worker` instead of `use_gpu`, and
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 
 @dataclass
@@ -20,6 +20,12 @@ class ScalingConfig:
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
     topology: Optional[str] = None       # e.g. "v5e-64": informs slice packing
+    # Elastic recovery (SURVEY hard-part #7): when a retry's placement group
+    # is infeasible on the surviving cluster (slice/node loss), shrink the
+    # request — halve num_workers, then halve the per-worker chip count —
+    # instead of failing. The train loop sees the smaller grant, builds a
+    # smaller mesh, and orbax restore re-lays the checkpoint onto it.
+    elastic: bool = False
 
     def worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker is not None:
@@ -58,3 +64,7 @@ class RunConfig:
     # stop criteria: a tune.Stopper, {"metric": threshold} dict, or
     # callable(trial_id, result) -> bool (reference RunConfig/tune.run stop)
     stop: Any = None
+    # tune.Callback instances (loggers, trackers); None = the default
+    # CSV/JSON/TensorBoard trio when an experiment dir exists (reference
+    # RunConfig(callbacks=...) + DEFAULT_LOGGERS)
+    callbacks: Optional[List[Any]] = None

@@ -10,6 +10,9 @@ from ray_tpu.tune.stopper import (CombinedStopper, FunctionStopper,
                                   TimeoutStopper, TrialPlateauStopper)
 from ray_tpu.tune.tuner import TuneConfig, Tuner, ResultGrid, with_parameters
 from ray_tpu.tune.session import report, get_checkpoint
+from ray_tpu.tune.callback import Callback
+from ray_tpu.tune.logger import (CSVLoggerCallback, JsonLoggerCallback,
+                                 TensorBoardLoggerCallback)
 
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "report", "get_checkpoint",
@@ -21,4 +24,6 @@ __all__ = [
     "Stopper", "MaximumIterationStopper", "TimeoutStopper",
     "TrialPlateauStopper", "FunctionStopper", "CombinedStopper",
     "with_parameters",
+    "Callback", "CSVLoggerCallback", "JsonLoggerCallback",
+    "TensorBoardLoggerCallback",
 ]

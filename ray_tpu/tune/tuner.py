@@ -197,7 +197,11 @@ class TrialRunner:
                  experiment_dir: Optional[str] = None,
                  failure_config=None,
                  restored_trials: Optional[List[Trial]] = None,
-                 stopper=None, stop_spec=None):
+                 stopper=None, stop_spec=None, callbacks=None):
+        from ray_tpu.tune.callback import CallbackList
+
+        self.callbacks = (callbacks if isinstance(callbacks, CallbackList)
+                          else CallbackList(callbacks))
         self.fn = fn
         if restored_trials is not None:
             self.trials = restored_trials
@@ -342,6 +346,7 @@ class TrialRunner:
             trial.last_result.get("training_iteration", 0))
         trial.state = "RUNNING"
         trial.pending = trial.actor.next_result.remote()
+        self.callbacks.on_trial_start(trial)
 
     def _stop_trial(self, trial: Trial, state: str = "TERMINATED") -> None:
         trial.state = state
@@ -349,9 +354,13 @@ class TrialRunner:
         if trial.actor is not None:
             try:
                 ray_tpu.kill(trial.actor)
-            except Exception:
-                pass
+            except (ValueError, RuntimeError, OSError):
+                pass  # actor already dead / runtime shutting down
             trial.actor = None
+        if state == "TERMINATED":
+            self.callbacks.on_trial_complete(trial)
+        elif state == "ERROR":
+            self.callbacks.on_trial_error(trial)
 
     def exploit(self, trial: Trial, donor: Trial, new_config: Dict[str, Any]) -> None:
         """PBT: clone donor's checkpoint into `trial` and restart it with the
@@ -369,6 +378,13 @@ class TrialRunner:
 
     # ----------------------------------------------------------- main loop
     def run(self) -> None:
+        self.callbacks.setup(self.experiment_dir)
+        try:
+            self._run_loop()
+        finally:
+            self.callbacks.on_experiment_end(self.trials)
+
+    def _run_loop(self) -> None:
         idle_retries = 0
         while True:
             if self.stopper is not None and self.stopper.stop_all():
@@ -441,8 +457,10 @@ class TrialRunner:
         ckpt = result.pop("__checkpoint__", None)
         if ckpt is not None:
             trial.last_checkpoint = ckpt
+            self.callbacks.on_checkpoint(trial, ckpt)
         trial.last_result = result
         trial.history.append(result)
+        self.callbacks.on_trial_result(trial, result)
         if self.stopper is not None and self.stopper(trial.trial_id, result):
             # stop criteria trump the scheduler entirely: a trial at the
             # stop bar must terminate even if PBT would have exploited it
@@ -547,13 +565,20 @@ class Tuner:
         else:
             configs = generate_configs(self._space, self._cfg.num_samples,
                                        self._cfg.seed)
+        exp_dir = self.experiment_dir()
+        callbacks = getattr(self._run_config, "callbacks", None)
+        if callbacks is None and exp_dir is not None:
+            from ray_tpu.tune.logger import DEFAULT_LOGGERS
+
+            callbacks = [cls() for cls in DEFAULT_LOGGERS]
         runner = TrialRunner(
             self._fn, configs, self._cfg,
-            experiment_dir=self.experiment_dir(),
+            experiment_dir=exp_dir,
             failure_config=getattr(self._run_config, "failure_config", None),
             stopper=make_stopper(getattr(self._run_config, "stop", None)),
             stop_spec=getattr(self._run_config, "stop", None),
-            restored_trials=self._restored_trials)
+            restored_trials=self._restored_trials,
+            callbacks=callbacks)
         runner.run()
         results = []
         for t in runner.trials:

@@ -1,0 +1,269 @@
+"""Tune logger/callback subsystem (reference `python/ray/tune/logger/`,
+`python/ray/tune/callback.py`, `python/ray/air/integrations/{wandb,mlflow}.py`)."""
+
+import csv
+import json
+import os
+import struct
+import sys
+
+import pytest
+
+
+def _fit(tmp_path, ray, callbacks=None):
+    from ray_tpu import tune
+    from ray_tpu.air.config import RunConfig
+
+    def _train_fn(config):
+        from ray_tpu import tune
+
+        for i in range(3):
+            tune.report({"score": config["a"] * (i + 1), "epoch": i})
+
+    tuner = tune.Tuner(
+        _train_fn,
+        param_space={"a": tune.grid_search([1.0, 2.0])},
+        tune_config=tune.TuneConfig(num_samples=1, metric="score", mode="max",
+                                    max_concurrent_trials=2),
+        run_config=RunConfig(name="exp", storage_path=str(tmp_path),
+                             callbacks=callbacks))
+    return tuner.fit(), os.path.join(str(tmp_path), "exp")
+
+
+def test_default_loggers_write_trial_files(ray_start_regular, tmp_path):
+    """With no explicit callbacks, CSV/JSON/TensorBoard loggers are on by
+    default and populate each trial dir (reference DEFAULT_LOGGERS)."""
+    results, exp_dir = _fit(tmp_path, ray_start_regular)
+    assert len(results) == 2
+    trial_dirs = [d for d in sorted(os.listdir(exp_dir))
+                  if d.startswith("trial_")
+                  and os.path.isdir(os.path.join(exp_dir, d))]
+    assert len(trial_dirs) == 2
+    for td in trial_dirs:
+        path = os.path.join(exp_dir, td)
+        with open(os.path.join(path, "params.json")) as f:
+            params = json.load(f)
+        assert params["a"] in (1.0, 2.0)
+        with open(os.path.join(path, "result.json")) as f:
+            rows = [json.loads(line) for line in f]
+        assert len(rows) == 3
+        assert rows[-1]["training_iteration"] == 3
+        with open(os.path.join(path, "progress.csv")) as f:
+            crows = list(csv.DictReader(f))
+        assert len(crows) == 3
+        assert float(crows[-1]["score"]) == params["a"] * 3
+        events = [x for x in os.listdir(path) if x.startswith("events.out")]
+        assert len(events) == 1
+
+
+def test_tensorboard_events_parse_back(ray_start_regular, tmp_path):
+    """The dependency-free TB writer emits valid TFRecord framing with
+    masked crc32c and parseable scalar summaries."""
+    from ray_tpu.tune.logger import _masked_crc
+
+    _, exp_dir = _fit(tmp_path, ray_start_regular)
+    trial = sorted(d for d in os.listdir(exp_dir) if d.startswith("trial_"))[0]
+    path = os.path.join(exp_dir, trial)
+    event_file = os.path.join(
+        path, [x for x in os.listdir(path) if x.startswith("events.out")][0])
+    raw = open(event_file, "rb").read()
+    records = []
+    off = 0
+    while off < len(raw):
+        (length,) = struct.unpack_from("<Q", raw, off)
+        (len_crc,) = struct.unpack_from("<I", raw, off + 8)
+        assert len_crc == _masked_crc(raw[off:off + 8])
+        payload = raw[off + 12:off + 12 + length]
+        (data_crc,) = struct.unpack_from("<I", raw, off + 12 + length)
+        assert data_crc == _masked_crc(payload)
+        records.append(payload)
+        off += 12 + length + 4
+    assert len(records) == 4  # file_version + 3 results
+    assert b"brain.Event:2" in records[0]
+    # scalar tags present in the summary payloads
+    assert any(b"score" in r for r in records[1:])
+
+
+class _Recorder:
+    """Bare Callback recording hook order."""
+
+    def __init__(self, log):
+        self.log = log
+
+    def setup(self, experiment_dir):
+        self.log.append(("setup", experiment_dir is not None))
+
+    def on_trial_start(self, trial):
+        self.log.append(("start", trial.trial_id))
+
+    def on_trial_result(self, trial, result):
+        self.log.append(("result", trial.trial_id,
+                         result["training_iteration"]))
+
+    def on_trial_complete(self, trial):
+        self.log.append(("complete", trial.trial_id))
+
+    def on_trial_error(self, trial):
+        self.log.append(("error", trial.trial_id))
+
+    def on_checkpoint(self, trial, checkpoint):
+        self.log.append(("checkpoint", trial.trial_id))
+
+    def on_experiment_end(self, trials):
+        self.log.append(("end", len(trials)))
+
+
+def test_callback_hook_order(ray_start_regular, tmp_path):
+    from ray_tpu.tune.callback import Callback
+
+    log = []
+
+    class R(_Recorder, Callback):
+        pass
+
+    _fit(tmp_path, ray_start_regular, callbacks=[R(log)])
+    assert log[0] == ("setup", True)
+    assert log[-1] == ("end", 2)
+    for tid in ("trial_00000", "trial_00001"):
+        events = [e for e in log if len(e) > 1 and e[1] == tid]
+        kinds = [e[0] for e in events]
+        assert kinds[0] == "start"
+        assert kinds[-1] == "complete"
+        assert [e[2] for e in events if e[0] == "result"] == [1, 2, 3]
+
+
+def test_raising_callback_is_isolated(ray_start_regular, tmp_path):
+    """A broken user callback is disabled, not fatal (reference stance)."""
+    from ray_tpu.tune.callback import Callback
+
+    log = []
+
+    class Bad(Callback):
+        def on_trial_result(self, trial, result):
+            raise RuntimeError("boom")
+
+    class Good(_Recorder, Callback):
+        pass
+
+    results, _ = _fit(tmp_path, ray_start_regular,
+                      callbacks=[Bad(), Good(log)])
+    assert not results.errors
+    assert any(e[0] == "result" for e in log)  # good callback still ran
+
+
+class _FakeWandbRun:
+    def __init__(self, owner, kw):
+        self.owner = owner
+        self.kw = kw
+        self.logged = []
+        self.finished = False
+
+    def log(self, metrics, step=None):
+        self.logged.append((dict(metrics), step))
+
+    def finish(self):
+        self.finished = True
+
+
+class _FakeWandb:
+    def __init__(self):
+        self.runs = []
+
+    def init(self, **kw):
+        run = _FakeWandbRun(self, kw)
+        self.runs.append(run)
+        return run
+
+
+def test_wandb_adapter_with_fake_module(ray_start_regular, tmp_path,
+                                        monkeypatch):
+    import types
+
+    fake = _FakeWandb()
+    mod = types.ModuleType("wandb")
+    mod.init = fake.init
+    monkeypatch.setitem(sys.modules, "wandb", mod)
+
+    from ray_tpu.air.integrations import WandbLoggerCallback
+
+    cb = WandbLoggerCallback(project="proj-x", group="g1")
+    _fit(tmp_path, ray_start_regular, callbacks=[cb])
+    assert len(fake.runs) == 2
+    for run in fake.runs:
+        assert run.kw["project"] == "proj-x"
+        assert run.kw["group"] == "g1"
+        assert run.kw["config"]["a"] in (1.0, 2.0)
+        assert run.finished
+        assert [step for _, step in run.logged] == [1, 2, 3]
+        assert run.logged[-1][0]["score"] == run.kw["config"]["a"] * 3
+
+
+def test_wandb_adapter_absent_module_is_noop(ray_start_regular, tmp_path,
+                                             monkeypatch):
+    monkeypatch.setitem(sys.modules, "wandb", None)
+
+    from ray_tpu.air.integrations import WandbLoggerCallback
+
+    results, _ = _fit(tmp_path, ray_start_regular,
+                      callbacks=[WandbLoggerCallback()])
+    assert not results.errors  # sweep unaffected
+
+
+def test_mlflow_adapter_with_fake_module(ray_start_regular, tmp_path,
+                                         monkeypatch):
+    """Fake mirrors the MlflowClient (per-run_id) API — the adapter must
+    address runs by id so concurrent trials can't terminate each other."""
+    import types
+
+    calls = {"params": [], "metrics": [], "terminated": [], "created": []}
+
+    class _Info:
+        def __init__(self, rid):
+            self.run_id = rid
+
+    class _Run:
+        def __init__(self, rid):
+            self.info = _Info(rid)
+
+    class _Client:
+        def __init__(self, tracking_uri=None):
+            pass
+
+        def get_experiment_by_name(self, name):
+            calls["exp"] = name
+            return None
+
+        def create_experiment(self, name):
+            return "exp1"
+
+        def create_run(self, experiment_id, tags=None):
+            rid = f"run{len(calls['created'])}"
+            calls["created"].append((experiment_id, tags))
+            return _Run(rid)
+
+        def log_param(self, run_id, k, v):
+            calls["params"].append((run_id, k, v))
+
+        def log_metric(self, run_id, k, v, step=None):
+            calls["metrics"].append((run_id, k, v, step))
+
+        def set_terminated(self, run_id, status=None):
+            calls["terminated"].append((run_id, status))
+
+    mod = types.ModuleType("mlflow")
+    mod.set_tracking_uri = lambda uri: calls.setdefault("uri", uri)
+    mod.tracking = types.SimpleNamespace(MlflowClient=_Client)
+    monkeypatch.setitem(sys.modules, "mlflow", mod)
+
+    from ray_tpu.air.integrations import MLflowLoggerCallback
+
+    cb = MLflowLoggerCallback(experiment_name="exp-y")
+    results, _ = _fit(tmp_path, ray_start_regular, callbacks=[cb])
+    assert calls["exp"] == "exp-y"
+    assert len(calls["created"]) == 2
+    assert len({rid for rid, _, _ in calls["params"]}) == 2
+    score_logs = [c for c in calls["metrics"] if c[1] == "score"]
+    assert len(score_logs) == 6  # 2 trials x 3 iterations
+    # each run terminated exactly once, by its own id
+    assert sorted(rid for rid, st in calls["terminated"]) == ["run0", "run1"]
+    assert all(st == "FINISHED" for _, st in calls["terminated"])
