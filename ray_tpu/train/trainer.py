@@ -129,14 +129,58 @@ class DataParallelTrainer:
     def fit(self) -> Result:
         failures_left = self.run_config.failure_config.max_failures
         checkpoint = self._resume_checkpoint
+        history: List[Dict[str, Any]] = []
+        # elastic _shrink mutates the scaling config; work on a per-fit
+        # copy so the caller's object (and the next fit) keep the original
+        import dataclasses as _dc
+
+        original_sc = self.scaling_config
+        self.scaling_config = _dc.replace(original_sc)
+        try:
+            return self._fit_loop(failures_left, checkpoint, history)
+        finally:
+            self.scaling_config = original_sc
+
+    def _fit_loop(self, failures_left, checkpoint, history) -> Result:
         while True:
             result = self._fit_once(checkpoint)
+            # the returned Result spans ALL attempts: a recovered run's
+            # pre-failure iterations are part of its history
+            history.extend(result.metrics_history)
             if result.error is None or failures_left == 0:
+                result.metrics_history = history
                 return result
             failures_left -= 1
             checkpoint = result.checkpoint or checkpoint
+            if (self.scaling_config.elastic
+                    and "placement group infeasible" in str(result.error)
+                    and not self._shrink()):
+                result.metrics_history = history
+                return result  # nothing left to shrink to
             logger.warning("training attempt failed (%s); restarting "
                            "(%d retries left)", result.error, failures_left)
+
+    def _shrink(self) -> bool:
+        """Elastic topology shrink after a node/slice loss: halve the worker
+        count first (fewest moving parts), then the per-worker chip grant.
+        Returns False when already at 1 worker x 1 chip."""
+        sc = self.scaling_config
+        if sc.num_workers > 1:
+            sc.num_workers = max(1, sc.num_workers // 2)
+        elif sc.resources_per_worker and sc.resources_per_worker.get("TPU", 0) > 1:
+            sc.resources_per_worker = dict(sc.resources_per_worker)
+            sc.resources_per_worker["TPU"] = max(
+                1.0, sc.resources_per_worker["TPU"] // 2)
+        elif (sc.resources_per_worker is None and sc.use_tpu
+              and sc.chips_per_worker > 1):
+            # (chips_per_worker only reaches worker_resources() when
+            # resources_per_worker is unset)
+            sc.chips_per_worker = max(1, sc.chips_per_worker // 2)
+        else:
+            return False
+        logger.warning("elastic shrink: retrying with num_workers=%d, "
+                       "resources=%s", sc.num_workers, sc.worker_resources())
+        return True
 
     def _fit_once(self, checkpoint: Optional[Checkpoint]) -> Result:
         sc = self.scaling_config

@@ -1,0 +1,128 @@
+"""Elastic recovery end-to-end (SURVEY §7 hard-part 7, VERDICT r04 #6):
+node loss mid-training -> trainer detects the failure -> elastic shrink to
+the surviving topology -> orbax restore onto the SMALLER mesh -> training
+continues from the checkpointed step.
+
+The mesh-reshape restore primitive is unit-tested in test_checkpointing.py;
+this is the system-level loop over an in-process multi-raylet Cluster."""
+
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.cluster import Cluster
+
+
+@pytest.mark.slow
+def test_elastic_recovery_node_loss_mesh_reshape(tmp_path):
+    from ray_tpu.air.config import (FailureConfig, RunConfig, ScalingConfig)
+    from ray_tpu.train import JaxTrainer
+
+    def _train_loop(config):
+        """Tiny-transformer train loop whose mesh is sized by the worker's TPU
+        grant: 8 chips on the doomed node in attempt 1, 2 on the survivor after
+        the elastic shrink. Saves orbax every step; restores on start."""
+        import jax
+
+        import ray_tpu as rt
+        from ray_tpu.air import session
+        from ray_tpu.air.checkpoint import Checkpoint
+        from ray_tpu.models import ModelConfig
+        from ray_tpu.parallel import MeshConfig, make_virtual_mesh
+        from ray_tpu.train import batch_sharding, make_train_step
+        from ray_tpu.train.checkpointing import (abstract_like, restore_sharded,
+                                                 save_sharded)
+        from ray_tpu.train.step import default_optimizer, state_shardings
+
+        ckpt_root = config["ckpt_root"]
+        total_steps = config["total_steps"]
+        granted = len(rt.get_tpu_ids())
+        mesh = make_virtual_mesh(granted, MeshConfig(dp=1, fsdp=granted))
+
+        cfg = ModelConfig.tiny()
+        optimizer = default_optimizer(1e-3)
+        step_fn, init_fn, sh = make_train_step(cfg, mesh, optimizer)
+
+        start_step = 0
+        prev = session.get_checkpoint()
+        if prev is not None:
+            meta = prev.to_dict()
+            start_step = meta["step"]
+            # restore the save-time state onto THIS attempt's (smaller) mesh:
+            # abstract_like carries the new shardings, orbax re-lays the shards
+            state_shape = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+            target = abstract_like(state_shape, sh)
+            state = restore_sharded(meta["orbax_path"], target)
+        else:
+            state = init_fn(jax.random.PRNGKey(0))
+
+        tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                    (max(4, granted), 65), 0, cfg.vocab_size)
+        b_sh = batch_sharding(mesh)
+        batch = {"inputs": jax.device_put(tokens[:, :-1], b_sh["inputs"]),
+                 "targets": jax.device_put(tokens[:, 1:], b_sh["targets"])}
+
+        for step in range(start_step, total_steps):
+            state, metrics = step_fn(state, batch)
+            loss = float(jax.device_get(metrics["loss"]))
+            path = save_sharded(state, os.path.join(ckpt_root, f"step_{step + 1}"))
+            session.report(
+                {"loss": loss, "step": step + 1, "mesh_devices": granted},
+                checkpoint=Checkpoint.from_dict(
+                    {"orbax_path": path, "step": step + 1}))
+            time.sleep(0.15)  # give the chaos thread a window mid-run
+
+    cluster = Cluster()
+    survivor = cluster.add_node(num_cpus=2, resources={"TPU": 2})
+    doomed = cluster.add_node(num_cpus=2, resources={"TPU": 8})
+    cluster.connect()
+    try:
+        ckpt_root = str(tmp_path / "ckpts")
+        os.makedirs(ckpt_root, exist_ok=True)
+
+        def chaos():
+            # wait for proof of progress (>= 3 checkpoints), then kill the
+            # node hosting the 8-chip worker
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                done = [d for d in os.listdir(ckpt_root)
+                        if d.startswith("step_")]
+                if len(done) >= 3:
+                    cluster.remove_node(doomed)
+                    return
+                time.sleep(0.1)
+
+        killer = threading.Thread(target=chaos, daemon=True)
+        killer.start()
+
+        trainer = JaxTrainer(
+            _train_loop,
+            train_loop_config={"ckpt_root": ckpt_root, "total_steps": 12},
+            scaling_config=ScalingConfig(
+                num_workers=1, resources_per_worker={"TPU": 8},
+                elastic=True),
+            run_config=RunConfig(failure_config=FailureConfig(max_failures=4)))
+        result = trainer.fit()
+        killer.join(timeout=5)
+
+        assert result.error is None, result.error
+        hist = result.metrics_history
+        assert hist, "no metrics reported"
+        meshes = [m["mesh_devices"] for m in hist]
+        # attempt 1 ran on the 8-chip grant, the recovery on the 2-chip one
+        assert 8 in meshes and 2 in meshes, meshes
+        # the recovery RESUMED: first post-kill step continues the saved
+        # step counter (never restarts at 1), and the sweep completes
+        reshaped = [m for m in hist if m["mesh_devices"] == 2]
+        assert reshaped[0]["step"] >= 3, reshaped[0]
+        assert hist[-1]["step"] == 12, hist[-1]
+        # loss continuity through the restore: the first reshaped-mesh loss
+        # continues the descent (within noise), not a from-scratch loss
+        pre_kill = [m for m in hist if m["mesh_devices"] == 8][-1]["loss"]
+        post = reshaped[0]["loss"]
+        assert post <= pre_kill + 0.5, (pre_kill, post)
+    finally:
+        cluster.shutdown()
