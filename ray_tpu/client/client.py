@@ -68,7 +68,7 @@ class ClientWorker:
     def shutdown(self) -> None:
         try:
             self._rpc.close()
-        except Exception:
+        except OSError:
             pass
 
     # ------------------------------------------------------------- objects

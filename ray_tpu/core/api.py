@@ -140,7 +140,7 @@ def shutdown() -> None:
         if _worker is not None:
             try:
                 _worker.shutdown()
-            except Exception:
+            except Exception:  # teardown: any half-open link may raise
                 pass
             from ray_tpu.core.worker import set_current_worker
 

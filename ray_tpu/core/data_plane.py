@@ -158,7 +158,7 @@ class DataPlaneServer:
         finally:
             try:
                 conn.close()
-            except Exception:
+            except OSError:
                 pass
 
     def _serve_fetch(self, conn: socket.socket, oid: ObjectID,
@@ -199,8 +199,8 @@ class DataPlaneServer:
             if not ok:
                 try:
                     self._store.delete(oid)
-                except Exception:
-                    pass
+                except KeyError:
+                    pass  # partial create already evicted
         self._store.seal(oid)
         conn.sendall(_REP.pack(OK, 0))  # DONE
         if self._on_pushed is not None:
@@ -213,7 +213,7 @@ class DataPlaneServer:
         self._stopped = True
         try:
             self._sock.close()
-        except Exception:
+        except OSError:
             pass
 
 
@@ -266,7 +266,7 @@ class DataPlaneClient:
     def close(self) -> None:
         try:
             self._sock.close()
-        except Exception:
+        except OSError:
             pass
 
 

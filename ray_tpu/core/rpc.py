@@ -75,7 +75,7 @@ class ServerConnection:
         if self.alive:
             try:
                 self._writer.write(data)
-            except Exception:
+            except (OSError, RuntimeError):  # closed transport/loop
                 self.alive = False
 
 
@@ -140,8 +140,8 @@ class RpcServer:
         finally:
             try:
                 self._loop.run_until_complete(self._loop.shutdown_asyncgens())
-            except Exception:
-                pass
+            except RuntimeError:
+                pass  # loop already stopping
             self._loop.close()
 
     async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
@@ -190,7 +190,7 @@ class RpcServer:
                     logger.exception("on_close callback failed")
             try:
                 writer.close()
-            except Exception:
+            except OSError:
                 pass
 
     def call_soon(self, fn: Callable, *args) -> None:
@@ -212,7 +212,7 @@ class RpcServer:
                     conn.alive = False
                     try:
                         conn._writer.close()
-                    except Exception:
+                    except OSError:
                         pass
                 self._loop.stop()
             try:
@@ -318,11 +318,11 @@ class RpcClient:
         self._closed = True
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
-        except Exception:
-            pass
+        except OSError:
+            pass  # never connected / already reset
         try:
             self._sock.close()
-        except Exception:
+        except OSError:
             pass
 
 

@@ -457,7 +457,7 @@ class CoreWorker:
                 "node_id": self.node_id,
                 "worker_id": self.worker_id.binary(),
             })
-        except Exception:
+        except (OSError, RuntimeError, TimeoutError):
             logger.debug("task event emit failed", exc_info=True)
 
     def _register_returns(self, spec: TaskSpec) -> List[ObjectRef]:
@@ -795,7 +795,7 @@ class CoreWorker:
                 self.peer(ref.owner_address).notify(
                     "add_object_location",
                     {"object_id": ref.id, "raylet": self.raylet_address})
-        except Exception:
+        except (OSError, RuntimeError, TimeoutError):
             logger.debug("copy registration for %s failed", ref.id,
                          exc_info=True)
 
@@ -809,7 +809,7 @@ class CoreWorker:
                 self.peer(ref.owner_address).notify(
                     "object_location_failed",
                     {"object_id": ref.id, "raylet": source})
-        except Exception:
+        except (OSError, RuntimeError, TimeoutError):
             logger.debug("location-failed report for %s lost", ref.id,
                          exc_info=True)
 
@@ -823,7 +823,7 @@ class CoreWorker:
         try:
             return bool(self.peer(ref.owner_address).call(
                 "reconstruct_object", {"object_id": ref.id}, timeout=30))
-        except Exception:
+        except (OSError, RuntimeError, TimeoutError):  # owner gone: unrecoverable via that owner
             return False
 
     def rpc_reconstruct_object(self, conn, req_id, payload):
@@ -1713,7 +1713,7 @@ class CoreWorker:
                 # conn-scoped accounting only honors removes that arrive on
                 # the connection that recorded the add.
                 self.reference_counter.owner_link(owner).notify(method, payload)
-            except Exception:
+            except (OSError, RuntimeError, TimeoutError):
                 logger.debug("%s notify to %s failed", method, owner)
 
     def _ensure_free_sweeper(self) -> None:

@@ -54,21 +54,21 @@ def _update_cluster_gauges() -> None:
         counts = core_api._global_worker().gcs.call("task_counts", timeout=5)
         g["tasks_finished"].set(float(counts["finished"] + counts["failed"]))
         g["tasks_pending"].set(float(counts["pending"]))
-    except Exception:
-        pass
+    except (OSError, RuntimeError, TimeoutError, KeyError):
+        pass  # GCS mid-restart: scrape returns last values
     try:
         worker = core_api._global_worker()
         stats = worker.raylet.call("object_store_stats", timeout=5)
         g["store_used"].set(float(stats.get("used_bytes", 0)))
         g["store_capacity"].set(float(stats.get("capacity_bytes", 0)))
         g["store_spilled"].set(float(stats.get("num_spilled", 0)))
-    except Exception:
-        pass
+    except (OSError, RuntimeError, TimeoutError):
+        pass  # raylet scrape is best-effort
     try:
         from ray_tpu.serve import api as serve_api
 
         serve_api._update_serve_gauges()
-    except Exception:
+    except Exception:  # serve may not be running at all in this cluster
         pass
 
 

@@ -126,11 +126,11 @@ def shutdown_group(group_name: str = "default") -> None:
         return
     try:
         jax.distributed.shutdown()
-    except Exception:
-        pass
+    except (RuntimeError, ValueError):
+        pass  # never initialized / already shut down
     try:
         _kv().call("kv_del", {"namespace": _KV_NS,
                               "key": f"coordinator:{group_name}".encode()})
-    except Exception:
-        pass
+    except (OSError, RuntimeError, TimeoutError):
+        pass  # GCS already down at interpreter exit
     _initialized_group = None

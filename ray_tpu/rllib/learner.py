@@ -51,7 +51,8 @@ def broadcast_weights(weights, handles, method: str = "set_weights"):
     except ValueError:
         pass  # inlined small object: nothing to push, args ship it inline
     except Exception:
-        pass  # push is an optimization; the pull path still works
+        # push is an optimization; the pull path still works
+        logging.getLogger(__name__).debug("weight push failed", exc_info=True)
     return ray_tpu.get([getattr(h, method).remote(ref) for h in handles])
 
 

@@ -56,7 +56,7 @@ def _cfg_eq(a, b) -> bool:
         return True
     try:
         return cloudpickle.dumps(a) == cloudpickle.dumps(b)
-    except Exception:
+    except Exception:  # arbitrary user objects: any pickling error = not equal
         return False
 
 
@@ -181,8 +181,8 @@ class ServeController:
         try:
             blob = _global_worker().gcs.call(
                 "kv_get", {"namespace": "serve", "key": self._KV_KEY}, timeout=5)
-        except Exception:
-            return
+        except (OSError, RuntimeError, TimeoutError):  # GCS unreachable:
+            return  # cold-start without a checkpoint
         if not blob:
             return
         try:
@@ -232,8 +232,9 @@ class ServeController:
 
             _global_worker().publish(SERVE_VERSIONS_CHANNEL,
                                      {"name": name, "version": v})
-        except Exception:
-            pass  # handles fall back to their periodic poll
+        except (OSError, RuntimeError):
+            logger.debug("version push for %s lost", name, exc_info=True)
+            # handles fall back to their periodic poll
 
     # -------------------------------------------------------------- deploy
     def deploy(self, name: str, def_blob: bytes, init_args, init_kwargs,

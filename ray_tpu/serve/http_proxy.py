@@ -156,7 +156,7 @@ class AsyncHTTPProxy:
         finally:
             try:
                 writer.close()
-            except Exception:
+            except OSError:
                 pass
 
     def _parse_target(self, req: dict):
@@ -336,6 +336,6 @@ class AsyncHTTPProxy:
     def stop(self) -> None:
         try:
             self._loop.call_soon_threadsafe(self._loop.stop)
-        except Exception:
-            pass
+        except RuntimeError:
+            pass  # loop already closed
         self._pool.shutdown(wait=False)

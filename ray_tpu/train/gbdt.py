@@ -75,7 +75,7 @@ class _XGBoostBackend:
             if fn is not None:
                 try:
                     fn()
-                except Exception:
+                except Exception:  # third-party tracker teardown: best-effort
                     pass
                 return
 

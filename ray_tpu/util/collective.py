@@ -116,8 +116,8 @@ def destroy_collective_group(group_name: str = "default") -> None:
     if g is not None and g.rank == 0:
         try:
             ray_tpu.kill(g.actor)
-        except Exception:
-            pass
+        except (ValueError, RuntimeError, OSError, TimeoutError):
+            pass  # rendezvous actor / control plane already gone
 
 
 def _collective(value, op: str, group_name: str):

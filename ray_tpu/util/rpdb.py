@@ -38,18 +38,18 @@ class _SocketIO:
     def flush(self):
         try:
             self._wfile.flush()
-        except Exception:
-            pass
+        except OSError:
+            pass  # peer hung up mid-session
 
     def close(self):
         for f in (self._rfile, self._wfile):
             try:
                 f.close()
-            except Exception:
+            except OSError:
                 pass
         try:
             self._conn.close()
-        except Exception:
+        except OSError:
             pass
 
 
@@ -104,7 +104,7 @@ def _register(entry: dict) -> Optional[str]:
         w.gcs.call("kv_put", {"namespace": _KV_NS, "key": key,
                               "value": json.dumps(entry).encode()})
         return key.decode()
-    except Exception:
+    except (OSError, RuntimeError, TimeoutError):  # GCS unreachable
         return None
 
 
@@ -117,8 +117,8 @@ def _unregister(key: Optional[str]) -> None:
         w = current_worker()
         if w is not None:
             w.gcs.call("kv_del", {"namespace": _KV_NS, "key": key.encode()})
-    except Exception:
-        pass
+    except (OSError, RuntimeError, TimeoutError):
+        pass  # breakpoint entry ages out of the KV anyway
 
 
 def set_trace(frame=None) -> None:
@@ -172,8 +172,8 @@ def list_breakpoints(gcs_client) -> List[dict]:
                 continue
             try:
                 out.append(json.loads(bytes(value).decode()))
-            except Exception:
-                continue
+            except (ValueError, UnicodeDecodeError):
+                continue  # stale/corrupt registry entry
     except Exception:
         pass
     return out
@@ -191,8 +191,8 @@ def attach(host: str, port: int) -> None:
                 if not line:
                     break
                 conn.sendall(line.encode())
-        except Exception:
-            pass
+        except (OSError, EOFError, KeyboardInterrupt):
+            pass  # debugger detach closes the socket mid-pipe
 
     t = threading.Thread(target=pump_in, daemon=True)
     t.start()

@@ -59,7 +59,7 @@ def span(name: str, category: str = "task", **args):
         for h in hooks:
             try:
                 h(event)
-            except Exception:
+            except Exception:  # user hook: never let tracing kill the task
                 pass
 
 

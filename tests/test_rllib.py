@@ -258,8 +258,15 @@ def test_rl_samples_per_second_microbench(ray_start_regular, tmp_path):
     with open(out_path, "w") as f:
         json.dump(results, f)
     print("rl microbench:", results)
-    assert all(v > 0 for k, v in results.items()
-               if k.endswith("_samples_per_s"))
+    # Floors vs the r03 recorded numbers (ppo 2068, impala 1676 samples/s on
+    # this box) with 40% headroom — the reference pins per-algorithm
+    # thresholds the same way in rllib/tuned_examples. The floors are
+    # hardware-coupled by nature; RAY_TPU_MICROBENCH_FLOOR_SCALE rescales
+    # (or 0 disables) on boxes unlike the recording one.
+    scale = float(os.environ.get("RAY_TPU_MICROBENCH_FLOOR_SCALE", "1.0"))
+    floors = {"ppo_samples_per_s": 1240.0, "impala_samples_per_s": 1000.0}
+    for key, floor in floors.items():
+        assert results[key] > floor * scale, (key, results[key], floor, scale)
 
 
 def test_ppo_periodic_evaluation(ray_start_regular):
