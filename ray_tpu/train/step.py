@@ -50,6 +50,20 @@ def default_optimizer(learning_rate: float = 3e-4,
     )
 
 
+def fused_adamw_optimizer(learning_rate: float = 3e-4,
+                          weight_decay: float = 0.1,
+                          warmup_steps: int = 100,
+                          total_steps: int = 10000):
+    """default_optimizer's schedule + hyperparams with the fused Pallas
+    AdamW+clip apply (one memory pass over params/grads/moments)."""
+    from ray_tpu.ops.pallas.adamw import FusedAdamW
+
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1))
+    return FusedAdamW(sched, b1=0.9, b2=0.95, weight_decay=weight_decay,
+                      clip_norm=1.0)
+
+
 def state_shardings(cfg: ModelConfig, mesh: Mesh,
                     optimizer: optax.GradientTransformation,
                     rules: AxisRules = DEFAULT_RULES) -> TrainState:
@@ -110,20 +124,31 @@ def make_init_fn(cfg: ModelConfig, mesh: Mesh,
 
 
 def make_train_step(cfg: ModelConfig, mesh: Mesh,
-                    optimizer: Optional[optax.GradientTransformation] = None,
+                    optimizer: Optional[Any] = None,
                     rules: AxisRules = DEFAULT_RULES,
                     donate: bool = True):
     """Returns (step_fn, init_fn, shardings). step_fn(state, batch) ->
-    (state, metrics); fully compiled, parameters donated."""
+    (state, metrics); fully compiled, parameters donated.
+
+    `optimizer` is an optax GradientTransformation, or a fused-apply
+    optimizer (`ops.pallas.adamw.FusedAdamW`-style: `.apply(grads, state,
+    params) -> (new_params, new_state)`) that updates params in one memory
+    pass instead of returning deltas."""
     optimizer = optimizer or default_optimizer()
+    fused = hasattr(optimizer, "apply")
     sh = state_shardings(cfg, mesh, optimizer, rules)
     b_sh = batch_sharding(mesh)
 
     def step(state: TrainState, batch: Dict[str, jax.Array]):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, batch, cfg, mesh)
-        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if fused:
+            new_params, new_opt = optimizer.apply(grads, state.opt_state,
+                                                  state.params)
+        else:
+            updates, new_opt = optimizer.update(grads, state.opt_state,
+                                                state.params)
+            new_params = optax.apply_updates(state.params, updates)
         metrics = {
             "loss": loss,
             "grad_norm": optax.global_norm(grads),

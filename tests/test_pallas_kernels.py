@@ -386,3 +386,39 @@ def test_fused_attn_requires_fused_ffn():
     params = init_params(jax.random.PRNGKey(0), cfg)
     with pytest.raises(ValueError, match="requires fused_ffn"):
         loss_fn(params, {"tokens": jnp.zeros((1, 9), jnp.int32)}, cfg)
+
+
+def test_fused_adamw_matches_optax_chain():
+    """FusedAdamW (Pallas one-pass update; jnp fallback on CPU) must match
+    optax.chain(clip_by_global_norm, adamw) step for step."""
+    import optax
+
+    from ray_tpu.ops.pallas.adamw import FusedAdamW
+
+    lr = 3e-3
+    params = {
+        "w": jax.random.normal(jax.random.PRNGKey(0), (64, 128), jnp.float32),
+        "b": jax.random.normal(jax.random.PRNGKey(1), (5,), jnp.float32),
+    }
+    ref_opt = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1,
+                    mu_dtype=jnp.float32))
+    fused = FusedAdamW(lr, b1=0.9, b2=0.95, weight_decay=0.1, clip_norm=1.0)
+
+    ref_state = ref_opt.init(params)
+    f_state = fused.init(params)
+    ref_params = params
+    f_params = params
+    for i in range(3):
+        grads = jax.tree_util.tree_map(
+            lambda p: jax.random.normal(jax.random.PRNGKey(10 + i), p.shape)
+            * (3.0 if i == 0 else 0.1),  # step 0 exercises real clipping
+            ref_params)
+        updates, ref_state = ref_opt.update(grads, ref_state, ref_params)
+        ref_params = optax.apply_updates(ref_params, updates)
+        f_params, f_state = fused.apply(grads, f_state, f_params)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-5,
+                                                    atol=2e-6),
+            ref_params, f_params)
