@@ -1,0 +1,60 @@
+"""Bounded-scan scheduler regressions (r05 envelope findings): deep queues
+must not starve dispatchable work, and actor bursts must keep spawning
+workers past the startup-concurrency budget."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_dispatchable_task_behind_blocked_queue(ray_start_regular):
+    """A CPU task queued behind hundreds of infeasible (TPU-demanding, no
+    TPU capacity left) tickets must still run: the bounded _schedule scan
+    rotates blocked heads behind the tail instead of re-examining the same
+    256 forever."""
+
+    @ray_tpu.remote
+    class Holder:
+        def ok(self):
+            return True
+
+    @ray_tpu.remote
+    def blocked():
+        return "never"
+
+    @ray_tpu.remote
+    def runnable():
+        return "ran"
+
+    # an actor holds 7.5 of the node's 8 TPU for its lifetime, so 300
+    # tickets demanding 7.5 are permanently blocked but feasible-looking
+    holder = Holder.options(num_cpus=0, resources={"TPU": 7.5}).remote()
+    assert ray_tpu.get(holder.ok.remote(), timeout=60)
+    blocked_refs = [
+        blocked.options(resources={"TPU": 7.5}).remote() for _ in range(300)
+    ]
+    ref = runnable.remote()
+    assert ray_tpu.get(ref, timeout=60) == "ran"
+    del blocked_refs
+    ray_tpu.kill(holder)
+
+
+def test_actor_burst_exceeds_startup_concurrency(ray_start_regular):
+    """A burst of actors larger than maximum_startup_concurrency (8) must
+    all come up: worker registration re-arms the spawn pipeline."""
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            import os
+
+            return os.getpid()
+
+    n = 24
+    actors = [A.options(num_cpus=0).remote() for _ in range(n)]
+    pids = ray_tpu.get([a.ping.remote() for a in actors], timeout=240)
+    assert len(set(pids)) == n
+    for a in actors:
+        ray_tpu.kill(a)
