@@ -192,3 +192,116 @@ class GceTpuNodeProvider(NodeProvider):
                     out.append(node["name"].rsplit("/", 1)[-1])
             page = resp.get("nextPageToken") or None
         return out
+
+
+class KubernetesTpuNodeProvider(NodeProvider):
+    """GKE analog of the reference's kuberay provider
+    (`python/ray/autoscaler/_private/kuberay/`): elastic worker capacity as
+    Kubernetes Pods with `google.com/tpu` resource requests.
+
+    Where kuberay drives a CRD reconciled by an operator, this provider
+    creates worker Pods directly against the Kubernetes API — operator-free
+    by design (the control loop is ray_tpu's own autoscaler; an external
+    reconciler would fight it). In-cluster auth: bearer token + CA from the
+    mounted service account. The HTTP transport is injectable (`request_fn`)
+    so the control logic unit-tests without a cluster.
+    """
+
+    _SA = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+    def __init__(self, namespace: str, gcs_address: str, *,
+                 image: str = "python:3.12-slim",
+                 tpu_resource: str = "google.com/tpu",
+                 node_selector: Optional[Dict[str, str]] = None,
+                 name_prefix: str = "ray-tpu-worker",
+                 api_server: str = "https://kubernetes.default.svc",
+                 request_fn=None):
+        self.namespace = namespace
+        self.gcs_address = gcs_address
+        self.image = image
+        self.tpu_resource = tpu_resource
+        self.node_selector = dict(node_selector or {})
+        self.name_prefix = name_prefix
+        self.api_server = api_server
+        self._request = request_fn or self._http_request
+
+    # ------------------------------------------------------------ transport
+    def _token(self) -> str:
+        with open(f"{self._SA}/token") as f:
+            return f.read().strip()
+
+    def _http_request(self, method: str, url: str,
+                      body: Optional[dict] = None,
+                      headers: Optional[Dict[str, str]] = None) -> dict:
+        import json
+        import ssl
+        import urllib.request
+
+        ctx = ssl.create_default_context(cafile=f"{self._SA}/ca.crt")
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers=dict(headers or {}))
+        req.add_header("Authorization", f"Bearer {self._token()}")
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=60, context=ctx) as resp:
+            payload = resp.read()
+        return json.loads(payload) if payload else {}
+
+    def _pods_url(self, suffix: str = "") -> str:
+        return (f"{self.api_server}/api/v1/namespaces/{self.namespace}"
+                f"/pods{suffix}")
+
+    # ------------------------------------------------------------- provider
+    def pod_manifest(self, node_type: str, resources: Dict[str, float],
+                     labels: Dict[str, str]) -> dict:
+        """Pure manifest assembly (unit-tested without a cluster, the
+        container-runtime-env pattern)."""
+        chips = int(resources.get("TPU", 4))
+        name = f"{self.name_prefix}-{uuid.uuid4().hex[:8]}"
+        cmd = (f"python -m ray_tpu start --address={self.gcs_address} "
+               f"--resources '{{\"TPU\": {chips}}}'")
+        manifest = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "labels": {**{k: str(v) for k, v in labels.items()},
+                           "ray-tpu-cluster": "1",
+                           "ray-tpu-type": node_type},
+            },
+            "spec": {
+                "restartPolicy": "Never",
+                "containers": [{
+                    "name": "worker",
+                    "image": self.image,
+                    "command": ["/bin/sh", "-c", cmd],
+                    "resources": {
+                        "limits": {self.tpu_resource: str(chips)},
+                        "requests": {self.tpu_resource: str(chips)},
+                    },
+                }],
+            },
+        }
+        if self.node_selector:
+            manifest["spec"]["nodeSelector"] = dict(self.node_selector)
+        return manifest
+
+    def create_node(self, node_type: str, resources: Dict[str, float],
+                    labels: Dict[str, str]) -> str:
+        manifest = self.pod_manifest(node_type, resources, labels)
+        self._request("POST", self._pods_url(), manifest)
+        return manifest["metadata"]["name"]
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        self._request("DELETE", self._pods_url(f"/{provider_node_id}"))
+
+    def non_terminated_nodes(self) -> List[str]:
+        resp = self._request(
+            "GET", self._pods_url("?labelSelector=ray-tpu-cluster%3D1"))
+        out: List[str] = []
+        for item in resp.get("items", []):
+            phase = item.get("status", {}).get("phase", "")
+            if phase in ("Pending", "Running"):
+                out.append(item["metadata"]["name"])
+        return out
