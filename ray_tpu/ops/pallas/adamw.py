@@ -27,14 +27,21 @@ from ray_tpu.ops.pallas._util import interpret_mode
 
 _LANES = 128
 _ROWS = 512  # rows per grid block: (512, 128) f32 blocks, ~0.75 MB x 7 bufs
+# How many of the largest leaves take the Pallas path (the rest use the jnp
+# fallback). The axon tunnel's AOT helper has crashed on full-step programs
+# with many optimizer custom calls; this caps the count while covering the
+# bulk of the bytes (the 8 stacked layer leaves are ~90% of a 1B model).
+PALLAS_LEAVES = 16
 
 
 def _adamw_kernel(scal_ref, p_ref, g_ref, mu_ref, nu_ref,
                   po_ref, muo_ref, nuo_ref, *, b1, b2, eps, wd):
-    lr = scal_ref[0]
-    clip = scal_ref[1]
-    c1 = scal_ref[2]          # 1 - b1^t
-    c2 = scal_ref[3]          # 1 - b2^t
+    # scalars ride a (1, 4) SMEM ref: 2-D scalar blocks are the layout
+    # Mosaic's SMEM path expects
+    lr = scal_ref[0, 0]
+    clip = scal_ref[0, 1]
+    c1 = scal_ref[0, 2]       # 1 - b1^t
+    c2 = scal_ref[0, 3]       # 1 - b2^t
     g = g_ref[:].astype(jnp.float32) * clip
     mu = b1 * mu_ref[:] + (1.0 - b1) * g
     nu = b2 * nu_ref[:] + (1.0 - b2) * g * g
@@ -45,9 +52,10 @@ def _adamw_kernel(scal_ref, p_ref, g_ref, mu_ref, nu_ref,
     nuo_ref[:] = nu
 
 
-def _leaf_update(p, g, mu, nu, scalars, *, b1, b2, eps, wd):
+def _leaf_update(p, g, mu, nu, scalars, *, b1, b2, eps, wd,
+                 use_pallas=True):
     n = p.size
-    if n % (8 * _LANES) == 0 and not interpret_mode():
+    if use_pallas and n % (8 * _LANES) == 0 and not interpret_mode():
         rows = n // _LANES
         br = min(_ROWS, rows)
         if rows % br:
@@ -76,7 +84,8 @@ def _leaf_update(p, g, mu, nu, scalars, *, b1, b2, eps, wd):
         return (po.reshape(p.shape), muo.reshape(p.shape),
                 nuo.reshape(p.shape))
     # jnp fallback: same math (odd-shaped leaves, CPU tests)
-    lr, clip, c1, c2 = scalars[0], scalars[1], scalars[2], scalars[3]
+    lr, clip, c1, c2 = (scalars[0, 0], scalars[0, 1], scalars[0, 2],
+                        scalars[0, 3])
     gf = g.astype(jnp.float32) * clip
     mu2 = b1 * mu + (1.0 - b1) * gf
     nu2 = b2 * nu + (1.0 - b2) * gf * gf
@@ -126,16 +135,20 @@ class FusedAdamW:
             clip.astype(jnp.float32),
             1.0 - self.b1 ** t,
             1.0 - self.b2 ** t,
-        ])
+        ]).reshape(1, 4)
         leaves_p, tdef = jax.tree_util.tree_flatten(params)
         leaves_g = tdef.flatten_up_to(grads)
         leaves_mu = tdef.flatten_up_to(state.mu)
         leaves_nu = tdef.flatten_up_to(state.nu)
+        big = set(sorted(range(len(leaves_p)),
+                         key=lambda i: leaves_p[i].size,
+                         reverse=True)[:PALLAS_LEAVES])
         out_p, out_mu, out_nu = [], [], []
-        for p, g, mu, nu in zip(leaves_p, leaves_g, leaves_mu, leaves_nu):
+        for i, (p, g, mu, nu) in enumerate(
+                zip(leaves_p, leaves_g, leaves_mu, leaves_nu)):
             po, muo, nuo = _leaf_update(
                 p, g, mu, nu, scalars, b1=self.b1, b2=self.b2, eps=self.eps,
-                wd=self.weight_decay)
+                wd=self.weight_decay, use_pallas=i in big)
             out_p.append(po)
             out_mu.append(muo)
             out_nu.append(nuo)
