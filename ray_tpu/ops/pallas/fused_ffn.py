@@ -48,8 +48,9 @@ _BK = 512
 # identical XLA fallback in _vjp_bwd, so step-time attribution is a flag
 # flip + re-jit. Measured on v5e at b1 shapes (batch 2 x 2048, d=2048,
 # dff=8192), step time vs the all-XLA custom backward's 243.2 ms:
-#   K1 pallas +16.0 ms, K2 pallas +8.9 ms (operand-panel re-reads across
-#   the untiled grid axis cost more than the fused elementwise saves),
+#   K1 pallas +16.0 ms at 512^3 tiles and +18.6 ms with full-d N blocks
+#   (the retile removed the gate/up panel re-reads but multiplied the dy
+#   panel re-reads; both lose to XLA), K2 pallas +8.9 ms,
 #   K3 pallas -6.3 ms (the h-recompute prologue + two dots sharing one
 #   operand panel beat XLA's materialize-then-matmul).
 # Defaults = the measured winners. NOTE the custom_vjp itself is the main
@@ -73,9 +74,9 @@ def _dsilu(x):
 
 
 def _dw_down_kernel(gate_ref, up_ref, dy_ref, out_ref, acc_ref):
-    """out[dff, d] += swiglu(gate, up)[t, dff]^T @ dy[t, d]; grid (i, j, k),
-    k (= token blocks) innermost."""
-    k = pl.program_id(2)
+    """out[dff, d] += swiglu(gate, up)[t, dff]^T @ dy[t, d]; grid (i, k),
+    k (= token blocks) innermost, full-d output rows per block."""
+    k = pl.program_id(1)
 
     @pl.when(k == 0)
     def _():
@@ -88,7 +89,7 @@ def _dw_down_kernel(gate_ref, up_ref, dy_ref, out_ref, acc_ref):
         s, dy_ref[:], (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)           # [bm, bn]
 
-    @pl.when(k == pl.num_programs(2) - 1)
+    @pl.when(k == pl.num_programs(1) - 1)
     def _():
         out_ref[:] = acc_ref[:].astype(out_ref.dtype)
 
@@ -187,7 +188,9 @@ def _vjp_bwd(eps, res, dy):
         raise ValueError(f"fused_ffn: shapes ({T}, {d}, {dff}) must tile by "
                          f"({bk}, {bn}, {bm})")
 
-    # K1: dW_down [dff, d]
+    # K1: dW_down [dff, d]. Full-d N blocks: the gate/up operand panels
+    # are fetched exactly once (the 512x512x512 variant re-read them per
+    # N block — +16 ms; this layout's only repeat is dy, dff/bm x 16 MB).
     if not USE_K1:
         s_act = (_silu(gate.astype(jnp.float32))
                  * up.astype(jnp.float32)).astype(gate.dtype)
@@ -195,18 +198,19 @@ def _vjp_bwd(eps, res, dy):
             s_act, dy2d, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32).astype(wd.dtype)
     else:
+      bm1, bk1 = min(256, dff), min(_BK, T)
       dwd = pl.pallas_call(
         _dw_down_kernel,
-        grid=(dff // bm, d // bn, T // bk),
+        grid=(dff // bm1, T // bk1),
         in_specs=[
-            pl.BlockSpec((bk, bm), lambda i, j, k: (k, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((bk, bm), lambda i, j, k: (k, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bk1, bm1), lambda i, k: (k, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bk1, bm1), lambda i, k: (k, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bk1, d), lambda i, k: (k, 0), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j),
+        out_specs=pl.BlockSpec((bm1, d), lambda i, k: (i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((dff, d), wd.dtype),
-        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bm1, d), jnp.float32)],
         interpret=interp,
       )(gate, up, dy2d)
 
