@@ -450,3 +450,41 @@ def test_fused_blocks_on_sharded_mesh():
         state, m = step_fn(state, b)
         losses[name] = float(jax.device_get(m["loss"]))
     np.testing.assert_allclose(losses["fused"], losses["stock"], rtol=1e-5)
+
+
+@pytest.mark.parametrize("flags", [(True, False, True), (False, True, True),
+                                   (True, True, False)])
+def test_fused_ffn_flag_variants_match_reference(flags):
+    """The non-default kernel variants (USE_K1/K2/K3 combinations kept
+    behind flags after losing the v5e A/B) must stay numerics-correct so
+    re-measuring on other hardware is a flag flip away."""
+    import ray_tpu.ops.pallas.fused_ffn as F
+
+    def ref_block(x, nw, wg, wu, wd, eps=1e-5):
+        xf = x.astype(jnp.float32)
+        rstd = jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
+        h = (xf * rstd * nw.astype(jnp.float32)).astype(x.dtype)
+        gate, up = h @ wg, h @ wu
+        s = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+        return x + (s @ wd).astype(x.dtype)
+
+    T, d, dff = 512, 256, 512
+    ks = jax.random.split(jax.random.PRNGKey(3), 5)
+    x = jax.random.normal(ks[0], (1, T, d), jnp.float32)
+    nw = 1 + 0.1 * jax.random.normal(ks[1], (d,), jnp.float32)
+    wg = jax.random.normal(ks[2], (d, dff), jnp.float32) * d ** -0.5
+    wu = jax.random.normal(ks[3], (d, dff), jnp.float32) * d ** -0.5
+    wd = jax.random.normal(ks[4], (dff, d), jnp.float32) * dff ** -0.5
+
+    old = (F.USE_K1, F.USE_K2, F.USE_K3)
+    F.USE_K1, F.USE_K2, F.USE_K3 = flags
+    try:
+        gp = jax.grad(lambda *a: jnp.sum(F.ffn_block(*a) ** 2),
+                      argnums=(0, 1, 2, 3, 4))(x, nw, wg, wu, wd)
+        gr = jax.grad(lambda *a: jnp.sum(ref_block(*a) ** 2),
+                      argnums=(0, 1, 2, 3, 4))(x, nw, wg, wu, wd)
+        for name, a, b in zip(["dx", "dnw", "dwg", "dwu", "dwd"], gp, gr):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4,
+                                       err_msg=f"{flags} {name}")
+    finally:
+        F.USE_K1, F.USE_K2, F.USE_K3 = old
