@@ -34,6 +34,9 @@ run_native() {
 run_fast() {
   echo "=== [2/3] fast test tier ==="
   python -m pytest tests/ -q
+  # core-primitives smoke: the submission hot path (function table, event
+  # batching, put/get) must run end to end on CPU every CI pass
+  JAX_PLATFORMS=cpu python -m ray_tpu.microbenchmark --quick --json
 }
 
 run_stress() {

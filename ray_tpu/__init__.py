@@ -53,6 +53,7 @@ from ray_tpu.core.exceptions import (
     WorkerCrashedError,
     ObjectLostError,
     GetTimeoutError,
+    PlacementInfeasibleError,
 )
 
 __all__ = [
@@ -86,6 +87,7 @@ __all__ = [
     "WorkerCrashedError",
     "ObjectLostError",
     "GetTimeoutError",
+    "PlacementInfeasibleError",
 ]
 
 __all__.append("util")

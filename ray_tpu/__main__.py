@@ -251,7 +251,7 @@ def main(argv=None) -> int:
     if args.cmd == "microbenchmark":
         from ray_tpu.microbenchmark import main as micro_main
 
-        return micro_main()
+        return micro_main([])
 
     if args.cmd == "envelope":
         from ray_tpu.envelope import main as env_main

@@ -43,12 +43,18 @@ class WandbLoggerCallback(Callback):
     def on_trial_start(self, trial) -> None:
         if self._wandb is None or trial.trial_id in self._runs:
             return
+        kw = dict(project=self._project, group=self._group,
+                  name=trial.trial_id, config=_scrub(dict(trial.config)),
+                  **self._init_kwargs)
         # reinit="create_new": concurrent trials need independent run
-        # handles (reinit=True would finish the previous trial's run)
-        self._runs[trial.trial_id] = self._wandb.init(
-            project=self._project, group=self._group, name=trial.trial_id,
-            config=_scrub(dict(trial.config)), reinit="create_new",
-            **self._init_kwargs)
+        # handles (reinit=True would finish the previous trial's run).
+        # Older wandb versions reject the string value — fall back to
+        # reinit=True rather than silently disabling tracking.
+        try:
+            run = self._wandb.init(reinit="create_new", **kw)
+        except (TypeError, ValueError):
+            run = self._wandb.init(reinit=True, **kw)
+        self._runs[trial.trial_id] = run
 
     def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
         run = self._runs.get(trial.trial_id)

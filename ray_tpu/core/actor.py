@@ -12,8 +12,6 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, Optional
 
-import cloudpickle
-
 from ray_tpu.core.ids import ActorID
 from ray_tpu.core.task_spec import ActorCreationSpec, SchedulingStrategy
 
@@ -107,6 +105,18 @@ class ActorClass:
                 scheduling.placement_group_id = pg.id
                 scheduling.bundle_index = o.get("placement_group_bundle_index", -1)
 
+        # export-once class pickle (same fast lane as task functions):
+        # repeated .remote() of one ActorClass ships a 16-byte id, and the
+        # hosting worker resolves it through its deserialized-class LRU.
+        # Client-mode workers have no function table — ship the blob and
+        # let the server-side driver's spec pass through unchanged.
+        ft = getattr(w, "function_table", None)
+        if ft is not None:
+            class_fn_id, class_blob = ft.export(self._cls)
+        else:
+            import cloudpickle
+
+            class_fn_id, class_blob = None, cloudpickle.dumps(self._cls)
         spec = ActorCreationSpec(
             actor_id=ActorID.from_random(),
             name=o.get("name"),
@@ -116,7 +126,8 @@ class ActorClass:
             max_concurrency=o.get("max_concurrency", 1),
             lifetime=o.get("lifetime", "non_detached"),
             concurrency_groups=o.get("concurrency_groups"),
-            class_blob=cloudpickle.dumps(self._cls),
+            class_blob=class_blob,
+            class_fn_id=class_fn_id,
             init_args=w._serialize_args(args),
             init_kwargs_blob=serialization.dumps(kwargs) if kwargs else None,
             resources=resources,

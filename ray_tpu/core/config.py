@@ -100,6 +100,28 @@ class Config:
     # beyond it queue rather than overcommitting store memory
     pull_admission_max_bytes: int = 2 * 1024 * 1024 * 1024
 
+    # --- task-path fast lanes ---
+    # Export-once function table (cf. reference function_manager.py): the
+    # submitter pickles a callable once, exports the blob to the GCS keyed
+    # by its content hash, and every TaskSpec carries only the FunctionID.
+    # Disabled -> every spec ships the full pickle (the fallback wire
+    # format, kept for anonymous one-shot callables).
+    function_table_enabled: bool = True
+    # executor-side LRU of DESERIALIZED functions/classes per process
+    function_cache_max_entries: int = 256
+    # GCS-side table byte budget: beyond it the OLDEST exports evict (with
+    # a warning — a task whose function was evicted fails its fetch). Keeps
+    # a driver minting unbounded distinct closures from growing the GCS and
+    # its snapshot forever.
+    function_table_max_bytes: int = 1024 * 1024 * 1024
+    # Worker-side TaskEventBuffer (cf. reference task_event_buffer.h,
+    # task_events_report_interval_ms): task-state transitions and tracing
+    # spans coalesce in-process and flush to the GCS on this timer (and at
+    # shutdown) instead of one notify per transition.
+    task_events_report_interval_ms: int = 200
+    # bounded buffer: oldest events drop (counted) beyond this
+    task_events_max_buffer_size: int = 10000
+
     # --- rpc ---
     rpc_connect_timeout_s: float = 30.0
     rpc_call_timeout_s: float = 0.0  # 0 = no timeout

@@ -75,3 +75,10 @@ class RuntimeEnvSetupError(RayTpuError):
 
 class PendingCallsLimitExceeded(RayTpuError):
     """Back-pressure: too many in-flight calls to an actor."""
+
+
+class PlacementInfeasibleError(RayTpuError):
+    """A placement group's bundles cannot be satisfied by the current
+    cluster. Raised at the reservation source and matched BY TYPE (elastic
+    shrink in train/trainer.py keys on it); matching the message string
+    would let a reword silently disable elastic recovery."""

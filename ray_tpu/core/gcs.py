@@ -66,6 +66,15 @@ class GcsServer:
         # kv: namespace -> key -> value
         self._kv: Dict[str, Dict[bytes, Any]] = {}
 
+        # function table: content-addressed export-once function/class
+        # pickles (reference function_manager.py export path). Durable via
+        # the snapshot: actor restart-on-failure resolves class blobs here.
+        # Insertion-ordered for FIFO eviction at function_table_max_bytes.
+        self._functions: Dict[bytes, bytes] = {}
+        self._function_bytes = 0
+        self._function_puts = 0  # put RPCs since boot (export-once proof)
+        self._function_evictions = 0
+
         # recent worker log lines for `ray_tpu logs`
         from collections import deque
 
@@ -128,6 +137,9 @@ class GcsServer:
                 data = pickle.load(f)
             with self._lock:
                 self._kv = data.get("kv", {})
+                self._functions = data.get("functions", {})
+                self._function_bytes = sum(
+                    len(b) for b in self._functions.values())
                 for jid, job in data.get("jobs", {}).items():
                     job = dict(job)
                     if job.get("status") == "RUNNING":
@@ -168,6 +180,9 @@ class GcsServer:
         with self._snapshot_write_lock:  # stop() vs loop: one writer at a time
             with self._lock:
                 data = {"kv": {ns: dict(t) for ns, t in self._kv.items()},
+                        # function table: actor restart after a GCS restart
+                        # resolves class blobs from here
+                        "functions": dict(self._functions),
                         "jobs": dict(self._jobs),
                         # durable actor metadata: restart budgets, names and
                         # owners survive a GCS restart (reference persists the
@@ -471,6 +486,48 @@ class GcsServer:
         with self._lock:
             return payload["key"] in self._kv.get(ns, {})
 
+    # ------------------------------------------------------- function table
+    def rpc_function_put(self, conn, req_id, payload):
+        """Export-once function/class blob, keyed by content hash
+        (reference function_manager.py export to GCS). Idempotent: the same
+        id always maps to the same bytes, so a duplicate put (replay after
+        a GCS restart, two submitters racing) is a no-op."""
+        with self._lock:
+            self._function_puts += 1
+            if payload["function_id"] not in self._functions:
+                self._functions[payload["function_id"]] = payload["blob"]
+                self._function_bytes += len(payload["blob"])
+                self._dirty = True
+                # Byte-budget FIFO eviction: a driver minting unbounded
+                # DISTINCT closures (new lambda per batch) must not grow
+                # the table and its snapshot forever. An evicted function
+                # fails its executor fetch — loudly, and only in that
+                # pathological pattern (steady workloads re-use ids).
+                budget = get_config().function_table_max_bytes
+                while self._function_bytes > budget and len(self._functions) > 1:
+                    old_id = next(iter(self._functions))
+                    self._function_bytes -= len(self._functions.pop(old_id))
+                    self._function_evictions += 1
+                    logger.warning(
+                        "function table over %d bytes; evicted oldest "
+                        "export %s (%d evictions total) — raise "
+                        "RAY_TPU_FUNCTION_TABLE_MAX_BYTES or stop "
+                        "creating distinct closures per submission",
+                        budget, old_id.hex()[:12], self._function_evictions)
+        return True
+
+    def rpc_function_get(self, conn, req_id, payload):
+        """Executor miss path: fetch a blob for local deserialization."""
+        with self._lock:
+            return self._functions.get(payload["function_id"])
+
+    def rpc_function_table_stats(self, conn, req_id, payload):
+        with self._lock:
+            return {"entries": len(self._functions),
+                    "bytes": self._function_bytes,
+                    "puts": self._function_puts,
+                    "evictions": self._function_evictions}
+
     # ---------------------------------------------------------------- jobs
     def rpc_register_job(self, conn, req_id, payload):
         with self._lock:
@@ -497,37 +554,69 @@ class GcsServer:
             return list(self._jobs.values())
 
     # ------------------------------------------------------------ task events
-    def rpc_task_event(self, conn, req_id, payload):
-        """Best-effort task lifecycle records (notify; no reply needed)."""
+    def _ingest_task_event(self, payload) -> None:
+        """Caller holds self._lock. One task lifecycle record into the ring."""
         key = payload["task_id"]
+        e = self._task_events.get(key)
+        if e is None:
+            if len(self._task_events_order) >= self._max_task_events:
+                old = self._task_events_order.pop(0)
+                self._task_events.pop(old, None)
+                # surfaced by list_task_events so `ray_tpu list tasks`
+                # can SAY history was truncated instead of silently
+                # showing a complete-looking window
+                self._task_events_dropped += 1
+            e = {"task_id": key}
+            self._task_events[key] = e
+            self._task_events_order.append(key)
+        state = payload.get("state")
+        # Count each task's SUBMITTED once per live entry. Batched buffers
+        # mean a worker's RUNNING can now land before the driver's
+        # SUBMITTED, so the count keys on a per-entry flag rather than on
+        # entry creation; a terminal event recreating an evicted entry
+        # (>10k tasks in flight) still can't inflate the running total, or
+        # the derived pending count (submitted - finished - failed) would
+        # drift upward forever.
+        if state == "SUBMITTED" and not e.get("_counted_submitted"):
+            e["_counted_submitted"] = True
+            self._task_counts["submitted"] += 1
+        if e.get("_terminal") and state not in ("FINISHED", "FAILED"):
+            # A non-terminal event arriving AFTER the terminal one (e.g.
+            # the driver's buffered SUBMITTED flushing behind the worker's
+            # FINISHED) is recorded in the history but must not regress the
+            # displayed state — no further event would ever repair it.
+            e.setdefault("events", []).append((state or "?", time.time()))
+            return
+        e.update({k: v for k, v in payload.items() if k != "task_id"})
+        e.setdefault("events", []).append((state or "?", time.time()))
+        # running totals survive the event-window eviction above (the
+        # dashboard's _total series must not saturate at the window)
+        if state in ("FINISHED", "FAILED") and not e.get("_terminal"):
+            e["_terminal"] = True
+            self._task_counts[state.lower()] += 1
+
+    def rpc_task_event(self, conn, req_id, payload):
+        """Best-effort single task lifecycle record (legacy per-event wire
+        format; in-tree emitters batch via task_events_batch)."""
         with self._lock:
-            e = self._task_events.get(key)
-            if e is None:
-                if len(self._task_events_order) >= self._max_task_events:
-                    old = self._task_events_order.pop(0)
-                    self._task_events.pop(old, None)
-                    # surfaced by list_task_events so `ray_tpu list tasks`
-                    # can SAY history was truncated instead of silently
-                    # showing a complete-looking window
-                    self._task_events_dropped += 1
-                e = {"task_id": key}
-                self._task_events[key] = e
-                self._task_events_order.append(key)
-                # Only the initial SUBMITTED event counts; a terminal event
-                # recreating an evicted entry (>10k tasks in flight) must not
-                # inflate the running submitted total, or the derived pending
-                # count (submitted - finished - failed) drifts upward forever.
-                if payload.get("state") == "SUBMITTED":
-                    self._task_counts["submitted"] += 1
-            e.update({k: v for k, v in payload.items() if k != "task_id"})
-            e.setdefault("events", []).append(
-                (payload.get("state", "?"), time.time()))
-            # running totals survive the event-window eviction above (the
-            # dashboard's _total series must not saturate at the window)
-            state = payload.get("state")
-            if state in ("FINISHED", "FAILED") and not e.get("_terminal"):
-                e["_terminal"] = True
-                self._task_counts[state.lower()] += 1
+            self._ingest_task_event(payload)
+        return True
+
+    def rpc_task_events_batch(self, conn, req_id, payload):
+        """One worker-side TaskEventBuffer flush (reference
+        TaskEventBuffer -> GcsTaskManager): a batch of task-state
+        transitions, the emitter's dropped-event count, and any tracing
+        spans recorded since its last flush — one notify per interval per
+        process instead of one per transition."""
+        with self._lock:
+            for ev in payload.get("events", ()):
+                self._ingest_task_event(ev)
+            # events the WORKER dropped (its bounded buffer overflowed) are
+            # history lost forever, same class as our ring eviction
+            self._task_events_dropped += int(payload.get("dropped", 0))
+            profile = payload.get("profile_events")
+            if profile:
+                self._append_profile_events(profile)
         return True
 
     def rpc_list_task_events(self, conn, req_id, payload):
@@ -536,7 +625,10 @@ class GcsServer:
             return []
         with self._lock:
             keys = self._task_events_order[-limit:]
-            out = [dict(self._task_events[k]) for k in keys]
+            # underscore keys (_terminal, _counted_submitted) are GCS
+            # bookkeeping, not part of the listing surface
+            out = [{f: v for f, v in self._task_events[k].items()
+                    if not f.startswith("_")} for k in keys]
             dropped = self._task_events_dropped
         if dropped:
             # sideband metadata row: EVICTED history is gone forever —
@@ -548,13 +640,19 @@ class GcsServer:
             out.append({"__truncated__": dropped})
         return out
 
+    def _append_profile_events(self, events) -> None:
+        """Caller holds self._lock. Capped ring so the GCS can't grow
+        unboundedly."""
+        self._profile_events.extend(events)
+        if len(self._profile_events) > 100_000:
+            self._profile_events = self._profile_events[-100_000:]
+
     def rpc_profile_events(self, conn, req_id, payload):
         """Chrome-trace spans shipped by workers (reference ProfileEvent
-        buffer); capped ring so the GCS can't grow unboundedly."""
+        buffer; legacy per-flush wire format — in-tree emitters batch via
+        task_events_batch)."""
         with self._lock:
-            self._profile_events.extend(payload.get("events", []))
-            if len(self._profile_events) > 100_000:
-                self._profile_events = self._profile_events[-100_000:]
+            self._append_profile_events(payload.get("events", []))
         return True
 
     def rpc_get_profile_events(self, conn, req_id, payload):

@@ -84,6 +84,22 @@ class TaskID(BaseID):
     __slots__ = ()
 
 
+class FunctionID(BaseID):
+    """Content hash of an exported function/class pickle (reference
+    `python/ray/_private/function_manager.py` function ids): the same blob
+    always maps to the same id, so the export-once function table is
+    content-addressed — re-decorating an identical function dedupes to one
+    GCS entry."""
+
+    __slots__ = ()
+
+    @classmethod
+    def for_blob(cls, blob: bytes) -> "FunctionID":
+        import hashlib
+
+        return cls(hashlib.blake2b(blob, digest_size=_UNIQUE_LEN).digest())
+
+
 class ObjectID(BaseID):
     """ObjectID = TaskID bytes + 4-byte big-endian index.
 

@@ -423,14 +423,24 @@ class ReconnectingClient:
         is dropped and a background reconnect is kicked off — callers are
         fire-and-forget paths (task events, resource reports) that must
         never stall an exec thread or RPC loop for a connect timeout."""
+        self.try_notify(method, payload)
+
+    def try_notify(self, method: str, payload: Any = None) -> bool:
+        """notify() that reports whether the message reached the socket:
+        False means the link is down (message dropped, background reconnect
+        kicked) so the caller can requeue. Still non-blocking; a write that
+        lands in a dying socket's buffer may yet be lost — this detects the
+        common down-link window (e.g. a GCS restart), not every loss."""
         cli = self._client
         if cli is None or cli.closed:
             self._schedule_reconnect()
-            return
+            return False
         try:
             cli.notify(method, payload)
+            return True
         except Exception:
             self._schedule_reconnect()
+            return False
 
     @property
     def closed(self) -> bool:

@@ -1,0 +1,133 @@
+"""Worker-side batched task-event + profile-span shipping.
+
+Equivalent of the reference's TaskEventBuffer
+(`src/ray/core_worker/task_event_buffer.h`): task lifecycle transitions
+(SUBMITTED/RUNNING/FINISHED/FAILED) and chrome-trace spans coalesce in the
+emitting process and flush to the GCS as ONE `task_events_batch` notify per
+`task_events_report_interval_ms` (and at shutdown), instead of one RPC per
+transition plus a profile flush after every execution. A driver submitting
+N tasks therefore issues O(elapsed/interval) control-plane RPCs, not O(N).
+
+The buffer is bounded (`task_events_max_buffer_size`): overflow drops the
+OLDEST events and counts them, and the dropped count rides the next flush so
+the GCS-side truncation counter stays honest (mirroring the eviction
+counter the GCS ring already keeps, gcs.py)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from typing import Optional
+
+from ray_tpu.core.config import get_config
+
+logger = logging.getLogger(__name__)
+
+
+class TaskEventBuffer:
+    def __init__(self, worker):
+        self._worker = worker
+        self._lock = threading.Lock()
+        self._events: deque = deque()
+        self._dropped = 0
+        # cursor into tracing.get_events() — spans before it were shipped
+        self._profile_sent = 0
+        self._thread: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+        self._stopped = False
+        self.flush_count = 0  # instrumentation for tests
+
+    def record(self, spec, state: str) -> None:
+        """Buffer one task-state transition (same payload the per-event
+        notify used to carry). Starts the flush timer lazily so processes
+        that never emit events never spawn the thread."""
+        w = self._worker
+        ev = {
+            "task_id": spec.task_id.binary(),
+            "name": spec.method_name,
+            "type": spec.task_type.name,
+            "state": state,
+            "job_id": spec.job_id.binary(),
+            "node_id": w.node_id,
+            "worker_id": w.worker_id.binary(),
+        }
+        start = None
+        with self._lock:
+            self._events.append(ev)
+            limit = max(1, get_config().task_events_max_buffer_size)
+            while len(self._events) > limit:
+                self._events.popleft()
+                self._dropped += 1
+            if self._thread is None and not self._stopped:
+                start = threading.Thread(target=self._loop,
+                                         name="task-events", daemon=True)
+                self._thread = start
+        if start is not None:
+            start.start()
+
+    def _loop(self) -> None:
+        while not self._stopped and not self._worker._shutdown.is_set():
+            self._wake.wait(get_config().task_events_report_interval_ms / 1000.0)
+            self._wake.clear()
+            try:
+                self.flush()
+            except Exception:
+                logger.debug("task event flush failed", exc_info=True)
+
+    def flush(self) -> None:
+        """Ship everything buffered (task events, dropped count, and any
+        tracing spans recorded since the last flush) in one GCS notify."""
+        from ray_tpu.util import tracing
+
+        with self._lock:
+            events = list(self._events)
+            self._events.clear()
+            dropped, self._dropped = self._dropped, 0
+            spans = tracing.get_events()
+            if self._profile_sent > len(spans):
+                self._profile_sent = 0  # tracing.clear() ran; resync
+            fresh = spans[self._profile_sent:]
+            self._profile_sent = len(spans)
+        if not events and not fresh and not dropped:
+            return
+        src = self._worker.worker_id.binary().hex()
+        payload = {
+            "events": events,
+            "dropped": dropped,
+            "profile_events": [{**e, "_src": src} for e in fresh],
+        }
+        # try_notify reports a down link (plain notify swallows it); fakes
+        # and raw clients in tests surface failure by raising instead
+        gcs = self._worker.gcs
+        sender = getattr(gcs, "try_notify", None)
+        try:
+            delivered = (sender("task_events_batch", payload)
+                         if sender is not None
+                         else (gcs.notify("task_events_batch", payload), True)[1])
+        except Exception:
+            delivered = False
+        if delivered:
+            self.flush_count += 1
+            return
+        # Task events go back for the next tick (a GCS-restart window must
+        # not silently lose lifecycle history); spans are best-effort, as
+        # they were under per-execution flushing.
+        with self._lock:
+            self._events.extendleft(reversed(events))
+            self._dropped += dropped
+            limit = max(1, get_config().task_events_max_buffer_size)
+            while len(self._events) > limit:
+                self._events.popleft()
+                self._dropped += 1
+        logger.debug("task event batch notify not delivered (GCS link down)")
+
+    def stop(self) -> None:
+        """Final flush at shutdown (the at-exit half of the batching
+        contract: nothing buffered may be lost to a clean exit)."""
+        self._stopped = True
+        self._wake.set()
+        try:
+            self.flush()
+        except Exception:
+            logger.debug("final task event flush failed", exc_info=True)

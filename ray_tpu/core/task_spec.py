@@ -42,6 +42,13 @@ class TaskSpec:
     function_blob: Optional[bytes]
     method_name: str
     language_hint: str = "python"
+    # Export-once fast lane (reference function_manager.py): when set, the
+    # callable's pickle lives in the GCS function table under this content
+    # hash and `function_blob` is None — the spec ships O(16 bytes) instead
+    # of O(closure). Executors resolve through a per-process LRU with a GCS
+    # fetch miss path; `function_blob` survives as the fallback wire format
+    # for one-shot/unexportable callables.
+    function_id: Optional[bytes] = None
 
     # Arguments: positional list of either ("value", bytes) inline serialized
     # or ("ref", ObjectID, owner_address) for object refs the executor must
@@ -93,7 +100,12 @@ class ActorCreationSpec:
     max_task_retries: int
     max_concurrency: int
     lifetime: str                  # "non_detached" | "detached"
-    class_blob: bytes              # cloudpickled class
+    # cloudpickled class — None when the class rides the function table
+    class_blob: Optional[bytes] = None
+    # export-once id of the class pickle (same fast lane as
+    # TaskSpec.function_id): repeated actor creations of one class ship
+    # 16 bytes instead of the class closure
+    class_fn_id: Optional[bytes] = None
     init_args: List[Tuple] = field(default_factory=list)
     init_kwargs_blob: Optional[bytes] = None
     resources: Dict[str, float] = field(default_factory=dict)

@@ -31,8 +31,6 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-import cloudpickle
-
 from ray_tpu.core import rpc, serialization
 from ray_tpu.core.config import get_config
 from ray_tpu.core.exceptions import (
@@ -42,7 +40,9 @@ from ray_tpu.core.exceptions import (
     TaskError,
     WorkerCrashedError,
 )
+from ray_tpu.core.function_table import FunctionTableClient
 from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID, WorkerID, _TaskIDCounter
+from ray_tpu.core.task_events import TaskEventBuffer
 from ray_tpu.core.object_store import attach_object
 from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.core.serialization import SerializedObject
@@ -302,10 +302,11 @@ class CoreWorker:
         # load reading is never inflated by the health checks that sample it
         self._load_count = 0
         self._exec_count_lock = threading.Lock()
-        self._profile_flush_lock = threading.Lock()
-        self._profile_events_sent = 0
         self._exec_threads_lock = threading.Lock()
         self._shutdown = threading.Event()
+        # optional submission-side instrumentation: called with each
+        # outgoing TaskSpec (microbenchmark wire-bytes probe); None = off
+        self._spec_bytes_probe = None
 
         self.raylet = rpc.connect_with_retry(
             raylet_address, push_handler=self._on_raylet_push,
@@ -316,6 +317,11 @@ class CoreWorker:
         self.gcs = rpc.ReconnectingClient(
             gcs_address, push_handler=self._on_gcs_push,
             on_reconnect=self._replay_gcs_state)
+
+        # task-path fast lanes: export-once function table + batched
+        # task-event/profile shipping (both ride self.gcs)
+        self.function_table = FunctionTableClient(self)
+        self.task_events = TaskEventBuffer(self)
 
         # Visible to task code before the first task can possibly arrive.
         set_current_worker(self)
@@ -360,6 +366,10 @@ class CoreWorker:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        # final event-buffer flush BEFORE the links close: a clean exit may
+        # not lose buffered lifecycle events (the at-shutdown half of the
+        # batching contract)
+        self.task_events.stop()
         self.reference_counter.close()
         if self.mode == "driver":
             try:
@@ -400,11 +410,17 @@ class CoreWorker:
 
             runtime_env = upload_py_modules(runtime_env, self.gcs)
         task_id = self._task_counter.next_task_id()
+        # Export-once fast lane: first submission of a callable pickles it
+        # once and exports the blob to the GCS function table; afterwards
+        # the spec carries only the 16-byte content hash (the fallback
+        # ships the blob inline for unexportable one-shot callables).
+        function_id, function_blob = self.function_table.export(func)
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
             task_type=TaskType.NORMAL,
-            function_blob=cloudpickle.dumps(func),
+            function_blob=function_blob,
+            function_id=function_id,
             method_name=getattr(func, "__name__", "anonymous"),
             args=self._serialize_args(args, task_id),
             kwargs_blob=serialization.dumps(kwargs) if kwargs else None,
@@ -422,43 +438,30 @@ class CoreWorker:
         with self._pending_lock:
             self._pending_tasks[task_id] = [spec, max_retries]
         self._emit_task_event(spec, "SUBMITTED")
+        probe = self._spec_bytes_probe
+        if probe is not None:
+            try:
+                probe(spec)
+            except Exception:
+                logger.debug("spec bytes probe failed", exc_info=True)
         self.raylet.notify("submit_task", {"spec": spec})
         return refs
 
-    def flush_profile_events(self, min_events: int = 1) -> None:
-        """Ship this process's tracing spans to the GCS so `timeline()` on
-        any driver aggregates cluster-wide events (reference ProfileEvent ->
-        TaskEventBuffer -> GCS)."""
-        from ray_tpu.util import tracing
-
-        src = self.worker_id.binary().hex()
-        with self._profile_flush_lock:
-            events = tracing.get_events()
-            fresh = events[self._profile_events_sent:]
-            if len(fresh) < min_events:
-                return
-            try:
-                self.gcs.notify("profile_events", {
-                    "events": [{**e, "_src": src} for e in fresh]})
-                self._profile_events_sent += len(fresh)
-            except OSError as e:
-                logger.debug("profile event flush failed: %s", e)
+    def flush_profile_events(self) -> None:
+        """Force-flush this process's event buffer (task events + tracing
+        spans) to the GCS so `timeline()` on any driver aggregates
+        cluster-wide events NOW instead of at the next batch interval
+        (reference ProfileEvent -> TaskEventBuffer -> GCS)."""
+        self.task_events.flush()
 
     def _emit_task_event(self, spec: TaskSpec, state: str) -> None:
-        """Best-effort task lifecycle record to the control plane
-        (reference TaskEventBuffer -> GcsTaskManager)."""
+        """Best-effort task lifecycle record, coalesced in the worker-side
+        TaskEventBuffer and shipped on its flush timer (reference
+        TaskEventBuffer -> GcsTaskManager)."""
         try:
-            self.gcs.notify("task_event", {
-                "task_id": spec.task_id.binary(),
-                "name": spec.method_name,
-                "type": spec.task_type.name,
-                "state": state,
-                "job_id": spec.job_id.binary(),
-                "node_id": self.node_id,
-                "worker_id": self.worker_id.binary(),
-            })
-        except (OSError, RuntimeError, TimeoutError):
-            logger.debug("task event emit failed", exc_info=True)
+            self.task_events.record(spec, state)
+        except Exception:
+            logger.debug("task event record failed", exc_info=True)
 
     def _register_returns(self, spec: TaskSpec) -> List[ObjectRef]:
         refs = []
@@ -1904,6 +1907,9 @@ class CoreWorker:
     def _replay_gcs_state(self, raw: rpc.RpcClient) -> None:
         """Rebuild this process's GCS-side state after a GCS restart (uses
         the RAW client — the reconnecting wrapper's lock is held)."""
+        # re-export the function table entries this process owns: a fresh
+        # GCS (no snapshot) must still resolve ids from in-flight specs
+        self.function_table.replay_exports(raw)
         if self.mode == "driver":
             raw.call("register_job", {
                 "job_id": self.job_id.binary(),
@@ -2045,6 +2051,10 @@ class CoreWorker:
             run_profile_request(payload)
         elif method == "exit":
             logger.info("worker exiting on raylet request")
+            try:
+                self.task_events.flush()
+            except Exception:
+                pass
             os._exit(0)
 
     def _actor_group_for(self, spec: TaskSpec) -> Optional[str]:
@@ -2136,7 +2146,8 @@ class CoreWorker:
         try:
             # become_actor can be pushed before our register reply lands.
             self._registered.wait(timeout=30)
-            cls = cloudpickle.loads(spec.class_blob)
+            cls = self.function_table.resolve(
+                getattr(spec, "class_fn_id", None), spec.class_blob)
             args, kwargs = self._deserialize_args(spec.init_args, spec.init_kwargs_blob)
             if spec.runtime_env:
                 self._apply_runtime_env(spec.runtime_env)
@@ -2228,10 +2239,15 @@ class CoreWorker:
         try:
             if spec.task_type == TaskType.ACTOR_TASK:
                 if spec.method_name == "__ray_terminate__":
+                    self.task_events.flush()
                     os._exit(0)
                 fn = getattr(self._actor_instance, spec.method_name)
             else:
-                fn = cloudpickle.loads(spec.function_blob)
+                # LRU of deserialized functions, GCS fetch on miss — the
+                # executor half of the export-once fast lane (replaces a
+                # cloudpickle.loads of the full blob on EVERY execution)
+                fn = self.function_table.resolve(
+                    spec.function_id, spec.function_blob)
                 if spec.runtime_env:
                     self._apply_runtime_env(spec.runtime_env)
             args, kwargs = self._deserialize_args(spec.args, spec.kwargs_blob)
@@ -2301,7 +2317,6 @@ class CoreWorker:
                         and spec.method_name not in self._PROBE_METHODS):
                     self._load_count -= 1
         self._emit_task_event(spec, "FAILED" if failed else "FINISHED")
-        self.flush_profile_events(min_events=1)
         try:
             if spec.owner_address == self.address:
                 self.rpc_report_task_result(None, 0, {"task_id": spec.task_id, "results": results})
@@ -2317,8 +2332,14 @@ class CoreWorker:
                 # worker recycling (reference max_calls): if this function
                 # just hit its budget, retire — the task_done notify tells
                 # the raylet to drop us from the pool FIRST so the next
-                # task can't be dispatched into the exiting process
-                key = hash(spec.function_blob)
+                # task can't be dispatched into the exiting process.
+                # Keyed on the FunctionID content hash; a blob-fallback spec
+                # (GCS blip during export) hashes to the SAME key, so one
+                # function never splits across two counters.
+                from ray_tpu.core.ids import FunctionID
+
+                key = spec.function_id or FunctionID.for_blob(
+                    spec.function_blob).binary()
                 with self._exec_count_lock:
                     self._fn_call_counts[key] = (
                         self._fn_call_counts.get(key, 0) + 1)
@@ -2331,7 +2352,7 @@ class CoreWorker:
             if recycle:
                 logger.info("max_calls=%d reached for %s; recycling worker",
                             spec.max_calls, spec.method_name)
-                self.flush_profile_events(min_events=1)
+                self.task_events.flush()
                 os._exit(0)
 
     def _stream_dynamic_returns(self, spec: TaskSpec, value) -> ObjectRefGenerator:

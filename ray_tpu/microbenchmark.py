@@ -1,11 +1,21 @@
 """Core-primitive microbenchmarks (reference `python/ray/_private/ray_perf.py:93-282`,
 run by `ray microbenchmark`): ops/s for tasks, actor calls, and object
-put/get. Requires an initialized runtime (`ray_tpu.init()` first or run via
-the CLI, which boots one).
+put/get, plus submission-side metrics for the task-path fast lanes — p50
+`.remote()` call latency and per-task TaskSpec wire bytes (which the
+export-once function table drops from O(closure) to O(FunctionID)).
+
+Requires an initialized runtime (`ray_tpu.init()` first or run via the CLI,
+which boots one).
+
+CLI:
+  python -m ray_tpu.microbenchmark              # full suite, one JSON row/line
+  python -m ray_tpu.microbenchmark --quick --json   # CI smoke: small batches,
+                                                    # short timers, one JSON doc
 """
 
 from __future__ import annotations
 
+import pickle
 import time
 from typing import Callable, Dict, List
 
@@ -36,6 +46,21 @@ def _noop_arg(x):
     return x
 
 
+def _make_closure_fn(nbytes: int = 1024 * 1024):
+    """A remote no-op capturing `nbytes` of state. Built INSIDE a function
+    on purpose: a nested def is always cloudpickled BY VALUE, so the
+    payload genuinely rides the export/spec — a module-level def would be
+    pickled by reference whenever this module is imported (CLI subcommand,
+    pytest) and the benchmark would measure a ~100-byte spec."""
+    payload = b"c" * nbytes
+
+    @ray_tpu.remote
+    def _noop_closure():
+        return len(payload)
+
+    return _noop_closure
+
+
 @ray_tpu.remote
 class _BenchActor:
     def method(self):
@@ -45,56 +70,127 @@ class _BenchActor:
         return x
 
 
-def run_microbenchmark(batch: int = 100) -> List[Dict]:
+def _submission_metrics(record, quick: bool) -> None:
+    """Submission-side fast-lane metrics: p50 time of an individual
+    `.remote()` call, and the pickled TaskSpec size for a closure-heavy
+    function on its first vs steady-state submission."""
+    from ray_tpu.core import api as _api
+
+    n = 50 if quick else 300
+    lat: List[float] = []
+    refs = []
+    ray_tpu.get(_noop.remote())  # ensure export + a warm worker
+    for _ in range(n):
+        t0 = time.perf_counter()
+        refs.append(_noop.remote())
+        lat.append(time.perf_counter() - t0)
+    ray_tpu.get(refs)
+    lat.sort()
+    record("task_submit_p50", lat[len(lat) // 2] * 1e6, unit="us")
+
+    w = _api._global_worker()
+    if not hasattr(w, "_spec_bytes_probe"):
+        return  # client mode: specs are built server-side
+    payload = b"z" * (256 * 1024)
+
+    @ray_tpu.remote
+    def _closure_heavy():
+        return len(payload)
+
+    sizes: List[int] = []
+    w._spec_bytes_probe = lambda spec: sizes.append(
+        len(pickle.dumps(spec, protocol=5)))
+    try:
+        ray_tpu.get(_closure_heavy.remote())
+        ray_tpu.get(_closure_heavy.remote())
+    finally:
+        w._spec_bytes_probe = None
+    record("task_wire_bytes_first", sizes[0], unit="bytes")
+    record("task_wire_bytes_steady", sizes[1], unit="bytes")
+
+
+def run_microbenchmark(batch: int = 100, quick: bool = False) -> List[Dict]:
+    """`quick` = CI smoke mode: small batches and short timers so the whole
+    suite runs in seconds on CPU while still driving every primitive."""
+    min_seconds = 0.2 if quick else 2.0
+    if quick:
+        batch = min(batch, 25)
     results: List[Dict] = []
 
     def record(name: str, rate: float, unit: str = "ops/s"):
         results.append({"benchmark": name, "rate": round(rate, 1), "unit": unit})
 
+    def rate(fn):
+        return _rate(fn, min_seconds=min_seconds)
+
     # tasks: batched submit + get
-    record("tasks_sync_batch", _rate(
+    record("tasks_sync_batch", rate(
         lambda: len(ray_tpu.get([_noop.remote() for _ in range(batch)]))))
 
     # single task round-trip latency expressed as ops/s
-    record("task_roundtrip", _rate(
+    record("task_roundtrip", rate(
         lambda: (ray_tpu.get(_noop.remote()), 1)[1]))
 
     arg = b"y" * 1024
-    record("tasks_1kb_arg_batch", _rate(
+    record("tasks_1kb_arg_batch", rate(
         lambda: len(ray_tpu.get([_noop_arg.remote(arg) for _ in range(batch)]))))
 
+    # the function-table acceptance benchmark: same 1 MiB-closure function
+    # submitted N times (export-once -> specs carry a 16-byte id)
+    closure_fn = _make_closure_fn()
+    record("tasks_1mb_closure_batch", rate(
+        lambda: len(ray_tpu.get([closure_fn.remote() for _ in range(batch)]))))
+
     a = _BenchActor.options(num_cpus=0).remote()
-    record("actor_calls_sync_batch", _rate(
+    record("actor_calls_sync_batch", rate(
         lambda: len(ray_tpu.get([a.method.remote() for _ in range(batch)]))))
-    record("actor_call_roundtrip", _rate(
+    record("actor_call_roundtrip", rate(
         lambda: (ray_tpu.get(a.method.remote()), 1)[1]))
-    record("actor_echo_1kb_batch", _rate(
+    record("actor_echo_1kb_batch", rate(
         lambda: len(ray_tpu.get([a.echo.remote(arg) for _ in range(batch)]))))
 
     small = b"x" * 1024
-    record("put_1kb", _rate(
+    record("put_1kb", rate(
         lambda: ([ray_tpu.put(small) for _ in range(batch)], batch)[1]))
 
-    big = np.zeros(10 * 1024 * 1024 // 8)  # 10 MB
+    big_bytes = (1 if quick else 10) * 1024 * 1024
+    big = np.zeros(big_bytes // 8)
     def put_get_big():
         ref = ray_tpu.put(big)
         out = ray_tpu.get(ref)
         return int(out.nbytes)
-    record("put_get_10mb_bytes", _rate(put_get_big), unit="bytes/s")
+    record(f"put_get_{big_bytes // (1024 * 1024)}mb_bytes", rate(put_get_big),
+           unit="bytes/s")
+
+    _submission_metrics(record, quick)
 
     ray_tpu.kill(a)
     return results
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    import argparse
     import json
+
+    parser = argparse.ArgumentParser(prog="ray_tpu.microbenchmark")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit one JSON document instead of a row per line")
+    parser.add_argument("--quick", action="store_true",
+                        help="CI smoke mode: small batches, short timers")
+    parser.add_argument("--batch", type=int, default=100)
+    args = parser.parse_args(argv)
 
     own_cluster = not ray_tpu.is_initialized()
     if own_cluster:
         ray_tpu.init(num_cpus=4)
     try:
-        for row in run_microbenchmark():
-            print(json.dumps(row))
+        rows = run_microbenchmark(batch=args.batch, quick=args.quick)
+        if args.as_json:
+            print(json.dumps({"quick": args.quick, "batch": args.batch,
+                              "results": rows}))
+        else:
+            for row in rows:
+                print(json.dumps(row))
     finally:
         if own_cluster:
             ray_tpu.shutdown()

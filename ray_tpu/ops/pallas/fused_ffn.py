@@ -184,9 +184,15 @@ def _vjp_bwd(eps, res, dy):
     interp = interpret_mode()
 
     bm, bn, bk = min(_BM, dff), min(_BN, d), min(_BK, T)
-    if T % bk or dff % bm or d % bn:
+    # The tiling constraint belongs to the Pallas kernels only: with every
+    # USE_K* flag turned off the backward is pure XLA and accepts any
+    # (T, d, dff) — rejecting non-tiling shapes at trace time used to break
+    # the all-XLA configuration for no reason. (USE_K3 defaults on, so the
+    # guard still fires out of the box.)
+    if (USE_K1 or USE_K2 or USE_K3) and (T % bk or dff % bm or d % bn):
         raise ValueError(f"fused_ffn: shapes ({T}, {d}, {dff}) must tile by "
-                         f"({bk}, {bn}, {bm})")
+                         f"({bk}, {bn}, {bm}) when a Pallas kernel "
+                         f"(USE_K1/K2/K3) is enabled")
 
     # K1: dW_down [dff, d]. Full-d N blocks: the gate/up operand panels
     # are fetched exactly once (the 512x512x512 variant re-read them per

@@ -36,6 +36,7 @@ from ray_tpu.air import (
     ScalingConfig,
 )
 from ray_tpu.air import session as air_session
+from ray_tpu.core.exceptions import PlacementInfeasibleError
 from ray_tpu.core.placement_group import placement_group, remove_placement_group
 from ray_tpu.util.queue import Queue
 
@@ -153,7 +154,7 @@ class DataParallelTrainer:
             failures_left -= 1
             checkpoint = result.checkpoint or checkpoint
             if (self.scaling_config.elastic
-                    and "placement group infeasible" in str(result.error)
+                    and isinstance(result.error, PlacementInfeasibleError)
                     and not self._shrink()):
                 result.metrics_history = history
                 return result  # nothing left to shrink to
@@ -189,7 +190,7 @@ class DataParallelTrainer:
         pg = placement_group([dict(bundle) for _ in range(n)], strategy=sc.strategy())
         if not pg.ready(timeout=60):
             remove_placement_group(pg)
-            return Result(metrics={}, error=RuntimeError(
+            return Result(metrics={}, error=PlacementInfeasibleError(
                 f"placement group infeasible: {n} x {bundle}"))
         queue = Queue()
         shards = self._make_dataset_shards(n)

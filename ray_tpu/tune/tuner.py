@@ -567,10 +567,17 @@ class Tuner:
                                        self._cfg.seed)
         exp_dir = self.experiment_dir()
         callbacks = getattr(self._run_config, "callbacks", None)
-        if callbacks is None and exp_dir is not None:
+        if exp_dir is not None:
+            # User callbacks EXTEND the default loggers, not replace them
+            # (reference tune: DEFAULT_LOGGERS are always installed unless a
+            # logger of that kind is already present) — passing only, say,
+            # WandbLoggerCallback must not silently drop progress.csv /
+            # result.json / TB event files.
             from ray_tpu.tune.logger import DEFAULT_LOGGERS
 
-            callbacks = [cls() for cls in DEFAULT_LOGGERS]
+            callbacks = list(callbacks) if callbacks is not None else []
+            callbacks += [cls() for cls in DEFAULT_LOGGERS
+                          if not any(isinstance(cb, cls) for cb in callbacks)]
         runner = TrialRunner(
             self._fn, configs, self._cfg,
             experiment_dir=exp_dir,

@@ -26,7 +26,6 @@ def test_state_api(ray_start_regular):
 
     a = A.remote()
     ray_tpu.get([f.remote(), a.ping.remote()])
-    time.sleep(0.3)  # task events are fire-and-forget
 
     nodes = state.list_nodes()
     assert len(nodes) == 1 and nodes[0]["alive"]
@@ -34,10 +33,17 @@ def test_state_api(ray_start_regular):
     actors = state.list_actors()
     assert any(x["class_name"] == "A" for x in actors)
 
-    tasks = state.list_tasks()
-    names = {t["name"] for t in tasks}
+    # task events ride the batched TaskEventBuffer (flush-interval lag)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        tasks = state.list_tasks()
+        names = {t["name"] for t in tasks}
+        finished = [t for t in tasks if t["name"] == "f"]
+        if "f" in names and "ping" in names and finished \
+                and finished[0]["state"] == "FINISHED":
+            break
+        time.sleep(0.2)
     assert "f" in names and "ping" in names
-    finished = [t for t in tasks if t["name"] == "f"]
     assert finished and finished[0]["state"] == "FINISHED"
 
 
@@ -150,9 +156,19 @@ def test_metrics_scrape_exports_dashboard_series(ray_start_regular):
 
     server, port = start_dashboard()
     try:
-        with urllib.request.urlopen(
-                f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
-            text = r.read().decode()
+        # poll: task counts arrive at the GCS via the batched event buffer
+        deadline = time.monotonic() + 15
+        while True:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+                text = r.read().decode()
+            got = re.search(
+                r"^ray_tpu_tasks_finished_total ([0-9.e+-]+)$", text,
+                re.MULTILINE)
+            if (got and float(got.group(1)) >= 3.0) \
+                    or time.monotonic() >= deadline:
+                break
+            time.sleep(0.3)
     finally:
         server.shutdown()
 

@@ -307,8 +307,14 @@ def test_list_tasks_reports_truncation(ray_start_regular):
         return i
 
     ray_tpu.get([tick.remote(i) for i in range(30)])
-    rows = state.list_tasks(limit=1000)
-    meta = [r for r in rows if r["type"] == "META"]
+    # events arrive via the batched TaskEventBuffer: poll past the flush lag
+    deadline = time.time() + 15
+    meta = []
+    while time.time() < deadline and not meta:
+        rows = state.list_tasks(limit=1000)
+        meta = [r for r in rows if r["type"] == "META"]
+        if not meta:
+            time.sleep(0.2)
     assert meta, "no truncation indicator after eviction"
     assert "evicted" in meta[0]["state"]
 

@@ -1,0 +1,25 @@
+"""Tier-1 smoke for the core-primitives microbenchmark: the quick/--json
+mode must run end to end on CPU so the submission hot path (function table,
+event batching, put/get) can't silently break between benchmark rounds."""
+
+import ray_tpu
+
+
+def test_microbenchmark_quick_mode(ray_start_regular):
+    from ray_tpu.microbenchmark import run_microbenchmark
+
+    rows = run_microbenchmark(batch=10, quick=True)
+    by_name = {r["benchmark"]: r for r in rows}
+    expected = {"tasks_sync_batch", "task_roundtrip", "tasks_1kb_arg_batch",
+                "actor_calls_sync_batch", "actor_call_roundtrip",
+                "actor_echo_1kb_batch", "put_1kb", "put_get_1mb_bytes",
+                "task_submit_p50", "task_wire_bytes_first",
+                "task_wire_bytes_steady"}
+    assert expected <= set(by_name), set(by_name)
+    for r in rows:
+        assert r["rate"] > 0, r
+    # export-once: the steady-state spec is never larger than the first,
+    # and both are O(id), far below the 256 KiB benchmark closure
+    assert by_name["task_wire_bytes_steady"]["rate"] <= \
+        by_name["task_wire_bytes_first"]["rate"]
+    assert by_name["task_wire_bytes_steady"]["rate"] < 16 * 1024

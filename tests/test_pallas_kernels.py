@@ -324,6 +324,44 @@ def test_fused_ffn_block_matches_reference():
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4, err_msg=name)
 
 
+def test_fused_ffn_nontiling_shapes_all_xla_backward():
+    """With every USE_K* kernel off, the backward is pure XLA and must
+    accept (T, d, dff) that do NOT tile by the 512 blocks — the tiling
+    check only applies when a Pallas kernel is enabled (it used to reject
+    these shapes at trace time even on the all-XLA path). With a kernel
+    enabled, the guard must still fire."""
+    import ray_tpu.ops.pallas.fused_ffn as F
+
+    # d > 512 and not a multiple of 512: the old trace-time check rejected
+    # this even with every Pallas kernel disabled
+    T, d, dff = 8, 520, 64
+    ks = jax.random.split(jax.random.PRNGKey(1), 5)
+    x = jax.random.normal(ks[0], (2, T // 2, d), jnp.float32)
+    nw = 1 + 0.1 * jax.random.normal(ks[1], (d,), jnp.float32)
+    wg = jax.random.normal(ks[2], (d, dff), jnp.float32) * d ** -0.5
+    wu = jax.random.normal(ks[3], (d, dff), jnp.float32) * d ** -0.5
+    wd = jax.random.normal(ks[4], (dff, d), jnp.float32) * dff ** -0.5
+
+    def loss_grads():
+        return jax.grad(
+            lambda *a: jnp.sum(F.ffn_block(*a).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2, 3, 4))(x, nw, wg, wu, wd)
+
+    old = (F.USE_K1, F.USE_K2, F.USE_K3)
+    F.USE_K1 = F.USE_K2 = F.USE_K3 = False
+    try:
+        grads = loss_grads()
+        for g, ref in zip(grads, (x, nw, wg, wu, wd)):
+            assert g.shape == ref.shape
+            assert bool(jnp.all(jnp.isfinite(g)))
+        # any enabled kernel re-arms the tiling requirement
+        F.USE_K3 = True
+        with pytest.raises(ValueError, match="must tile"):
+            loss_grads()
+    finally:
+        F.USE_K1, F.USE_K2, F.USE_K3 = old
+
+
 def test_fused_ffn_in_transformer_forward():
     """cfg.fused_ffn=True matches the stock layer path end to end (tiny
     shapes that satisfy the kernel's tiling divide the 512 blocks evenly

@@ -1,0 +1,250 @@
+"""Worker-side TaskEventBuffer (reference task_event_buffer.h): task-state
+transitions and profile spans coalesce per process and reach the GCS as
+O(flush intervals) batched RPCs — not O(tasks) — with a bounded buffer,
+dropped-event accounting, and a final flush at shutdown."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def _gcs(ray):
+    from ray_tpu.core import api as _api
+
+    return _api._node._gcs
+
+
+@pytest.fixture
+def slow_flush_cluster(monkeypatch):
+    """Cluster with a 1 s report interval so the RPC count below is a tight
+    function of elapsed seconds, not scheduling noise."""
+    from ray_tpu.core.config import reset_config
+
+    monkeypatch.setenv("RAY_TPU_TASK_EVENTS_REPORT_INTERVAL_MS", "1000")
+    reset_config()
+    ray_tpu.init(num_cpus=4, resources={"TPU": 8})
+    yield ray_tpu
+    ray_tpu.shutdown()
+    reset_config()
+
+
+def _count_handler(gcs, name, counter):
+    orig = gcs._server._handlers[name]
+
+    def wrapped(conn, req_id, payload):
+        counter[name] = counter.get(name, 0) + 1
+        return orig(conn, req_id, payload)
+
+    gcs._server._handlers[name] = wrapped
+
+
+def test_many_tasks_few_event_rpcs(slow_flush_cluster):
+    """The acceptance bar: a driver pushing hundreds of no-op tasks issues
+    batched task-event/profile RPCs, not one (or three) per task."""
+    gcs = _gcs(slow_flush_cluster)
+    counts = {}
+    for name in ("task_events_batch", "task_event", "profile_events"):
+        _count_handler(gcs, name, counts)
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    n = 200
+    ray_tpu.get([noop.remote() for _ in range(n)])
+
+    # wait until every lifecycle event (driver SUBMITTED + worker
+    # RUNNING/FINISHED) has landed, so the RPC count below is final
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        c = ray_tpu.core.worker.current_worker().gcs.call("task_counts")
+        if c["finished"] >= n:
+            break
+        time.sleep(0.2)
+    assert c["finished"] >= n, c
+
+    total = (counts.get("task_events_batch", 0)
+             + counts.get("task_event", 0)
+             + counts.get("profile_events", 0))
+    # pre-batching this was >= 3 RPCs per task (SUBMITTED + FINISHED +
+    # profile flush per execution) = 3n+; batched it is bounded by
+    # elapsed-seconds x processes (O(1) in the task count), far below n
+    assert counts.get("task_event", 0) == 0  # legacy per-event path unused
+    assert total < n, (total, counts)
+
+
+def test_events_arrive_timeline_intact_dropped_counted(ray_start_regular):
+    """One cluster, three claims: (1) buffered events land within ~the
+    report interval with no explicit flush; (2) timeline() still yields
+    chrome-trace spans for worker task executions; (3) a batch's
+    worker-side dropped count folds into the GCS truncation counter."""
+    w = ray_tpu.core.worker.current_worker()
+
+    @ray_tpu.remote
+    def tick():
+        time.sleep(0.01)
+        return 1
+
+    assert ray_tpu.get([tick.remote() for _ in range(2)]) == [1, 1]
+    deadline = time.monotonic() + 15
+    seen = {}
+    while time.monotonic() < deadline:
+        seen = w.gcs.call("task_counts")
+        if seen["finished"] >= 2 and seen["submitted"] >= 2:
+            break
+        time.sleep(0.1)
+    assert seen["finished"] >= 2 and seen["submitted"] >= 2, seen
+
+    # timeline aggregation unchanged (spans now ride the batched buffer)
+    deadline = time.monotonic() + 15
+    spans = []
+    while time.monotonic() < deadline:
+        spans = [e for e in ray_tpu.timeline()
+                 if e.get("cat") == "task_execution"
+                 and "tick" in e.get("name", "")]
+        if len(spans) >= 2:
+            break
+        time.sleep(0.2)
+    assert len(spans) >= 2
+    for e in spans:
+        assert {"name", "cat", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+
+    # dropped accounting: `list tasks` stays honest about lost history
+    gcs = _gcs(ray_tpu)
+    before = gcs._task_events_dropped
+    w.gcs.call("task_events_batch", {"events": [], "dropped": 7,
+                                     "profile_events": []})
+    assert gcs._task_events_dropped == before + 7
+
+
+class _FakeGcs:
+    def __init__(self):
+        self.batches = []
+
+    def notify(self, method, payload):
+        assert method == "task_events_batch"
+        self.batches.append(payload)
+
+
+class _FakeWorker:
+    def __init__(self):
+        from ray_tpu.core.ids import WorkerID
+
+        self.gcs = _FakeGcs()
+        self.node_id = b"node"
+        self.worker_id = WorkerID.from_random()
+        self._shutdown = threading.Event()
+
+
+def _spec(i=0):
+    from ray_tpu.core.ids import JobID, WorkerID, _TaskIDCounter
+    from ray_tpu.core.task_spec import TaskSpec, TaskType
+
+    tid = _TaskIDCounter(WorkerID.from_random()).next_task_id()
+    return TaskSpec(task_id=tid, job_id=JobID.from_random(),
+                    task_type=TaskType.NORMAL, function_blob=None,
+                    method_name=f"t{i}")
+
+
+def test_overflow_drops_oldest_and_counts(monkeypatch):
+    from ray_tpu.core import task_events as te_mod
+    from ray_tpu.core.config import Config
+
+    cfg = Config()
+    cfg.task_events_max_buffer_size = 10
+    # interval long enough that the timer thread can't flush mid-test
+    cfg.task_events_report_interval_ms = 60_000
+    monkeypatch.setattr(te_mod, "get_config", lambda: cfg)
+
+    w = _FakeWorker()
+    buf = te_mod.TaskEventBuffer(w)
+    for i in range(25):
+        buf.record(_spec(i), "SUBMITTED")
+    buf.flush()
+    assert len(w.gcs.batches) == 1
+    batch = w.gcs.batches[0]
+    assert len(batch["events"]) == 10
+    assert batch["dropped"] == 15
+    # the RETAINED events are the newest 15..24
+    assert batch["events"][0]["name"] == "t15"
+    assert batch["events"][-1]["name"] == "t24"
+
+
+def test_flush_requeues_when_link_down(monkeypatch):
+    """A flush that can't reach the GCS (restart window) puts the events
+    back for the next tick instead of silently losing them."""
+    from ray_tpu.core import task_events as te_mod
+    from ray_tpu.core.config import Config
+
+    cfg = Config()
+    cfg.task_events_report_interval_ms = 60_000
+    monkeypatch.setattr(te_mod, "get_config", lambda: cfg)
+
+    class _DownThenUpGcs(_FakeGcs):
+        def __init__(self):
+            super().__init__()
+            self.down = True
+
+        def try_notify(self, method, payload):
+            if self.down:
+                return False
+            self.notify(method, payload)
+            return True
+
+    w = _FakeWorker()
+    w.gcs = _DownThenUpGcs()
+    buf = te_mod.TaskEventBuffer(w)
+    buf.record(_spec(0), "SUBMITTED")
+    buf.flush()
+    assert not w.gcs.batches  # dropped link: nothing delivered...
+    w.gcs.down = False
+    buf.flush()
+    assert len(w.gcs.batches) == 1  # ...but nothing lost either
+    assert len(w.gcs.batches[0]["events"]) == 1
+
+
+def test_terminal_state_not_regressed_by_late_event():
+    """Batch reordering can land a worker's FINISHED before the driver's
+    SUBMITTED: the late non-terminal event must not regress the displayed
+    state (no further event would ever repair it)."""
+    from ray_tpu.core.gcs import GcsServer
+
+    gcs = GcsServer()  # not started: direct handler calls only
+    ev = {"task_id": b"t1", "name": "f", "type": "NORMAL",
+          "job_id": b"j", "node_id": b"n", "worker_id": b"w"}
+    gcs.rpc_task_events_batch(None, 0, {
+        "events": [{**ev, "state": "RUNNING"}, {**ev, "state": "FINISHED"}],
+        "dropped": 0, "profile_events": []})
+    gcs.rpc_task_events_batch(None, 0, {
+        "events": [{**ev, "state": "SUBMITTED"}],  # late driver flush
+        "dropped": 0, "profile_events": []})
+    entry = gcs._task_events[b"t1"]
+    assert entry["state"] == "FINISHED"
+    # the late SUBMITTED still counts toward the totals and the history
+    counts = gcs.rpc_task_counts(None, 0, {})
+    assert counts["submitted"] == 1 and counts["finished"] == 1
+    assert [s for s, _ in entry["events"]] == \
+        ["RUNNING", "FINISHED", "SUBMITTED"]
+
+
+def test_stop_flushes_pending_events(monkeypatch):
+    from ray_tpu.core import task_events as te_mod
+    from ray_tpu.core.config import Config
+
+    cfg = Config()
+    cfg.task_events_report_interval_ms = 60_000
+    monkeypatch.setattr(te_mod, "get_config", lambda: cfg)
+
+    w = _FakeWorker()
+    buf = te_mod.TaskEventBuffer(w)
+    buf.record(_spec(), "SUBMITTED")
+    buf.record(_spec(), "FINISHED")
+    assert not w.gcs.batches  # nothing flushed yet (long interval)
+    buf.stop()
+    assert len(w.gcs.batches) == 1
+    assert len(w.gcs.batches[0]["events"]) == 2
+
+

@@ -227,6 +227,58 @@ def test_elastic_restart_resumes_from_checkpoint(ray_start_regular, tmp_path):
     assert steps == [0, 1, 2, 3, 4, 5], steps
 
 
+def test_elastic_shrink_matches_infeasible_by_type(monkeypatch):
+    """Elastic shrink keys on the typed PlacementInfeasibleError, not on a
+    message substring — a reworded message must still trigger the shrink
+    ladder (halve workers until 1x1, then give up)."""
+    from ray_tpu.air.config import FailureConfig, RunConfig, ScalingConfig
+    from ray_tpu.air.result import Result
+    from ray_tpu.core.exceptions import PlacementInfeasibleError
+
+    attempts = []
+    trainer = DataParallelTrainer(
+        lambda config: None,
+        scaling_config=ScalingConfig(num_workers=4, elastic=True,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=5)))
+
+    def fake_fit_once(checkpoint):
+        attempts.append(trainer.scaling_config.num_workers)
+        # deliberately reworded message: the old substring match would
+        # have skipped the shrink entirely
+        return Result(metrics={}, error=PlacementInfeasibleError(
+            "bundle reservation cannot be satisfied"))
+
+    monkeypatch.setattr(trainer, "_fit_once", fake_fit_once)
+    result = trainer.fit()
+    assert isinstance(result.error, PlacementInfeasibleError)
+    assert attempts == [4, 2, 1], attempts  # shrank to 1 worker, then gave up
+
+
+def test_non_placement_error_does_not_shrink(monkeypatch):
+    """Generic failures retry at FULL size: only the typed infeasibility
+    error may shrink the topology."""
+    from ray_tpu.air.config import FailureConfig, RunConfig, ScalingConfig
+    from ray_tpu.air.result import Result
+
+    attempts = []
+    trainer = DataParallelTrainer(
+        lambda config: None,
+        scaling_config=ScalingConfig(num_workers=4, elastic=True,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=2)))
+
+    def fake_fit_once(checkpoint):
+        attempts.append(trainer.scaling_config.num_workers)
+        return Result(metrics={}, error=RuntimeError(
+            "placement group infeasible"))  # message lies; type rules
+
+    monkeypatch.setattr(trainer, "_fit_once", fake_fit_once)
+    result = trainer.fit()
+    assert result.error is not None
+    assert attempts == [4, 4, 4], attempts
+
+
 @pytest.mark.slow
 def test_transformers_trainer_ddp(ray_start_regular):
     """TransformersTrainer (reference huggingface_trainer.py): HF Trainer

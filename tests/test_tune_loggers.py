@@ -175,6 +175,41 @@ class _FakeWandb:
         return run
 
 
+def test_user_callbacks_keep_default_loggers(ray_start_regular, tmp_path):
+    """Supplying callbacks APPENDS the missing default loggers instead of
+    replacing them: a sweep run with only a tracker callback must still get
+    progress.csv / result.json / TB events per trial. A user-supplied
+    instance of a default logger kind suppresses the auto-appended one."""
+    from ray_tpu.tune.callback import Callback
+    from ray_tpu.tune.logger import CSVLoggerCallback
+
+    log = []
+
+    class R(_Recorder, Callback):
+        pass
+
+    class MyCSV(CSVLoggerCallback):
+        pass
+
+    results, exp_dir = _fit(tmp_path, ray_start_regular,
+                            callbacks=[R(log), MyCSV()])
+    assert len(results) == 2
+    assert any(e[0] == "result" for e in log)  # user callback still ran
+    trial_dirs = [d for d in sorted(os.listdir(exp_dir))
+                  if d.startswith("trial_")
+                  and os.path.isdir(os.path.join(exp_dir, d))]
+    assert len(trial_dirs) == 2
+    for td in trial_dirs:
+        path = os.path.join(exp_dir, td)
+        for fname in ("result.json", "progress.csv"):
+            assert os.path.exists(os.path.join(path, fname)), fname
+        assert any(x.startswith("events.out") for x in os.listdir(path))
+        # exactly 3 rows: the user's CSV subclass SUPPRESSED the
+        # auto-appended CSVLoggerCallback (a duplicate would double-write)
+        with open(os.path.join(path, "progress.csv")) as f:
+            assert len(list(csv.DictReader(f))) == 3
+
+
 def test_wandb_adapter_with_fake_module(ray_start_regular, tmp_path,
                                         monkeypatch):
     import types
@@ -196,6 +231,36 @@ def test_wandb_adapter_with_fake_module(ray_start_regular, tmp_path,
         assert run.finished
         assert [step for _, step in run.logged] == [1, 2, 3]
         assert run.logged[-1][0]["score"] == run.kw["config"]["a"] * 3
+
+
+def test_wandb_reinit_fallback_for_old_versions(ray_start_regular, tmp_path,
+                                                monkeypatch):
+    """Older wandb rejects reinit="create_new" with TypeError/ValueError:
+    the adapter retries with reinit=True instead of silently disabling
+    tracking."""
+    import types
+
+    class _OldFakeWandb(_FakeWandb):
+        def init(self, **kw):
+            if kw.get("reinit") == "create_new":
+                raise TypeError("reinit must be a bool")
+            return super().init(**kw)
+
+    fake = _OldFakeWandb()
+    mod = types.ModuleType("wandb")
+    mod.init = fake.init
+    monkeypatch.setitem(sys.modules, "wandb", mod)
+
+    from ray_tpu.air.integrations import WandbLoggerCallback
+
+    results, _ = _fit(tmp_path, ray_start_regular,
+                      callbacks=[WandbLoggerCallback(project="p")])
+    assert not results.errors
+    assert len(fake.runs) == 2  # both trials tracked via the fallback
+    for run in fake.runs:
+        assert run.kw["reinit"] is True
+        assert [step for _, step in run.logged] == [1, 2, 3]
+        assert run.finished
 
 
 def test_wandb_adapter_absent_module_is_noop(ray_start_regular, tmp_path,
