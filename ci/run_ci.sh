@@ -34,9 +34,23 @@ run_native() {
 run_fast() {
   echo "=== [2/3] fast test tier ==="
   python -m pytest tests/ -q
-  # core-primitives smoke: the submission hot path (function table, event
-  # batching, put/get) must run end to end on CPU every CI pass
-  JAX_PLATFORMS=cpu python -m ray_tpu.microbenchmark --quick --json
+  # core-primitives smoke: the submission AND completion hot paths
+  # (function table, event batching, batched result delivery, put/get)
+  # must run end to end on CPU every CI pass, and the return-path rows
+  # must be present so the completion fast lanes can't silently drop out
+  mb_json="$(mktemp /tmp/ray_tpu_mb_quick.XXXXXX.json)"
+  JAX_PLATFORMS=cpu python -m ray_tpu.microbenchmark --quick --json \
+    | tee "$mb_json"
+  MB_JSON="$mb_json" python - <<'EOF'
+import json, os
+rows = {r["benchmark"] for r in
+        json.load(open(os.environ["MB_JSON"]))["results"]}
+need = {"task_submit_p50", "task_e2e_p50", "task_completions_per_s"}
+missing = need - rows
+assert not missing, f"microbenchmark smoke missing rows: {missing}"
+print("microbenchmark rows ok:", ", ".join(sorted(need)))
+EOF
+  rm -f "$mb_json"
 }
 
 run_stress() {

@@ -122,6 +122,17 @@ class Config:
     # bounded buffer: oldest events drop (counted) beyond this
     task_events_max_buffer_size: int = 10000
 
+    # --- completion-path fast lanes ---
+    # Executor-side ResultBuffer (result_buffer.py): while a delivery is in
+    # flight, further results batch per owner until this interval's edge;
+    # with nothing in flight a result ships as soon as the flush thread
+    # wakes, so a sequential caller's round-trips never wait out the
+    # interval.
+    result_buffer_flush_interval_ms: int = 10
+    # per-result delivery attempts (one flush retry each) before results to
+    # an unreachable owner are dropped with a warning
+    result_delivery_max_attempts: int = 5
+
     # --- rpc ---
     rpc_connect_timeout_s: float = 30.0
     rpc_call_timeout_s: float = 0.0  # 0 = no timeout

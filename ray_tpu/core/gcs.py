@@ -57,6 +57,14 @@ class GcsServer:
         self._snapshot_interval_s = snapshot_interval_s
         self._dirty = False
         self._snapshot_write_lock = threading.Lock()
+        # debounced resource fan-out (completion-path fast lane): at most
+        # one CH_RESOURCES publish per resource_broadcast_period_ms
+        from ray_tpu.util.debounce import Debouncer
+
+        self._bcast_debounce = Debouncer(
+            lambda: self._publish(CH_RESOURCES, self.cluster_view()),
+            lambda: get_config().resource_broadcast_period_ms / 1000.0,
+            skip_deferred=lambda: self._shutdown.is_set())
 
         # node table: node_id(bytes) -> info dict
         self._nodes: Dict[bytes, dict] = {}
@@ -317,7 +325,7 @@ class GcsServer:
             except Exception:
                 logger.exception("GCS could not connect back to raylet %s", payload["address"])
         self._publish(CH_NODES, {"event": "added", "node": self._public_node(node_id)})
-        self._broadcast_resources()
+        self._broadcast_resources(force=True)
         return {"nodes": [self._public_node(n) for n in self._nodes]}
 
     def _public_node(self, node_id: bytes) -> dict:
@@ -370,9 +378,14 @@ class GcsServer:
         self._broadcast_resources()
         return True
 
-    def _broadcast_resources(self) -> None:
-        view = self.cluster_view()
-        self._publish(CH_RESOURCES, view)
+    def _broadcast_resources(self, force: bool = False) -> None:
+        """Debounced CH_RESOURCES fan-out: every subscribed raylet runs a
+        scheduling pass on each broadcast, so per-completion rebroadcasts
+        multiplied control-plane work by the node count. At most one publish
+        per resource_broadcast_period_ms; a burst arms one trailing timer so
+        the final view always lands. Topology changes (node added/removed)
+        pass force=True — membership must never wait out a debounce."""
+        self._bcast_debounce(force=force)
 
     def cluster_view(self) -> dict:
         with self._lock:
@@ -444,7 +457,7 @@ class GcsServer:
         if client:
             client.close()
         self._publish(CH_NODES, {"event": "removed", "node_id": node_id, "reason": reason})
-        self._broadcast_resources()
+        self._broadcast_resources(force=True)
         # Fail over actors that lived on the dead node.
         with self._lock:
             affected = [a for a in self._actors.values() if a.node_id == node_id and a.state == ActorState.ALIVE]

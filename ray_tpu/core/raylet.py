@@ -51,6 +51,10 @@ class WorkerHandle:
     actor_charge: Optional[Tuple[Optional[Tuple], Dict[str, float]]] = None
     # chip indices granted for the current task / actor lifetime
     tpu_grant: Optional[Tuple[Optional[List[int]], float]] = None
+    # recently completed tasks (task_id, owner_address, t_done): their
+    # batched results may still sit in the worker's ResultBuffer when the
+    # process dies, so unexpected disconnects fail them over to the owners
+    recent_done: deque = field(default_factory=lambda: deque(maxlen=128))
 
 
 @dataclass
@@ -129,7 +133,17 @@ class Raylet:
         self._policy = SchedulingPolicy()
         self._queue: deque[_QueuedTask] = deque()
         self._workers: Dict[WorkerID, WorkerHandle] = {}
-        self._idle_workers: deque[WorkerID] = deque()
+        # idle workers keyed by runtime-env pool: O(1) acquire per dispatch
+        # instead of an O(n) scan over every idle worker of every env
+        self._idle_pools: Dict[Optional[str], deque[WorkerID]] = {}
+        # debounced resource broadcast (at most one report_resources notify
+        # per resource_broadcast_period_ms, trailing edge guaranteed)
+        from ray_tpu.util.debounce import Debouncer
+
+        self._resource_report_debounce = Debouncer(
+            self._send_resource_report,
+            lambda: get_config().resource_broadcast_period_ms / 1000.0,
+            skip_deferred=lambda: self._shutdown.is_set())
         self._starting: List[subprocess.Popen] = []
         self._starting_env: Dict[int, str] = {}  # pid -> env_key
         self._env_spawning: set = set()          # env_keys mid-creation
@@ -367,6 +381,16 @@ class Raylet:
                     logger.exception("periodic schedule retry failed")
 
     def _report_resources(self) -> None:
+        """Debounced resource broadcast: at most one GCS notify per
+        resource_broadcast_period_ms. Completions used to push one report
+        (and one cluster-wide broadcast echo, which re-triggered _schedule
+        on every subscribed raylet) per finished task; under a deep queue
+        that was a measurable slice of the per-completion budget. A burst
+        arms ONE trailing timer so the final post-burst state always lands
+        within a period — never a stale view, never a notify storm."""
+        self._resource_report_debounce()
+
+    def _send_resource_report(self) -> None:
         try:
             self._gcs.notify("report_resources", {
                 "node_id": self.node_id.binary(),
@@ -426,7 +450,8 @@ class Raylet:
                     self._maybe_spawn(handle.env_key, spec.runtime_env,
                                       needed=remaining)
             else:
-                self._idle_workers.append(wid)
+                self._idle_pools.setdefault(
+                    handle.env_key, deque()).append(wid)
         if spawned_env:
             # the spawn lease handed off to the worker's own reference
             self._env_manager.release(spawned_env)
@@ -541,10 +566,12 @@ class Raylet:
         if handle.env_key:
             self._env_manager.release(handle.env_key)
         with self._lock:
-            try:
-                self._idle_workers.remove(wid)
-            except ValueError:
-                pass
+            pool = self._idle_pools.get(handle.env_key)
+            if pool is not None:
+                try:
+                    pool.remove(wid)
+                except ValueError:
+                    pass
             spec = handle.current_task
             actor_id = handle.actor_id
         if self._shutdown.is_set():
@@ -557,6 +584,17 @@ class Raylet:
         if spec is not None:
             self._release_resources(spec)
             self._notify_owner_worker_died(spec, reason="oom" if was_oom else "")
+        # Batched-result loss failover: tasks completed in the last few
+        # flush intervals may have died with their results still in the
+        # worker's ResultBuffer (task_done precedes result delivery under
+        # load). task_worker_died is idempotent at the owner — a task whose
+        # results already landed was popped from its pending table — so
+        # over-notifying is safe; an owner that DID lose the results retries
+        # or fails the task instead of hanging on it forever. Clean exits
+        # (max_calls recycle, idle kill) pop the handle before the
+        # disconnect fires and never reach this; retiring workers get the
+        # same backstop after a grace delay in rpc_task_done.
+        self._failover_recent_done(handle.recent_done)
         self._release_actor_charge(handle)
         if actor_id is not None:
             try:
@@ -565,6 +603,32 @@ class Raylet:
             except OSError as e:
                 logger.warning("actor_failed notify lost (GCS down?): %s", e)
         self._schedule()
+
+    def _failover_recent_done(self, recent_done, extra_window: float = 0.0
+                              ) -> None:
+        """Notify owners of recently completed tasks that their worker is
+        gone; owners whose results already landed treat it as a no-op. The
+        window scales with the configured flush interval — results can sit
+        buffered in the worker for about that long (`extra_window` covers
+        deliberate delays, e.g. the retiring-worker grace). Entries group
+        per owner and an owner is dialed ONCE: a dead owner (the common
+        paired failure — driver died, then its worker) costs one bounded
+        connect attempt, not one per completed task."""
+        window = extra_window + max(
+            5.0, 10 * get_config().result_buffer_flush_interval_ms / 1000.0)
+        now = time.monotonic()
+        by_owner: Dict[str, list] = {}
+        for task_id, owner, t_done in list(recent_done):
+            if now - t_done <= window:
+                by_owner.setdefault(owner, []).append(task_id)
+        for owner, task_ids in by_owner.items():
+            try:
+                peer = self._peer(owner)
+                for task_id in task_ids:
+                    peer.notify("task_worker_died",
+                                {"task_id": task_id, "reason": ""})
+            except Exception:
+                logger.debug("recent-done failover notify to %s lost", owner)
 
     def _notify_owner_task_failed(self, spec: TaskSpec, msg: str) -> None:
         try:
@@ -703,12 +767,13 @@ class Raylet:
             now = time.monotonic()
             to_kill: List[WorkerHandle] = []
             with self._lock:
-                for wid in list(self._idle_workers):
-                    w = self._workers.get(wid)
-                    if w and w.proc is not None and now - w.idle_since > cfg.idle_worker_killing_time_s:
-                        self._idle_workers.remove(wid)
-                        self._workers.pop(wid, None)
-                        to_kill.append(w)
+                for pool in self._idle_pools.values():
+                    for wid in list(pool):
+                        w = self._workers.get(wid)
+                        if w and w.proc is not None and now - w.idle_since > cfg.idle_worker_killing_time_s:
+                            pool.remove(wid)
+                            self._workers.pop(wid, None)
+                            to_kill.append(w)
             for w in to_kill:
                 if w.env_key:
                     # popped here, so _on_worker_disconnect won't release
@@ -998,15 +1063,18 @@ class Raylet:
 
     def _acquire_worker(self, env_key: Optional[str] = None
                         ) -> Optional[WorkerHandle]:
-        """Pop an idle worker from the matching runtime-env pool."""
-        for wid in list(self._idle_workers):
+        """Pop an idle worker from the matching runtime-env pool: O(1) per
+        dispatch (plus skipped dead connections) instead of a linear scan
+        over every idle worker of every env on a busy mixed-env node."""
+        pool = self._idle_pools.get(env_key)
+        while pool:
+            wid = pool.popleft()
             w = self._workers.get(wid)
             if w is None or not w.conn.alive:
-                self._idle_workers.remove(wid)
-                continue
-            if w.env_key == env_key:
-                self._idle_workers.remove(wid)
-                return w
+                continue  # raced a disconnect; entry already stale
+            return w
+        if pool is not None and not pool:
+            self._idle_pools.pop(env_key, None)  # drop drained env pools
         return None
 
     def _starting_for(self, env_key: Optional[str]) -> int:
@@ -1038,6 +1106,9 @@ class Raylet:
             spec = w.current_task
             w.current_task = None
             grant, w.tpu_grant = w.tpu_grant, None
+            if spec is not None:
+                w.recent_done.append(
+                    (spec.task_id, spec.owner_address, time.monotonic()))
             if retiring:
                 # max_calls recycling: the worker exits after this notify.
                 # Drop it NOW so no task is dispatched into the closing
@@ -1051,16 +1122,97 @@ class Raylet:
         if retiring:
             if w.env_key:
                 self._env_manager.release(w.env_key)
+            # A retiring worker drains its ResultBuffer before os._exit, but
+            # that final drain can fail against a transiently-down owner and
+            # the clean pop above means no disconnect failover will fire.
+            # After a grace exceeding the drain's WORST case (per-owner 2s
+            # short-timeout reconnect plus the 5s in-flight wait — firing
+            # mid-drain would spuriously retry a task that succeeded), send
+            # the idempotent failover anyway: owners that got their results
+            # no-op, an owner that lost them unsticks.
+            entries = list(w.recent_done)
+            if entries:
+                grace = 10.0
+                t = threading.Timer(
+                    grace, lambda: self._failover_recent_done(
+                        entries, extra_window=grace))
+                t.daemon = True
+                t.start()
             self._schedule()
             self._report_resources()
             return True
-        with self._lock:
-            if w.actor_id is None and w.conn.alive:
-                w.idle_since = time.monotonic()
-                self._idle_workers.append(wid)
+        # Completion fast lane: hand the next queued same-env task straight
+        # to the just-freed worker. When the handoff consumed exactly what
+        # the finished task released (the homogeneous deep-queue regime) no
+        # other ticket became dispatchable, so the full _schedule() pass —
+        # O(blocked-scan) policy evaluations per completion — is skipped.
+        handed = self._try_handoff(w)
+        if handed is not None and spec is not None and \
+                self._effective_demand(spec) == self._effective_demand(handed) \
+                and self._pool_key(spec) == self._pool_key(handed):
+            # the handoff re-charged exactly the pool the finished task
+            # released into: no other ticket became dispatchable
+            self._report_resources()
+            return True
+        if handed is None:
+            with self._lock:
+                if w.actor_id is None and w.conn.alive:
+                    w.idle_since = time.monotonic()
+                    self._idle_pools.setdefault(
+                        w.env_key, deque()).append(wid)
         self._schedule()
         self._report_resources()
         return True
+
+    @staticmethod
+    def _pool_key(spec: TaskSpec):
+        """Identity of the resource pool a task charges: None for the node
+        pool, (pg_id, bundle) for a placement-group bundle. The handoff may
+        only skip the full _schedule() pass when release and re-charge hit
+        the SAME pool — equal demand dicts against different pools still
+        leave freed capacity behind."""
+        pg = spec.scheduling.placement_group_id
+        return None if pg is None else (pg, max(spec.scheduling.bundle_index, 0))
+
+    def _try_handoff(self, w: WorkerHandle) -> Optional[TaskSpec]:
+        """Dispatch the HEAD queued task into the just-freed worker without
+        a full _schedule() scan. Returns the dispatched spec, or None when
+        the head needs anything the fast lane can't do (another env's pool,
+        spilling to a peer, a spawn, infeasible resources) — then the caller
+        falls back to the full pass, so behavior degrades to the old path
+        rather than diverging from it."""
+        with self._lock:
+            # Liveness re-checked UNDER the lock: _on_worker_disconnect
+            # serializes on it, so a worker whose disconnect already ran
+            # (popped from _workers, current_task seen as None — nobody
+            # would ever fail the task over) can't receive a dispatch here.
+            if (w.actor_id is not None or not w.conn.alive
+                    or self._workers.get(w.worker_id) is not w):
+                return None
+            if not self._queue:
+                return None
+            qt = self._queue[0]
+            spec = qt.spec
+            if _env_key(spec.runtime_env) != w.env_key:
+                return None
+            if w.env_key is not None and \
+                    self._env_manager.creation_error(w.env_key) is not None:
+                return None
+            demand = self._effective_demand(spec)
+            if not self._resources_ok(spec, demand):
+                return None
+            if self._choose_node(spec, qt.spillback_count) != self.node_id.hex():
+                return None  # wants another node: let _schedule spill it
+            self._queue.popleft()
+            self._charge_resources(spec, demand)
+            w.current_task = spec
+            w.task_started = time.monotonic()
+            tpu_amount = demand.get("TPU", 0.0)
+            tpu_ids = self._assign_tpus(tpu_amount)
+            w.tpu_grant = (tpu_ids, tpu_amount)
+            w.conn.push("execute_task", {
+                "spec": spec, "tpu_ids": tpu_ids or []})
+            return spec
 
     # ---------------------------------------------------------------- actors
     def rpc_create_actor(self, conn, req_id, payload):

@@ -18,6 +18,7 @@ the node-local object store.
 from __future__ import annotations
 
 import asyncio
+import heapq
 import inspect
 import logging
 import os
@@ -263,6 +264,15 @@ class CoreWorker:
         self._peers: Dict[str, rpc.RpcClient] = {}
         self._peers_lock = threading.Lock()
 
+        # Delayed resubmits (task retries) ride ONE shared timer thread
+        # instead of one threading.Timer per retry: a burst of failed tasks
+        # must not fork hundreds of timer threads. Heap of
+        # (due_monotonic, seq, spec); seq breaks ties (specs don't compare).
+        self._resubmit_heap: list = []
+        self._resubmit_cv = threading.Condition()
+        self._resubmit_thread: Optional[threading.Thread] = None
+        self._resubmit_seq = 0
+
         # pending task specs for retry: task_id -> [spec, retries_left].
         # Touched by user threads (submit), the RPC reader (results, death
         # notifications) and the GCS push thread (actor death fan-out), so all
@@ -322,6 +332,10 @@ class CoreWorker:
         # task-event/profile shipping (both ride self.gcs)
         self.function_table = FunctionTableClient(self)
         self.task_events = TaskEventBuffer(self)
+        # completion-path fast lane: per-owner batched result delivery
+        from ray_tpu.core.result_buffer import ResultBuffer
+
+        self.result_buffer = ResultBuffer(self)
 
         # Visible to task code before the first task can possibly arrive.
         set_current_worker(self)
@@ -366,9 +380,10 @@ class CoreWorker:
 
     def shutdown(self) -> None:
         self._shutdown.set()
-        # final event-buffer flush BEFORE the links close: a clean exit may
-        # not lose buffered lifecycle events (the at-shutdown half of the
-        # batching contract)
+        # final buffer flushes BEFORE the links close: a clean exit may not
+        # lose buffered results or lifecycle events (the at-shutdown half of
+        # the batching contract)
+        self.result_buffer.stop()
         self.task_events.stop()
         self.reference_counter.close()
         if self.mode == "driver":
@@ -1145,8 +1160,28 @@ class CoreWorker:
                 logger.exception("done callback failed")
 
     def rpc_report_task_result(self, conn, req_id, payload):
-        """Executor pushed results for a task we own."""
-        task_id: TaskID = payload["task_id"]
+        """Executor pushed results for task(s) we own. Accepts both the
+        legacy single-task payload and the ResultBuffer's multi-task batch
+        (`{"batch": [(task_id, results), ...]}`, applied in completion
+        order); object-state wakeups coalesce into ONE `_obj_cv.notify_all()`
+        per call instead of one per result entry."""
+        batch = payload.get("batch")
+        if batch is None:
+            batch = [(payload["task_id"], payload["results"])]
+        for task_id, results in batch:
+            try:
+                self._handle_task_result(task_id, results)
+            except Exception:
+                # tasks were isolated per-RPC before batching; one bad
+                # entry must not strand the other tasks riding the batch
+                logger.exception("failed to apply results of task %s", task_id)
+        with self._obj_cv:
+            self._obj_cv.notify_all()
+        return True
+
+    def _handle_task_result(self, task_id: TaskID, results) -> None:
+        """Apply one task's reported results. Does NOT notify _obj_cv — the
+        batch handler wakes waiters once per batch."""
         # Application-level retry (cf. reference retry_exceptions): resubmit
         # instead of recording the error while budget remains. The retry
         # decision (read budget, decrement, or pop) is atomic so a concurrent
@@ -1154,7 +1189,7 @@ class CoreWorker:
         with self._pending_lock:
             pend = self._pending_tasks.get(task_id)
             retry = (pend is not None and pend[0].retry_exceptions and pend[1] > 0
-                     and any(e[0] == "error" for e in payload["results"]))
+                     and any(e[0] == "error" for e in results))
             if retry:
                 pend[1] -= 1
                 retries_left = pend[1]
@@ -1164,10 +1199,9 @@ class CoreWorker:
             delay = get_config().task_retry_delay_ms / 1000.0
             spec = pend[0]
             logger.warning("task %s raised; retrying (%d left)", spec.method_name, retries_left)
-            threading.Timer(delay, lambda: self.raylet.notify(
-                "submit_task", {"spec": spec})).start()
-            return True
-        for entry in payload["results"]:
+            self._resubmit_later(spec, delay)
+            return
+        for entry in results:
             kind, oid = entry[0], entry[1]
             contained = ()
             with self._obj_lock:
@@ -1189,7 +1223,6 @@ class CoreWorker:
                 elif kind == "error":
                     st.state = "error"
                     st.inline_blob = entry[2]
-                self._obj_cv.notify_all()
             if contained:
                 self._adopt_contained_refs(oid, contained)
             self._notify_info_waiters(oid)
@@ -1200,10 +1233,9 @@ class CoreWorker:
                 st = self._objects.get(oid)
                 if st is not None:
                     self._maybe_free(oid, st)
-        self._finish_dynamic(task_id, payload["results"])
+        self._finish_dynamic(task_id, results)
         if pend is not None:
             self._unpin_after_task(pend[0])
-        return True
 
     # -------------------------------------------------- dynamic returns
     def rpc_report_dynamic_return(self, conn, req_id, payload):
@@ -1402,9 +1434,7 @@ class CoreWorker:
             logger.warning("task %s worker died (%s); retrying (%d left)",
                            spec.method_name, payload.get("reason") or "crash",
                            retries_left)
-            delay = get_config().task_retry_delay_ms / 1000.0
-            threading.Timer(delay, lambda: self.raylet.notify(
-                "submit_task", {"spec": spec})).start()
+            self._resubmit_later(spec, get_config().task_retry_delay_ms / 1000.0)
             return True
         if payload.get("reason") == "oom":
             from ray_tpu.core.exceptions import OutOfMemoryError
@@ -1495,6 +1525,14 @@ class CoreWorker:
             if st is not None and recorded:
                 st.borrowers = max(0, st.borrowers - 1)
                 self._maybe_free(oid, st)
+        return True
+
+    def rpc_remove_borrowers(self, conn, req_id, payload):
+        """Batched rpc_remove_borrower: one notify releases many borrows
+        (the borrower's owner-notify loop coalesces a GC storm per owner
+        before it reaches the wire)."""
+        for oid in payload["object_ids"]:
+            self.rpc_remove_borrower(conn, req_id, {"object_id": oid})
         return True
 
     def _on_borrower_conn_close(self, conn_key: int) -> None:
@@ -1704,20 +1742,42 @@ class CoreWorker:
     def _owner_notify_loop(self) -> None:
         while not self._shutdown.is_set():
             try:
-                owner, method, payload = self._owner_notify_q.get(timeout=5)
+                item = self._owner_notify_q.get(timeout=5)
             except queue.Empty:
                 with self._owner_notify_lock:
                     if self._owner_notify_q.empty():
                         self._owner_notify_thread = None
                         return  # idle: next release starts a fresh thread
                 continue
-            try:
-                # Same link the borrow was registered over: the owner's
-                # conn-scoped accounting only honors removes that arrive on
-                # the connection that recorded the add.
-                self.reference_counter.owner_link(owner).notify(method, payload)
-            except (OSError, RuntimeError, TimeoutError):
-                logger.debug("%s notify to %s failed", method, owner)
+            # Drain everything already queued: a GC storm's remove_borrower
+            # releases coalesce into ONE batched notify per owner per drain
+            # instead of one RPC per dropped ref (completion-path fast lane).
+            items = [item]
+            while True:
+                try:
+                    items.append(self._owner_notify_q.get_nowait())
+                except queue.Empty:
+                    break
+            sends: List[Tuple[str, str, dict]] = []
+            batches: Dict[str, list] = {}
+            for owner, method, payload in items:
+                if method == "remove_borrower":
+                    b = batches.get(owner)
+                    if b is None:
+                        b = batches[owner] = []
+                        sends.append((owner, "remove_borrowers",
+                                      {"object_ids": b}))
+                    b.append(payload["object_id"])
+                else:
+                    sends.append((owner, method, payload))
+            for owner, method, payload in sends:
+                try:
+                    # Same link the borrow was registered over: the owner's
+                    # conn-scoped accounting only honors removes that arrive
+                    # on the connection that recorded the add.
+                    self.reference_counter.owner_link(owner).notify(method, payload)
+                except (OSError, RuntimeError, TimeoutError):
+                    logger.debug("%s notify to %s failed", method, owner)
 
     def _ensure_free_sweeper(self) -> None:
         if self._free_sweeper is None or not self._free_sweeper.is_alive():
@@ -1865,6 +1925,45 @@ class CoreWorker:
                 self._actor_cv.wait(timeout=0.1)
         self._fail_task(spec, ActorDiedError(f"timed out waiting for actor {actor_id}"))
         return None
+
+    def _resubmit_later(self, spec: TaskSpec, delay: float) -> None:
+        """Schedule a delayed task resubmission on the shared retry timer
+        (one thread for all in-flight retry delays; started lazily, exits
+        when the heap drains)."""
+        with self._resubmit_cv:
+            self._resubmit_seq += 1
+            heapq.heappush(self._resubmit_heap,
+                           (time.monotonic() + delay, self._resubmit_seq, spec))
+            t = self._resubmit_thread
+            if t is None or not t.is_alive():
+                t = threading.Thread(target=self._resubmit_loop,
+                                     name="task-resubmit", daemon=True)
+                self._resubmit_thread = t
+                t.start()
+            self._resubmit_cv.notify_all()
+
+    def _resubmit_loop(self) -> None:
+        while not self._shutdown.is_set():
+            with self._resubmit_cv:
+                if not self._resubmit_heap:
+                    self._resubmit_cv.wait(timeout=1.0)
+                    if not self._resubmit_heap:
+                        # Exit decision under the cv: _resubmit_later holds it
+                        # while pushing + checking liveness, so an item can
+                        # never strand behind a thread that chose to exit.
+                        self._resubmit_thread = None
+                        return
+                due, _, spec = self._resubmit_heap[0]
+                wait = due - time.monotonic()
+                if wait > 0:
+                    self._resubmit_cv.wait(timeout=wait)
+                    continue
+                heapq.heappop(self._resubmit_heap)
+            try:
+                self.raylet.notify("submit_task", {"spec": spec})
+            except Exception:
+                logger.warning("delayed resubmit of %s lost (raylet down?)",
+                               spec.method_name)
 
     def _fail_task(self, spec: TaskSpec, err: Exception) -> None:
         with self._pending_lock:
@@ -2052,6 +2151,7 @@ class CoreWorker:
         elif method == "exit":
             logger.info("worker exiting on raylet request")
             try:
+                self.result_buffer.stop()
                 self.task_events.flush()
             except Exception:
                 pass
@@ -2239,6 +2339,7 @@ class CoreWorker:
         try:
             if spec.task_type == TaskType.ACTOR_TASK:
                 if spec.method_name == "__ray_terminate__":
+                    self.result_buffer.stop()
                     self.task_events.flush()
                     os._exit(0)
                 fn = getattr(self._actor_instance, spec.method_name)
@@ -2321,8 +2422,10 @@ class CoreWorker:
             if spec.owner_address == self.address:
                 self.rpc_report_task_result(None, 0, {"task_id": spec.task_id, "results": results})
             else:
-                self.peer(spec.owner_address).notify(
-                    "report_task_result", {"task_id": spec.task_id, "results": results})
+                # batched fast lane: coalesces per owner under load, delivers
+                # immediately when idle, requeues on a down owner link
+                self.result_buffer.report(spec.owner_address, spec.task_id,
+                                          results)
         except Exception:
             logger.warning("could not deliver results of %s to owner %s",
                            spec.method_name, spec.owner_address)
@@ -2352,6 +2455,7 @@ class CoreWorker:
             if recycle:
                 logger.info("max_calls=%d reached for %s; recycling worker",
                             spec.max_calls, spec.method_name)
+                self.result_buffer.stop()
                 self.task_events.flush()
                 os._exit(0)
 

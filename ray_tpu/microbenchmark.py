@@ -109,6 +109,28 @@ def _submission_metrics(record, quick: bool) -> None:
     record("task_wire_bytes_steady", sizes[1], unit="bytes")
 
 
+def _completion_metrics(record, quick: bool) -> None:
+    """Return-path fast-lane metrics: p50 end-to-end latency of one task
+    (submit -> result landed at the owner, the adaptive-flush idle path) and
+    drain throughput of a deep queue of no-ops (the batched path: dominated
+    by result delivery, task_done handling and scheduler wakeups, not by
+    submission)."""
+    ray_tpu.get(_noop.remote())  # warm worker + export
+    n = 30 if quick else 100
+    lat: List[float] = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        ray_tpu.get(_noop.remote())
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    record("task_e2e_p50", lat[len(lat) // 2] * 1e6, unit="us")
+
+    depth = 200 if quick else 2000
+    t0 = time.perf_counter()
+    ray_tpu.get([_noop.remote() for _ in range(depth)])
+    record("task_completions_per_s", depth / (time.perf_counter() - t0))
+
+
 def run_microbenchmark(batch: int = 100, quick: bool = False) -> List[Dict]:
     """`quick` = CI smoke mode: small batches and short timers so the whole
     suite runs in seconds on CPU while still driving every primitive."""
@@ -163,6 +185,7 @@ def run_microbenchmark(batch: int = 100, quick: bool = False) -> List[Dict]:
            unit="bytes/s")
 
     _submission_metrics(record, quick)
+    _completion_metrics(record, quick)
 
     ray_tpu.kill(a)
     return results

@@ -1,7 +1,21 @@
 """Envelope suite smoke (scaled 1%): the full-scale run is the committed
-ENVELOPE_r{N}.json artifact; this keeps the harness itself green in CI."""
+ENVELOPE_r{N}.json artifact; this keeps the harness itself green in CI —
+and pins regression floors on the core-runtime throughput numbers so the
+control plane can't silently collapse between benchmark rounds."""
 
 import math
+
+# Committed full-scale ENVELOPE_r05.json values (the pre-completion-fast-lane
+# baseline). The smoke runs at 1% scale on a loaded 1-CPU CI box, so the
+# floors carry a generous ~0.5x slack: they catch collapse-class regressions
+# (a redundant per-completion _schedule() pass, an unbatched notify storm),
+# not percent-level drift — that's what the committed artifacts track.
+_R05 = {
+    "submit_per_s": 582.8,
+    "end_to_end_per_s": 80.8,
+    "actor_call_roundtrip": 158.5,
+}
+_SLACK = 0.5
 
 
 def test_envelope_smoke(tmp_path):
@@ -20,3 +34,16 @@ def test_envelope_smoke(tmp_path):
     rates = {r["benchmark"]: r["rate"] for r in art["microbenchmark"]}
     assert all(math.isfinite(v) and v > 0 for v in rates.values())
     assert "hardware" in art and art["hardware"]["cpus"] >= 1
+
+    # --- regression floors vs ENVELOPE_r05.json (ROADMAP item 3) ---
+    q = art["queued_tasks"]
+    assert q["submit_per_s"] >= _SLACK * _R05["submit_per_s"], (
+        f"submit_per_s {q['submit_per_s']} fell below "
+        f"{_SLACK}x the r05 envelope ({_R05['submit_per_s']})")
+    assert q["end_to_end_per_s"] >= _SLACK * _R05["end_to_end_per_s"], (
+        f"end_to_end_per_s {q['end_to_end_per_s']} fell below "
+        f"{_SLACK}x the r05 envelope ({_R05['end_to_end_per_s']})")
+    assert rates["actor_call_roundtrip"] >= \
+        _SLACK * _R05["actor_call_roundtrip"], (
+        f"actor_call_roundtrip {rates['actor_call_roundtrip']} fell below "
+        f"{_SLACK}x the r05 envelope ({_R05['actor_call_roundtrip']})")

@@ -14,7 +14,8 @@ def test_microbenchmark_quick_mode(ray_start_regular):
                 "actor_calls_sync_batch", "actor_call_roundtrip",
                 "actor_echo_1kb_batch", "put_1kb", "put_get_1mb_bytes",
                 "task_submit_p50", "task_wire_bytes_first",
-                "task_wire_bytes_steady"}
+                "task_wire_bytes_steady", "task_e2e_p50",
+                "task_completions_per_s"}
     assert expected <= set(by_name), set(by_name)
     for r in rows:
         assert r["rate"] > 0, r
