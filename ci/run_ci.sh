@@ -1,10 +1,11 @@
 #!/usr/bin/env bash
 # One-command CI for ray_tpu (reference role: .buildkite/pipeline.build.yml).
 #
-#   ci/run_ci.sh            # fast tier + ordering stress x20 + native sanitizers
+#   ci/run_ci.sh            # native sanitizers + fast tier + stress x20 + chaos
 #   ci/run_ci.sh --fast     # fast test tier only
 #   ci/run_ci.sh --native   # native ASAN/UBSAN harness only
 #   ci/run_ci.sh --stress   # actor-ordering stress x20 only
+#   ci/run_ci.sh --chaos    # control-plane HA chaos suite only
 #
 # Stages:
 #   1. native    : arena + scheduler + token-loader compiled whole-program
@@ -14,13 +15,16 @@
 #   2. fast tier : pytest tests/ (the "not slow" default tier).
 #   3. stress    : the actor-ordering race test repeated 20x (the round-1
 #                  ordering bug class must stay dead).
+#   4. chaos     : head-replacement + fault-injection suite under its own
+#                  timeout, with the injection seed printed so any failure
+#                  reproduces exactly.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGE="${1:-all}"
 
 run_native() {
-  echo "=== [1/3] native modules under ASan/UBSan ==="
+  echo "=== [1/4] native modules under ASan/UBSan ==="
   mkdir -p build
   g++ -std=c++17 -O1 -g -fsanitize=address,undefined \
       -fno-omit-frame-pointer -o build/sanitize_native \
@@ -32,7 +36,7 @@ run_native() {
 }
 
 run_fast() {
-  echo "=== [2/3] fast test tier ==="
+  echo "=== [2/4] fast test tier ==="
   python -m pytest tests/ -q
   # core-primitives smoke: the submission AND completion hot paths
   # (function table, event batching, batched result delivery, put/get)
@@ -54,19 +58,35 @@ EOF
 }
 
 run_stress() {
-  echo "=== [3/3] actor ordering stress x20 ==="
+  echo "=== [3/4] actor ordering stress x20 ==="
   for i in $(seq 1 20); do
     python -m pytest tests/test_actor_ordering_stress.py -q -x \
       || { echo "ordering stress failed on iteration $i"; exit 1; }
   done
 }
 
+run_chaos() {
+  echo "=== [4/4] control-plane HA chaos suite ==="
+  # Deterministic fault injection: pin + print the seed so a red run
+  # reproduces bit-for-bit (override by exporting the variable).
+  : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
+  export RAY_TPU_FAULT_INJECTION_SEED
+  echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_head_replacement.py tests/test_fault_injection.py \
+    tests/test_chaos.py tests/test_gcs_fault_tolerance.py \
+    -q -m '' \
+    || { echo "chaos suite failed (seed ${RAY_TPU_FAULT_INJECTION_SEED})"
+         exit 1; }
+}
+
 case "$STAGE" in
   --native) run_native ;;
   --fast)   run_fast ;;
   --stress) run_stress ;;
-  all)      run_native; run_fast; run_stress ;;
-  *) echo "unknown stage: $STAGE (use --native|--fast|--stress)" >&2
+  --chaos)  run_chaos ;;
+  all)      run_native; run_fast; run_stress; run_chaos ;;
+  *) echo "unknown stage: $STAGE (use --native|--fast|--stress|--chaos)" >&2
      exit 2 ;;
 esac
 echo "CI green"

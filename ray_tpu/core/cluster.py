@@ -18,8 +18,14 @@ from ray_tpu.core.raylet import Raylet
 
 
 class Cluster:
-    def __init__(self, gcs_snapshot_path: Optional[str] = None):
-        self.gcs = GcsServer(snapshot_path=gcs_snapshot_path)
+    def __init__(self, gcs_snapshot_path: Optional[str] = None,
+                 snapshot_uri: Optional[str] = None):
+        """`snapshot_uri` selects the control-plane SnapshotStore
+        ("file://<dir>" / "memory://<name>"); `gcs_snapshot_path` is the
+        legacy file spelling. Either enables `restart_gcs()` (same
+        address) and `replace_head()` (NEW address)."""
+        self.gcs = GcsServer(snapshot_path=gcs_snapshot_path,
+                             snapshot_uri=snapshot_uri)
         self.gcs.start()
         self._raylets: list[Raylet] = []
         self.head: Optional[Raylet] = None
@@ -61,10 +67,33 @@ class Cluster:
         workers detect the drop and re-register over their reconnecting
         clients, rebuilding live cluster state."""
         host, port = self.gcs.address.rsplit(":", 1)
-        snapshot = self.gcs._snapshot_path
+        snapshot_uri = self.gcs._snapshot_uri
         self.gcs.stop()
-        self.gcs = GcsServer(host=host, snapshot_path=snapshot, port=int(port))
+        self.gcs = GcsServer(host=host, snapshot_uri=snapshot_uri,
+                             port=int(port))
         self.gcs.start()
+
+    def kill_head(self) -> None:
+        """Crash-stop the GCS (no final snapshot flush, links just drop) —
+        the failure a replacement head must recover from."""
+        self.gcs.kill()
+
+    def replace_head(self) -> str:
+        """Start a REPLACEMENT GCS on a NEW address (control-plane HA): it
+        restores node/actor/PG/KV state from the snapshot store, dials the
+        snapshot-known raylets to announce its address, and the fleet
+        (raylets, workers, drivers) re-registers over re-resolving
+        reconnecting clients with backoff. Call `kill_head()` first to
+        simulate the loss; returns the new GCS address."""
+        host = self.gcs.address.rsplit(":", 1)[0]
+        snapshot_uri = self.gcs._snapshot_uri
+        if not snapshot_uri:
+            raise ValueError("replace_head() needs a snapshot store "
+                             "(pass snapshot_uri= to Cluster)")
+        if not self.gcs._shutdown.is_set():
+            self.gcs.kill()
+        self.gcs = GcsServer(host=host, snapshot_uri=snapshot_uri, port=0)
+        return self.gcs.start()
 
     def remove_node(self, raylet: Raylet) -> None:
         """Simulate node failure: kill raylet + its workers abruptly."""

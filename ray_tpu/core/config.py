@@ -136,6 +136,45 @@ class Config:
     # --- rpc ---
     rpc_connect_timeout_s: float = 30.0
     rpc_call_timeout_s: float = 0.0  # 0 = no timeout
+    # Reconnect loops (control-plane links, owner links) sleep with
+    # exponential backoff + full jitter between attempts (util/backoff.py)
+    # instead of fixed sleeps: after a head replacement every process
+    # reconnects at once, and jitter decorrelates the herd.
+    reconnect_backoff_base_ms: int = 100
+    reconnect_backoff_cap_ms: int = 10000
+
+    # --- control-plane HA (cf. reference gcs_table_storage.h) ---
+    # SnapshotStore URI for GCS persistence: "file:///path" or
+    # "memory://name"; empty = no persistence. A replacement head started
+    # on a NEW address restores node/actor/PG/KV state from this store.
+    gcs_snapshot_uri: str = ""
+    # retained snapshot versions (newest wins; corrupt falls back older)
+    gcs_snapshot_keep: int = 3
+    # Head re-resolution: a file holding the current GCS address. The GCS
+    # writes it at boot; raylets/workers/drivers re-read it on every
+    # reconnect attempt, so a replacement head on a new address is found
+    # without any process restart. Empty = rely on the in-band announce
+    # (the new head dials snapshot-known raylets) + static addresses.
+    gcs_address_file: str = ""
+    # a 2-phase PG bundle prepared but never committed (the head died
+    # between phases) is returned to the node pool after this timeout
+    bundle_prepare_timeout_s: float = 30.0
+
+    # --- fault injection (deterministic chaos; see rpc.FaultInjector) ---
+    # Rules at named client-side RPC boundaries, ";"-separated:
+    #   drop:<method>[:<prob>]          lose the message
+    #   delay:<method>:<ms>[:<prob>]    stall before send
+    #   sever_once:<method>             cut the connection at first match
+    #   sever:<method>[:<prob>]         cut the connection per match
+    # <method> may be "*". Empty = injection disabled (zero overhead).
+    fault_injection_spec: str = ""
+    # seeds the injector's RNG so probabilistic faults replay exactly
+    fault_injection_seed: int = 0
+
+    # --- completion-path retry ---
+    # cap for the owner-down result-redelivery backoff (base is the flush
+    # interval; full jitter)
+    result_retry_backoff_cap_ms: int = 2000
 
     # --- logging / session ---
     session_dir_root: str = "/tmp/ray_tpu"

@@ -40,23 +40,50 @@ class GcsServer:
     def __init__(self, host: str = "127.0.0.1",
                  snapshot_path: Optional[str] = None,
                  snapshot_interval_s: float = 5.0,
-                 port: int = 0):
-        """`snapshot_path` enables control-plane persistence: the durable
-        tables (internal KV and the job table) checkpoint to disk and
-        reload on the next start — the role Redis plays for the reference's
-        HA GCS (`gcs_table_storage.h`, `redis_client.h`). Runtime state
-        (live nodes/actors/PGs) is NOT persisted: raylets and actor workers
-        detect the restart and re-register over their reconnecting clients
-        (reference gcs_redis_failure_detector + component resubscribe), so
-        live state is rebuilt from its sources of truth. A fixed `port`
-        lets a restarted GCS come back on the same address."""
+                 port: int = 0,
+                 snapshot_uri: Optional[str] = None):
+        """Control-plane persistence rides a pluggable `SnapshotStore`
+        (snapshot_store.py — the role Redis plays for the reference's HA
+        GCS, `gcs_table_storage.h`): the durable tables (internal KV, jobs,
+        function table, actor metadata, node table, placement groups)
+        serialize into versioned, checksummed, atomically-swapped blobs
+        selected by `snapshot_uri` ("file://<dir>" or "memory://<name>";
+        `snapshot_path` is the legacy spelling of a file store; config
+        `gcs_snapshot_uri` is the env-driven default). A restarted head on
+        the SAME address rebuilds live state from re-registrations alone; a
+        REPLACEMENT head on a new address additionally restores the node
+        and PG tables from the snapshot, dials the snapshot-known raylets
+        to announce its address, and re-adopts them as they re-register
+        (see _readopt_loop). Actor liveness still comes only from worker
+        re-registration — the snapshot restores identity and restart
+        budgets, never liveness."""
         self._server = rpc.RpcServer(host, port)
         self._server.register_all(self)
         self._lock = threading.RLock()
-        self._snapshot_path = snapshot_path
+        from ray_tpu.core.snapshot_store import VersionedSnapshots, \
+            store_from_uri
+
+        uri = snapshot_uri or (get_config().gcs_snapshot_uri or None)
+        if uri is None and snapshot_path:
+            uri = f"file://{self._migrate_legacy_snapshot(snapshot_path)}"
+        self._snapshot_uri = uri
+        self._snapshots: Optional[VersionedSnapshots] = None
+        if uri:
+            self._snapshots = VersionedSnapshots(
+                store_from_uri(uri), prefix="gcs",
+                keep=get_config().gcs_snapshot_keep)
         self._snapshot_interval_s = snapshot_interval_s
         self._dirty = False
         self._snapshot_write_lock = threading.Lock()
+        # 2-phase PG creations serialize here: a client retry racing the
+        # restored head's resume of the same (idempotent) creation must not
+        # run two concurrent placements and leak the loser's reservations
+        self._pg_2pc_lock = threading.Lock()
+        self._pg_retry_active = False  # one paced PENDING-retry pass at a time
+        # nodes restored from the snapshot, awaiting raylet re-registration
+        # (address -> node_id); the readopt loop dials them to announce the
+        # new head address, and the health loop reaps silent ones
+        self._restored_nodes: Dict[str, bytes] = {}
         # debounced resource fan-out (completion-path fast lane): at most
         # one CH_RESOURCES publish per resource_broadcast_period_ms
         from ray_tpu.util.debounce import Debouncer
@@ -120,29 +147,79 @@ class GcsServer:
         self._shutdown = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
 
+    @staticmethod
+    def _migrate_legacy_snapshot(snapshot_path: str) -> str:
+        """Legacy `snapshot_path` pointed at a single pickle FILE; the
+        store needs a directory. If an old-format file exists there, root
+        the store beside it (`<path>.d`) and import the pickle as version
+        1 — a pre-HA head's snapshot still restores after an upgrade.
+        Returns the directory to root the FileSnapshotStore on."""
+        if not os.path.isfile(snapshot_path):
+            return snapshot_path
+        from ray_tpu.core.snapshot_store import FileSnapshotStore, \
+            VersionedSnapshots
+
+        root = snapshot_path + ".d"
+        try:
+            store = FileSnapshotStore(root)
+            if not store.list_keys(prefix="gcs-"):
+                with open(snapshot_path, "rb") as f:
+                    legacy = f.read()
+                VersionedSnapshots(store, prefix="gcs").save(legacy)
+                logger.info("migrated legacy GCS snapshot %s into store %s",
+                            snapshot_path, root)
+        except Exception:
+            logger.exception("legacy snapshot migration failed; starting "
+                             "from the store at %s", root)
+        return root
+
     # ------------------------------------------------------------------ boot
     def start(self) -> str:
         self._load_snapshot()
         self._server.start()
+        self._write_address_file()
         self._health_thread = threading.Thread(
             target=self._health_loop, name="gcs-health", daemon=True
         )
         self._health_thread.start()
-        if self._snapshot_path:
+        if self._snapshots is not None:
             threading.Thread(target=self._snapshot_loop, name="gcs-snapshot",
+                             daemon=True).start()
+        if self._restored_nodes or any(
+                p.get("state") == "PREPARING" for p in self._pgs.values()):
+            threading.Thread(target=self._readopt_loop, name="gcs-readopt",
                              daemon=True).start()
         logger.info("GCS listening on %s", self._server.address)
         return self._server.address
 
+    def _write_address_file(self) -> None:
+        """Publish this head's address for re-resolution (config
+        gcs_address_file): raylets/workers/drivers re-read the file on
+        every reconnect attempt, so a replacement head on a new address is
+        found without restarting anything. Atomic swap — a reader never
+        sees a half-written address."""
+        path = get_config().gcs_address_file
+        if not path:
+            return
+        try:
+            tmp = f"{path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(self._server.address)
+            os.replace(tmp, path)
+        except OSError:
+            logger.exception("could not write GCS address file %s", path)
+
     # ------------------------------------------------------- persistence
     def _load_snapshot(self) -> None:
-        if not self._snapshot_path or not os.path.exists(self._snapshot_path):
+        if self._snapshots is None:
             return
         import pickle
 
         try:
-            with open(self._snapshot_path, "rb") as f:
-                data = pickle.load(f)
+            payload = self._snapshots.load_latest()
+            if payload is None:
+                return
+            data = pickle.loads(payload)
             with self._lock:
                 self._kv = data.get("kv", {})
                 self._functions = data.get("functions", {})
@@ -175,10 +252,33 @@ class GcsServer:
                     if m["name"]:
                         self._named_actors[(m["namespace"], m["name"])] = aid
                     self._awaiting_rereg[aid] = time.monotonic()
+                # Node table: restored entries let a REPLACEMENT head (new
+                # address) know which raylets exist and where, so it can
+                # dial them and announce itself (_readopt_loop). They stay
+                # provisional ("restored") until the raylet re-registers;
+                # the heartbeat timeout reaps ones that never do.
+                now = time.monotonic()
+                for nid, n in data.get("nodes", {}).items():
+                    n = dict(n)
+                    n["alive"] = True
+                    n["restored"] = True
+                    self._nodes[nid] = n
+                    self._last_heartbeat[nid] = now
+                    self._restored_nodes[n["address"]] = nid
+                # Placement groups: bundle reservations live on in the
+                # raylets (which survived the head), so the restored table
+                # — bundles, strategy, bundle->node placement — makes PG
+                # state consistent again the moment nodes re-register. A
+                # creation the old head died inside (PREPARING) is resumed
+                # or failed by the readopt loop; it must not hang forever.
+                for pid, p in data.get("pgs", {}).items():
+                    self._pgs[pid] = dict(p)
             logger.info("GCS restored %d KV namespaces, %d jobs, %d actor "
-                        "records from %s",
+                        "records, %d nodes, %d placement groups from %s",
                         len(self._kv), len(data.get("jobs", {})),
-                        len(data.get("actor_meta", {})), self._snapshot_path)
+                        len(data.get("actor_meta", {})),
+                        len(data.get("nodes", {})), len(data.get("pgs", {})),
+                        self._snapshot_uri)
         except Exception:
             logger.exception("snapshot restore failed; starting fresh")
 
@@ -205,13 +305,25 @@ class GcsServer:
                                   # a restored actor needs the class blob
                                   "spec": self._actor_specs.get(aid)}
                             for aid, i in self._actors.items()
-                            if i.state != ActorState.DEAD}}
+                            if i.state != ActorState.DEAD},
+                        # node table: a replacement head must know which
+                        # raylets to dial (per-node live stats stay out —
+                        # they are rebuilt from heartbeats)
+                        "nodes": {
+                            nid: {k: n[k] for k in (
+                                "node_id", "address", "object_store_address",
+                                "resources_total", "resources_available",
+                                "labels", "start_time")}
+                            for nid, n in self._nodes.items() if n["alive"]},
+                        # placement groups with their bundle->node
+                        # assignments: raylets keep the reservations, the
+                        # head keeps the map (satellite: a restored head
+                        # must not forget PGs whose bundles still run)
+                        "pgs": {pid: dict(p)
+                                for pid, p in self._pgs.items()}}
                 self._dirty = False
             try:
-                tmp = f"{self._snapshot_path}.tmp{os.getpid()}"
-                with open(tmp, "wb") as f:
-                    pickle.dump(data, f)
-                os.replace(tmp, self._snapshot_path)
+                self._snapshots.save(pickle.dumps(data, protocol=5))
             except Exception:
                 self._dirty = True  # failed write must be retried
                 raise
@@ -225,17 +337,96 @@ class GcsServer:
                     logger.exception("snapshot write failed")
         # stop() performs the final flush (single writer, serialized above)
 
+    def _readopt_loop(self) -> None:
+        """Replacement-head re-adoption: dial every snapshot-known raylet,
+        announce the new head address (the in-band 'callback' flavor of
+        re-resolution — works with no address file), and reconnect the
+        GCS->raylet dispatch clients. Then resume any placement-group
+        creation the old head died inside: with idempotent prepare_bundle
+        on the raylets, re-running the 2-phase protocol either completes
+        the PG or marks it INFEASIBLE — clients polling it never hang."""
+        with self._lock:
+            targets = dict(self._restored_nodes)
+        for address, node_id in targets.items():
+            if self._shutdown.is_set():
+                return
+            try:
+                client = rpc.connect_with_retry(address, timeout=5)
+            except Exception:
+                # raylet gone with the old head; the heartbeat timeout
+                # will reap its restored entry
+                logger.info("restored node %s at %s unreachable",
+                            node_id.hex()[:8], address)
+                continue
+            try:
+                client.notify("new_gcs_address",
+                              {"address": self._server.address})
+            except OSError:
+                client.close()
+                continue
+            with self._lock:
+                n = self._nodes.get(node_id)
+                if n is not None and n.get("restored"):
+                    old = self._raylet_clients.get(node_id)
+                    self._raylet_clients[node_id] = client
+                    self._last_heartbeat[node_id] = time.monotonic()
+                else:
+                    # re-registration beat us: keep its client, drop ours
+                    old = client
+            if old is not None:
+                old.close()
+        # interrupted 2-phase creations: finish or fail them
+        with self._lock:
+            preparing = [pid for pid, p in self._pgs.items()
+                         if p.get("state") == "PREPARING"]
+        for pid in preparing:
+            if self._shutdown.is_set():
+                return
+            with self._lock:
+                p = self._pgs.get(pid)
+                if p is None or p.get("state") != "PREPARING":
+                    continue
+                bundles, strategy, name = p["bundles"], p["strategy"], p.get("name")
+            try:
+                result = self._create_placement_group(pid, bundles, strategy,
+                                                      name)
+            except Exception as e:
+                # one bad resume must not kill the thread and strand every
+                # LATER interrupted group in PREPARING forever
+                logger.exception("resume of placement group %s failed", pid)
+                result = {"ok": False, "error": f"resume failed: {e}"}
+            if not result.get("ok"):
+                with self._lock:
+                    p = self._pgs.get(pid)
+                    if p is not None and p.get("state") != "CREATED":
+                        p["state"] = "INFEASIBLE"
+                        p["error"] = result.get("error", "resume failed")
+                        self._dirty = True
+                logger.warning("placement group %s interrupted by head "
+                               "replacement could not be completed: %s",
+                               pid, result.get("error"))
+
     @property
     def address(self) -> str:
         return self._server.address
 
     def stop(self) -> None:
         self._shutdown.set()
-        if self._snapshot_path and self._dirty:
+        if self._snapshots is not None and self._dirty:
             try:
                 self._write_snapshot()
             except OSError:
                 logger.exception("final snapshot flush failed")
+        for c in self._raylet_clients.values():
+            c.close()
+        self._server.stop()
+
+    def kill(self) -> None:
+        """Crash-stop for HA tests: tear the process-level state down the
+        way a SIGKILLed head would leave it — NO final snapshot flush (a
+        replacement restores from whatever the periodic loop last wrote),
+        connections just dropped."""
+        self._shutdown.set()
         for c in self._raylet_clients.values():
             c.close()
         self._server.stop()
@@ -307,6 +498,7 @@ class GcsServer:
     def rpc_register_node(self, conn, req_id, payload):
         node_id: bytes = payload["node_id"]
         with self._lock:
+            stale = self._raylet_clients.pop(node_id, None)
             self._nodes[node_id] = {
                 "node_id": node_id,
                 "address": payload["address"],
@@ -319,11 +511,32 @@ class GcsServer:
                 "alive": True,
                 "start_time": payload.get("start_time") or time.time(),
             }
+            self._restored_nodes.pop(payload["address"], None)
             self._last_heartbeat[node_id] = time.monotonic()
+            self._dirty = True  # membership is snapshot state
             try:
                 self._raylet_clients[node_id] = rpc.connect_with_retry(payload["address"], timeout=10)
             except Exception:
                 logger.exception("GCS could not connect back to raylet %s", payload["address"])
+        if stale is not None:
+            stale.close()
+        # Bundle re-pinning: the raylet reports the PG bundle reservations
+        # it still holds. A head replacement may have restored a snapshot
+        # older than a commit — adopt the raylet's committed bundles into
+        # the known PG table so placement reflects what the fleet actually
+        # holds (the raylet, not the snapshot, is the source of truth for
+        # reservations it charged).
+        with self._lock:
+            for b in payload.get("bundles", ()):
+                pg = self._pgs.get(b["pg_id"])
+                if pg is None or not b.get("committed"):
+                    continue
+                placement = pg.get("placement")
+                idx = b["bundle_index"]
+                if placement is not None and idx < len(placement) \
+                        and placement[idx] != node_id:
+                    placement[idx] = node_id
+                    self._dirty = True
         self._publish(CH_NODES, {"event": "added", "node": self._public_node(node_id)})
         self._broadcast_resources(force=True)
         return {"nodes": [self._public_node(n) for n in self._nodes]}
@@ -446,6 +659,85 @@ class GcsServer:
                 self._publish(CH_ACTORS, {
                     "actor_id": aid, "state": "DEAD", "address": "",
                     "death_cause": info.death_cause})
+            # PENDING placement groups are retryable (transient prepare
+            # failure, capacity that has since arrived): re-run their 2PC
+            # off-thread, paced, so a blip never strands a group forever.
+            self._maybe_retry_pending_pgs()
+
+    _PG_RETRY_INTERVAL_S = 5.0
+
+    def _maybe_retry_pending_pgs(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if self._pg_retry_active or self._shutdown.is_set():
+                return
+            if not any(n["alive"] for n in self._nodes.values()):
+                return
+            due = [pid for pid, p in self._pgs.items()
+                   if p.get("state") == "PENDING"
+                   and now - p.get("_last_attempt", 0.0)
+                   > self._PG_RETRY_INTERVAL_S]
+            if not due:
+                return
+            self._pg_retry_active = True
+
+        def run():
+            try:
+                for pid in due:
+                    if self._shutdown.is_set():
+                        return
+                    with self._lock:
+                        p = self._pgs.get(pid)
+                        if p is None or p.get("state") != "PENDING":
+                            continue
+                        bundles, strategy = p["bundles"], p["strategy"]
+                        name = p.get("name")
+                    try:
+                        self._create_placement_group(pid, bundles, strategy,
+                                                     name)
+                    except Exception:
+                        logger.exception("retry of pending placement group "
+                                         "failed")
+                    finally:
+                        # stamped AFTER the attempt (creation overwrites the
+                        # entry) so the pace holds even across failures
+                        with self._lock:
+                            p = self._pgs.get(pid)
+                            if p is not None:
+                                p["_last_attempt"] = time.monotonic()
+            finally:
+                with self._lock:
+                    self._pg_retry_active = False
+
+        threading.Thread(target=run, name="gcs-pg-retry", daemon=True).start()
+
+    def _raylet_client(self, node_id: bytes) -> Optional[rpc.RpcClient]:
+        """Live dispatch client for a node, reconnecting a dead one (a
+        severed link — injected fault, transient network blip — must not
+        permanently cut the head off from an otherwise-alive raylet)."""
+        with self._lock:
+            c = self._raylet_clients.get(node_id)
+            n = self._nodes.get(node_id)
+        if c is not None and not c.closed:
+            return c
+        if n is None or not n.get("alive"):
+            return None
+        try:
+            fresh = rpc.connect_with_retry(n["address"], timeout=3)
+        except Exception:
+            logger.info("could not reconnect to raylet %s at %s",
+                        node_id.hex()[:8], n["address"])
+            return None
+        with self._lock:
+            cur = self._raylet_clients.get(node_id)
+            if cur is not None and not cur.closed:
+                keep = cur  # a re-registration raced us in; use its client
+            else:
+                self._raylet_clients[node_id] = fresh
+                keep = fresh
+        if keep is not fresh:
+            fresh.close()
+        return keep
 
     def _mark_node_dead(self, node_id: bytes, reason: str) -> None:
         with self._lock:
@@ -453,6 +745,8 @@ class GcsServer:
             if n is None or not n["alive"]:
                 return
             n["alive"] = False
+            self._restored_nodes.pop(n.get("address"), None)
+            self._dirty = True  # membership is snapshot state
             client = self._raylet_clients.pop(node_id, None)
         if client:
             client.close()
@@ -742,9 +1036,9 @@ class GcsServer:
         if target is None:
             return False
         with self._lock:
-            client = self._raylet_clients.get(target)
             info = self._actors[actor_id]
             info.node_id = target
+        client = self._raylet_client(target)
         if client is None:
             return False
         try:
@@ -914,60 +1208,120 @@ class GcsServer:
 
     # ------------------------------------------------------------ placement
     def rpc_create_placement_group(self, conn, req_id, payload):
-        """2-phase bundle reservation (cf. gcs_placement_group_scheduler.h)."""
-        pg_id: PlacementGroupID = payload["pg_id"]
-        bundles: List[Dict[str, float]] = payload["bundles"]
-        strategy: str = payload["strategy"]
-        name = payload.get("name")
-        with self._lock:
-            views = [
-                NodeView(nid, n["resources_total"], n["resources_available"], n["labels"])
-                for nid, n in self._nodes.items()
-                if n["alive"]
-            ]
-        placement = self._policy.place_bundles(views, bundles, strategy)
-        if placement is None:
-            self._pgs[pg_id] = {"state": "PENDING", "bundles": bundles,
-                                "strategy": strategy, "name": name, "placement": None}
-            return {"ok": False, "error": "infeasible"}
-        # Phase 1: prepare on each raylet; rollback on any failure.
-        prepared = []
-        ok = True
-        for idx, node_id in enumerate(placement):
-            client = self._raylet_clients.get(node_id)
-            if client is None:
-                ok = False
-                break
+        """2-phase bundle reservation (cf. gcs_placement_group_scheduler.h),
+        run OFF the RPC loop (prepare calls block) and replied via
+        Deferred. Idempotent per pg_id: a client whose create call died
+        with the old head re-sends it to the replacement, which either
+        finds the PG already CREATED (snapshot/resume) or re-runs the
+        protocol — raylet-side prepare_bundle is idempotent, so a bundle
+        the old head already reserved is not double-charged."""
+        threading.Thread(
+            target=self._create_pg_and_reply,
+            args=(conn, req_id, payload), name="gcs-pg-create",
+            daemon=True).start()
+        return rpc.RpcServer.DEFERRED
+
+    def _create_pg_and_reply(self, conn, req_id, payload) -> None:
+        try:
+            result = self._create_placement_group(
+                payload["pg_id"], payload["bundles"], payload["strategy"],
+                payload.get("name"))
+        except Exception as e:
+            logger.exception("placement group creation failed")
+            result = f"placement group creation failed: {e}"
             try:
-                r = client.call("prepare_bundle", {
-                    "pg_id": pg_id, "bundle_index": idx, "resources": bundles[idx]}, timeout=10)
-            except (OSError, TimeoutError, rpc.RpcCallError,
-                    rpc.RpcDisconnected) as e:
-                logger.info("prepare_bundle on %s failed: %s",
-                            node_id.hex()[:8], e)
-                r = False
-            if not r:
-                ok = False
-                break
-            prepared.append((idx, node_id))
-        if not ok:
+                conn.reply(req_id, result, is_error=True)
+            except (OSError, RuntimeError):
+                pass  # head shutting down mid-creation; client will retry
+            return
+        try:
+            conn.reply(req_id, result)
+        except (OSError, RuntimeError):
+            pass  # head shutting down mid-creation; client will retry
+
+    def _create_placement_group(self, pg_id: PlacementGroupID,
+                                bundles: List[Dict[str, float]],
+                                strategy: str, name) -> dict:
+        with self._pg_2pc_lock:
+            with self._lock:
+                existing = self._pgs.get(pg_id)
+                if existing is not None and existing.get("state") == "CREATED":
+                    return {"ok": True, "placement": existing["placement"]}
+                # PREPARING is durable: if this head dies mid-protocol, its
+                # replacement sees the marker and resumes or fails the PG
+                # instead of leaving clients polling forever.
+                self._pgs[pg_id] = {
+                    "state": "PREPARING", "bundles": bundles,
+                    "strategy": strategy, "name": name, "placement": None}
+                self._dirty = True
+                views = [
+                    NodeView(nid, n["resources_total"], n["resources_available"], n["labels"])
+                    for nid, n in self._nodes.items()
+                    if n["alive"]
+                ]
+            placement = self._policy.place_bundles(views, bundles, strategy)
+            if placement is None:
+                with self._lock:
+                    self._pgs[pg_id].update(state="PENDING", placement=None)
+                    self._dirty = True
+                return {"ok": False, "error": "infeasible"}
+            # Phase 1: prepare on each raylet; rollback on any failure.
+            prepared = []
+            ok = True
+            for idx, node_id in enumerate(placement):
+                client = self._raylet_client(node_id)
+                if client is None:
+                    ok = False
+                    break
+                try:
+                    r = client.call("prepare_bundle", {
+                        "pg_id": pg_id, "bundle_index": idx, "resources": bundles[idx]}, timeout=10)
+                except (OSError, TimeoutError, rpc.RpcCallError,
+                        rpc.RpcDisconnected) as e:
+                    logger.info("prepare_bundle on %s failed: %s",
+                                node_id.hex()[:8], e)
+                    r = False
+                if not r:
+                    ok = False
+                    break
+                prepared.append((idx, node_id))
+            if not ok:
+                for idx, node_id in prepared:
+                    c = self._raylet_client(node_id)
+                    if c:
+                        try:
+                            c.notify("return_bundle", {"pg_id": pg_id, "bundle_index": idx})
+                        except OSError as e:
+                            logger.debug("return_bundle to dead raylet: %s", e)
+                # PENDING is retryable: the paced health-loop retry re-runs
+                # the 2PC, so a transient prepare failure (link blip, node
+                # mid-death) heals instead of stranding the group
+                with self._lock:
+                    self._pgs[pg_id].update(state="PENDING", placement=None)
+                    self._dirty = True
+                return {"ok": False, "error": "prepare failed"}
+            # Phase 2: commit. Tolerant per node: a raylet dying between
+            # prepare and commit must not blow up the whole creation — its
+            # uncommitted reservation returns via the 2PC orphan reaper and
+            # the node-death path fails over whatever ran there.
             for idx, node_id in prepared:
-                c = self._raylet_clients.get(node_id)
-                if c:
-                    try:
-                        c.notify("return_bundle", {"pg_id": pg_id, "bundle_index": idx})
-                    except OSError as e:
-                        logger.debug("return_bundle to dead raylet: %s", e)
-            return {"ok": False, "error": "prepare failed"}
-        # Phase 2: commit.
-        for idx, node_id in prepared:
-            self._raylet_clients[node_id].notify("commit_bundle", {"pg_id": pg_id, "bundle_index": idx})
-        with self._lock:
-            self._pgs[pg_id] = {
-                "state": "CREATED", "bundles": bundles, "strategy": strategy,
-                "name": name, "placement": placement,
-            }
-        return {"ok": True, "placement": placement}
+                client = self._raylet_client(node_id)
+                try:
+                    if client is None:
+                        raise OSError("raylet client gone")
+                    client.notify("commit_bundle",
+                                  {"pg_id": pg_id, "bundle_index": idx})
+                except OSError as e:
+                    logger.warning(
+                        "commit_bundle (%s, %d) to %s lost: %s", pg_id, idx,
+                        node_id.hex()[:8], e)
+            with self._lock:
+                self._pgs[pg_id] = {
+                    "state": "CREATED", "bundles": bundles, "strategy": strategy,
+                    "name": name, "placement": placement,
+                }
+                self._dirty = True
+            return {"ok": True, "placement": placement}
 
     def rpc_get_placement_group(self, conn, req_id, payload):
         with self._lock:
@@ -983,6 +1337,7 @@ class GcsServer:
         pg_id = payload["pg_id"]
         with self._lock:
             pg = self._pgs.pop(pg_id, None)
+            self._dirty = self._dirty or pg is not None
         if pg and pg.get("placement"):
             for idx, node_id in enumerate(pg["placement"]):
                 c = self._raylet_clients.get(node_id)

@@ -27,7 +27,13 @@ def main(argv=None) -> None:
                         help='node labels JSON, e.g. \'{"tpu_slice": "s0"}\'')
     parser.add_argument("--object-store-memory", type=int, default=None)
     parser.add_argument("--snapshot-path", default=None,
-                        help="persist GCS KV/job tables here (head only)")
+                        help="legacy file path for GCS persistence "
+                             "(head only); prefer --snapshot-uri")
+    parser.add_argument("--snapshot-uri", default=None,
+                        help="SnapshotStore URI for control-plane HA "
+                             "(file:///dir or memory://name, head only): a "
+                             "replacement head restores node/actor/PG/KV "
+                             "state from it, even on a new address")
     parser.add_argument("--gcs-port", type=int, default=0,
                         help="fixed GCS port (head only; cluster-launcher "
                              "startup scripts need a known join address)")
@@ -54,6 +60,7 @@ def main(argv=None) -> None:
     gcs = None
     if args.head:
         gcs = GcsServer(snapshot_path=args.snapshot_path,
+                        snapshot_uri=args.snapshot_uri,
                         port=args.gcs_port, host=args.gcs_host)
         gcs_address = gcs.start()
         print(f"ray_tpu head started. GCS address: {gcs_address}")

@@ -32,8 +32,36 @@ class PlacementGroup:
             info = w.gcs.call("get_placement_group", {"pg_id": self.id})
             if info and info["state"] == "CREATED":
                 return True
+            if info and info["state"] == "INFEASIBLE":
+                # terminal: a replacement head resumed this group's
+                # interrupted creation and could not satisfy it — polling
+                # longer will never help
+                return False
             time.sleep(0.05)
         return False
+
+    def ready_or_raise(self, timeout: float = 30.0) -> "PlacementGroup":
+        """`ready()` that surfaces terminal infeasibility as the typed
+        `PlacementInfeasibleError` (matched BY TYPE by elastic shrink and
+        chaos tests) instead of an indistinguishable False/hang."""
+        from ray_tpu.core.api import _global_worker
+        from ray_tpu.core.exceptions import PlacementInfeasibleError
+
+        w = _global_worker()
+        deadline = time.monotonic() + timeout
+        info = None
+        while time.monotonic() < deadline:
+            info = w.gcs.call("get_placement_group", {"pg_id": self.id})
+            if info and info["state"] == "CREATED":
+                return self
+            if info and info["state"] in ("INFEASIBLE", "PENDING"):
+                raise PlacementInfeasibleError(
+                    f"placement group {self.id.hex()[:8]} infeasible: "
+                    f"{info.get('error', 'no feasible placement')}")
+            time.sleep(0.05)
+        raise PlacementInfeasibleError(
+            f"placement group {self.id.hex()[:8]} not created within "
+            f"{timeout}s (state: {info['state'] if info else 'unknown'})")
 
     @property
     def bundle_count(self) -> int:

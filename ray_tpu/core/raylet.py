@@ -159,6 +159,18 @@ class Raylet:
         # per-pg bundle reservations: (pg_id, idx) -> remaining resources
         self._bundles: Dict[Tuple, Dict[str, float]] = {}
         self._bundles_committed: Dict[Tuple, bool] = {}
+        # original reservation per bundle (re-reported to the GCS on
+        # re-registration so a replacement head re-pins them) + prepare
+        # time for 2PC orphan cleanup (a head that died between prepare
+        # and commit leaks the reservation; the reaper returns it)
+        self._bundle_reservations: Dict[Tuple, Dict[str, float]] = {}
+        self._bundle_prepared_at: Dict[Tuple, float] = {}
+
+        # head re-resolution: a new GCS address learned in-band (the
+        # replacement head dials us and announces itself) overrides the
+        # boot-time address; the address file (config gcs_address_file)
+        # overrides both. Read on every reconnect attempt.
+        self._gcs_address_override: Optional[str] = None
 
         # object pulls in flight: object_id -> list[(conn, req_id)] waiting
         self._pending_pulls: Dict[ObjectID, List[Tuple]] = {}
@@ -185,10 +197,13 @@ class Raylet:
     def start(self) -> str:
         self._server.start()
         # Reconnecting link: a restarted GCS gets this node re-registered and
-        # re-subscribed before any other call proceeds (GCS fault tolerance).
+        # re-subscribed before any other call proceeds (GCS fault tolerance);
+        # the resolver lets the link follow a REPLACEMENT head to a new
+        # address (control-plane HA).
         self._gcs = rpc.ReconnectingClient(
             self.gcs_address, push_handler=self._on_gcs_push,
-            on_reconnect=self._replay_gcs_registration)
+            on_reconnect=self._replay_gcs_registration,
+            resolve=self._resolve_gcs_address)
         reply = self._gcs.call("register_node", self._registration_payload())
         for n in reply["nodes"]:
             self._note_node(n)
@@ -214,6 +229,14 @@ class Raylet:
     def _registration_payload(self) -> dict:
         with self._lock:
             available = dict(self.resources_available)
+            # PG bundle re-pinning: report the reservations this node still
+            # holds so a replacement head (whose snapshot may trail a
+            # commit) re-anchors its PG table to what the fleet holds
+            bundles = [
+                {"pg_id": key[0], "bundle_index": key[1],
+                 "resources": dict(self._bundle_reservations.get(key, {})),
+                 "committed": bool(self._bundles_committed.get(key))}
+                for key in self._bundles]
         return {
             "node_id": self.node_id.binary(),
             "address": self._server.address,
@@ -222,19 +245,62 @@ class Raylet:
             # must not advertise full capacity for a saturated node.
             "resources_available": available,
             "labels": self.labels,
+            "bundles": bundles,
             "start_time": self._start_time,
         }
+
+    def _resolve_gcs_address(self) -> Optional[str]:
+        """Current-best GCS address for a reconnect attempt: the address
+        file (authoritative — operators/replacement heads publish there)
+        beats the in-band announce, which beats the boot-time address."""
+        return rpc.read_gcs_address_file() or self._gcs_address_override
 
     def _replay_gcs_registration(self, raw: rpc.RpcClient) -> None:
         """Re-register on a fresh GCS connection (uses the RAW client — the
         wrapper's lock is held during replay)."""
         reply = raw.call("register_node", self._registration_payload(), timeout=30)
+        # the link may have followed a head replacement: workers spawned
+        # from now on (and rpc_get_gcs_address callers) get the live head
+        self.gcs_address = raw.address
         for n in reply.get("nodes", []):
             self._note_node(n)
         raw.call("subscribe", {"channels": ["resources", "nodes", "control"]},
                  timeout=30)
-        logger.info("raylet %s re-registered with restarted GCS",
-                    self.node_id.hex()[:8])
+        logger.info("raylet %s re-registered with GCS at %s",
+                    self.node_id.hex()[:8], raw.address)
+
+    def rpc_new_gcs_address(self, conn, req_id, payload):
+        """In-band head-replacement announce: a replacement GCS restored
+        this node from its snapshot and is telling us where it lives now.
+        Records the override and kicks the reconnect loop by dropping the
+        stale link (off-thread — this runs on the RPC loop)."""
+        address = payload["address"]
+        if address == self.gcs_address and self._gcs is not None \
+                and not self._gcs.closed:
+            cli = getattr(self._gcs, "_client", None)
+            if cli is not None and not cli.closed:
+                return True  # same head, live link: nothing to do
+        self._gcs_address_override = address
+        logger.info("raylet %s: GCS announced new address %s",
+                    self.node_id.hex()[:8], address)
+
+        def kick():
+            gcs = self._gcs
+            if gcs is None or gcs.closed:
+                return
+            cli = getattr(gcs, "_client", None)
+            if cli is not None and not cli.closed:
+                cli.close()  # on_disconnect schedules the reconnect
+
+        threading.Thread(target=kick, name="gcs-address-kick",
+                         daemon=True).start()
+        return True
+
+    def rpc_get_gcs_address(self, conn, req_id, payload):
+        """Workers/drivers re-resolve the head through their raylet: the
+        raylet's own reconnect loop tracks the replacement head, so its
+        current gcs_address is the freshest in-band answer."""
+        return self._gcs_address_override or self.gcs_address
 
     def stop(self) -> None:
         self._shutdown.set()
@@ -749,6 +815,25 @@ class Raylet:
                         min_idle_s=cfg.idle_worker_killing_time_s)
                 except Exception:
                     logger.exception("runtime env gc failed")
+            # 2PC orphan cleanup: a bundle PREPARED but never committed
+            # means the head died (or gave up) between phases — nothing
+            # will ever commit or return it, so the reservation would leak
+            # node capacity forever. Return it after the prepare timeout
+            # (a resumed creation re-prepares it idempotently first).
+            now_mono = time.monotonic()
+            prep_timeout = cfg.bundle_prepare_timeout_s
+            with self._lock:
+                orphans = [k for k, t in self._bundle_prepared_at.items()
+                           if not self._bundles_committed.get(k)
+                           and now_mono - t > prep_timeout]
+            for pg_id, idx in orphans:
+                pid = pg_id.hex()[:8] if hasattr(pg_id, "hex") else str(pg_id)
+                logger.warning(
+                    "returning orphaned uncommitted bundle (%s, %d): "
+                    "prepared over %.0fs ago, never committed",
+                    pid, idx, prep_timeout)
+                self.rpc_return_bundle(None, 0, {
+                    "pg_id": pg_id, "bundle_index": idx})
             with self._lock:
                 starting = list(self._starting)
             for p in starting:
@@ -1304,13 +1389,25 @@ class Raylet:
         key = (payload["pg_id"], payload["bundle_index"])
         resources = payload["resources"]
         with self._lock:
+            if key in self._bundles:
+                # Idempotent re-prepare: a replacement head resuming an
+                # interrupted 2-phase creation (or a client retry of the
+                # create RPC) re-sends prepares the old head already made;
+                # the reservation is held — re-charging it would leak. The
+                # prepare clock RESTARTS (a creation is actively in flight
+                # again — the orphan reaper must not fire mid-resume).
+                if not self._bundles_committed.get(key):
+                    self._bundle_prepared_at[key] = time.monotonic()
+                return True
             if not all(self.resources_available.get(r, 0.0) + 1e-9 >= q
                        for r, q in resources.items()):
                 return False
             for r, q in resources.items():
                 self.resources_available[r] = self.resources_available.get(r, 0.0) - q
             self._bundles[key] = dict(resources)
+            self._bundle_reservations[key] = dict(resources)
             self._bundles_committed[key] = False
+            self._bundle_prepared_at[key] = time.monotonic()
         self._report_resources()
         return True
 
@@ -1318,6 +1415,7 @@ class Raylet:
         key = (payload["pg_id"], payload["bundle_index"])
         with self._lock:
             self._bundles_committed[key] = True
+            self._bundle_prepared_at.pop(key, None)
         return True
 
     def rpc_return_bundle(self, conn, req_id, payload):
@@ -1325,6 +1423,8 @@ class Raylet:
         with self._lock:
             pool = self._bundles.pop(key, None)
             self._bundles_committed.pop(key, None)
+            self._bundle_reservations.pop(key, None)
+            self._bundle_prepared_at.pop(key, None)
             if pool is None:
                 return True
             # return the bundle's original reservation to the node

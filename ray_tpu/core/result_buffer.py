@@ -19,9 +19,13 @@ submits/s vs 81 completions/s). This buffer coalesces results PER OWNER:
   never wait out the interval.
 - **No silent loss**: a flush whose owner link is down requeues the batch
   (ahead of anything buffered since, preserving completion order) and
-  retries on the next flush, bounded by `result_delivery_max_attempts`
-  before the results are dropped with a warning — the same at-least-tried
-  contract TaskEventBuffer's try_notify requeue gives task events.
+  retries, bounded by `result_delivery_max_attempts` before the results
+  are dropped with a warning — the same at-least-tried contract
+  TaskEventBuffer's try_notify requeue gives task events. Self-scheduled
+  retries back off exponentially with full jitter (util/backoff.py, base
+  = the flush interval, cap = `result_retry_backoff_cap_ms`) instead of
+  hammering a dead owner every interval; an explicit flush (new results,
+  shutdown) still retries immediately, preserving per-owner order.
 
 The owner side (`CoreWorker.rpc_report_task_result`) accepts the multi-task
 `{"batch": [(task_id, results), ...]}` payload and collapses the per-task
@@ -49,6 +53,10 @@ class ResultBuffer:
         # owner address -> [[task_id, results, attempts], ...] in completion
         # order (OrderedDict so flush delivers owners in first-result order)
         self._buffers: "OrderedDict[str, List[list]]" = OrderedDict()
+        # owners backing off after failed deliveries:
+        # owner -> [not_before_monotonic, [[task_id, results, attempts]...]]
+        # — re-merged AHEAD of newer results at the next flush
+        self._deferred: "OrderedDict[str, list]" = OrderedDict()
         # monotonic deadline of the scheduled flush; None = no flush claimed.
         # Also the immediate path's claim token: concurrent reporters that
         # see it non-None just append and ride the claimed flush.
@@ -73,6 +81,13 @@ class ResultBuffer:
         flight."""
         interval = get_config().result_buffer_flush_interval_ms / 1000.0
         with self._lock:
+            if not self._stopped and owner in self._deferred:
+                # the owner is backing off after failed deliveries: join the
+                # deferred batch so completion order holds when it re-merges
+                self._deferred[owner][1].append([task_id, results, 0])
+                self._ensure_thread_locked()
+                self._cond.notify_all()
+                return
             self._buffers.setdefault(owner, []).append([task_id, results, 0])
             if self._stopped:
                 # after stop() no thread will ever drain a deferred flush:
@@ -99,6 +114,13 @@ class ResultBuffer:
         """Deliver everything buffered, one notify per owner."""
         with self._flush_mutex:
             with self._lock:
+                # deferred batches re-merge AHEAD of anything buffered since
+                # (per-owner completion order is the contract); any flush
+                # retries them — the backoff only paces the SELF-scheduled
+                # retry wakeups, never delays an explicit flush
+                for owner, (_t, items) in list(self._deferred.items()):
+                    self._buffers.setdefault(owner, [])[:0] = items
+                self._deferred.clear()
                 buffers, self._buffers = self._buffers, OrderedDict()
                 self._deadline = None
                 self._last_flush = time.monotonic()
@@ -153,7 +175,12 @@ class ResultBuffer:
                     "after %d delivery attempts", tid, owner, attempts + 1)
         if not keep:
             return
-        interval = get_config().result_buffer_flush_interval_ms / 1000.0
+        cfg = get_config()
+        from ray_tpu.util.backoff import ExponentialBackoff
+
+        backoff = ExponentialBackoff(
+            base_s=max(0.001, cfg.result_buffer_flush_interval_ms / 1000.0),
+            cap_s=max(0.001, cfg.result_retry_backoff_cap_ms / 1000.0))
         with self._lock:
             if self._stopped:
                 # the process is exiting; nothing will drain a requeue. The
@@ -163,11 +190,18 @@ class ResultBuffer:
                     "exiting with %d undeliverable task results for owner %s",
                     len(keep), owner)
                 return
-            self._buffers.setdefault(owner, [])[:0] = keep
-            if self._deadline is None:
-                self._deadline = time.monotonic() + interval
-                self._ensure_thread_locked()
-                self._cond.notify_all()
+            # Defer with full-jitter backoff scaled by how often this batch
+            # already failed: a down owner (e.g. mid head replacement) gets
+            # progressively rarer self-scheduled retries instead of one per
+            # flush interval.
+            ent = self._deferred.get(owner)
+            if ent is None:
+                not_before = time.monotonic() + backoff.delay_for(keep[0][2])
+                self._deferred[owner] = [not_before, keep]
+            else:
+                ent[1][:0] = keep
+            self._ensure_thread_locked()
+            self._cond.notify_all()
 
     # ------------------------------------------------------- deferred flusher
     def _ensure_thread_locked(self) -> None:
@@ -186,10 +220,13 @@ class ResultBuffer:
             with self._lock:
                 if self._stopped or self._worker._shutdown.is_set():
                     return
-                if self._deadline is None:
+                nxt = self._deadline
+                for not_before, _items in self._deferred.values():
+                    nxt = not_before if nxt is None else min(nxt, not_before)
+                if nxt is None:
                     self._cond.wait(timeout=5.0)
                 else:
-                    delay = self._deadline - time.monotonic()
+                    delay = nxt - time.monotonic()
                     if delay > 0:
                         self._cond.wait(timeout=delay)
                     else:

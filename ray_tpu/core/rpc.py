@@ -29,6 +29,178 @@ logger = logging.getLogger(__name__)
 # Frame types
 REQ, REP, ERR, PUSH = 0, 1, 2, 3
 
+
+# --------------------------------------------------------------------------
+# Deterministic fault injection (chaos testing without timing luck).
+#
+# Rules fire at the client SEND boundary of named RPC methods, before the
+# bytes reach the socket — the same cut points a real network fault hits.
+# The rule spec and RNG seed come from config (RAY_TPU_FAULT_INJECTION_SPEC
+# / _SEED env vars, so spawned workers inherit them) or from
+# install_fault_injector() for in-process tests. Spec grammar (";" or ","
+# separated; <method> is an RPC name or "*"):
+#
+#   drop:<method>[:<prob>]        message lost (a call raises
+#                                 RpcDisconnected; a notify vanishes)
+#   delay:<method>:<ms>[:<prob>]  sender stalls before the write
+#   sever_once:<method>           connection cut at the first match, then
+#                                 the rule disarms (one deterministic cut)
+#   sever:<method>[:<prob>]       connection cut per matching send
+#
+# Determinism: one seeded RNG drives every probabilistic decision, so a
+# single-threaded call sequence replays exactly under the same seed.
+# Prob-1.0 rules (drop/sever_once/delay without prob) are deterministic
+# regardless of threading.
+
+
+class _FaultRule:
+    __slots__ = ("action", "method", "prob", "delay_s", "armed", "hits")
+
+    def __init__(self, action: str, method: str, prob: float = 1.0,
+                 delay_s: float = 0.0):
+        self.action = action
+        self.method = method
+        self.prob = prob
+        self.delay_s = delay_s
+        self.armed = True
+        self.hits = 0
+
+    def matches(self, method: str) -> bool:
+        return self.armed and (self.method == "*" or self.method == method)
+
+    def __repr__(self):
+        return (f"_FaultRule({self.action}:{self.method} prob={self.prob} "
+                f"delay={self.delay_s}s hits={self.hits})")
+
+
+class FaultInjector:
+    def __init__(self, spec: str, seed: int = 0):
+        import random as _random
+
+        self.spec = spec
+        self.seed = seed
+        self._rng = _random.Random(seed)
+        self._lock = threading.Lock()
+        self.rules = [self._parse_rule(r) for r in
+                      spec.replace(",", ";").split(";") if r.strip()]
+        self.stats: Dict[str, int] = {"drop": 0, "delay": 0, "sever": 0}
+
+    @staticmethod
+    def _parse_rule(text: str) -> "_FaultRule":
+        parts = [p.strip() for p in text.strip().split(":")]
+        action = parts[0]
+        if action not in ("drop", "delay", "sever", "sever_once"):
+            raise ValueError(f"unknown fault action {action!r} in {text!r}")
+        if len(parts) < 2 or not parts[1]:
+            raise ValueError(f"fault rule {text!r} needs a method name")
+        method = parts[1]
+        if action == "delay":
+            if len(parts) < 3:
+                raise ValueError(f"delay rule {text!r} needs milliseconds")
+            return _FaultRule("delay", method,
+                             prob=float(parts[3]) if len(parts) > 3 else 1.0,
+                             delay_s=float(parts[2]) / 1000.0)
+        prob = float(parts[2]) if len(parts) > 2 else 1.0
+        return _FaultRule(action, method, prob=prob)
+
+    def on_send(self, method: str, client: "RpcClient") -> Optional[str]:
+        """Apply matching rules; returns "drop" when the message must be
+        lost, raises RpcDisconnected after severing the connection."""
+        for rule in self.rules:
+            if not rule.matches(method):
+                continue
+            with self._lock:
+                if not rule.armed:
+                    continue
+                fire = rule.prob >= 1.0 or self._rng.random() < rule.prob
+                if not fire:
+                    continue
+                rule.hits += 1
+                if rule.action == "sever_once":
+                    rule.armed = False
+            if rule.action == "delay":
+                self.stats["delay"] += 1
+                time.sleep(rule.delay_s)
+            elif rule.action == "drop":
+                self.stats["drop"] += 1
+                return "drop"
+            else:  # sever / sever_once
+                self.stats["sever"] += 1
+                client.close()
+                raise RpcDisconnected(
+                    f"[fault-injection seed={self.seed}] severed "
+                    f"{method} to {client.address}")
+        return None
+
+
+def read_gcs_address_file() -> Optional[str]:
+    """The published GCS address from config `gcs_address_file`, or None
+    when unset/unreadable/empty — the shared first hop of every
+    control-plane re-resolution chain (raylet, worker, driver)."""
+    from ray_tpu.core.config import get_config
+
+    path = get_config().gcs_address_file
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            addr = f.read().strip()
+    except OSError:
+        return None
+    return addr or None
+
+
+_fault_injector: Optional[FaultInjector] = None
+_fault_checked = False
+_fault_lock = threading.Lock()
+
+
+def install_fault_injector(spec: str, seed: int = 0) -> FaultInjector:
+    """Programmatic injection for in-process tests. Returns the injector
+    (its .stats/.rules expose hit counts for assertions)."""
+    global _fault_injector, _fault_checked
+    inj = FaultInjector(spec, seed)
+    with _fault_lock:
+        _fault_injector = inj
+        _fault_checked = True
+    logger.warning("fault injection ACTIVE: spec=%r seed=%d "
+                   "(reproduce with RAY_TPU_FAULT_INJECTION_SPEC/"
+                   "RAY_TPU_FAULT_INJECTION_SEED)", spec, seed)
+    return inj
+
+
+def clear_fault_injector() -> None:
+    global _fault_injector, _fault_checked
+    with _fault_lock:
+        _fault_injector = None
+        _fault_checked = True
+
+
+def get_fault_injector() -> Optional[FaultInjector]:
+    """The active injector, initializing once from config (env-driven:
+    spawned worker processes inherit the spec + seed and print the seed,
+    so a failing chaos run is reproducible)."""
+    global _fault_injector, _fault_checked
+    if _fault_checked:
+        return _fault_injector
+    with _fault_lock:
+        if _fault_checked:
+            return _fault_injector
+        try:
+            from ray_tpu.core.config import get_config
+
+            cfg = get_config()
+            if cfg.fault_injection_spec:
+                _fault_injector = FaultInjector(cfg.fault_injection_spec,
+                                                cfg.fault_injection_seed)
+                logger.warning(
+                    "fault injection ACTIVE from config: spec=%r seed=%d",
+                    cfg.fault_injection_spec, cfg.fault_injection_seed)
+        except Exception:
+            logger.exception("fault injector init failed; disabled")
+        _fault_checked = True
+        return _fault_injector
+
 _HDR = struct.Struct("!BQI")  # type, request_id, method-name length
 
 
@@ -291,6 +463,13 @@ class RpcClient:
             self._sock.sendall(data)
 
     def call_future(self, method: str, payload: Any = None) -> Future:
+        inj = get_fault_injector()
+        if inj is not None and inj.on_send(method, self) == "drop":
+            # the request never reaches the wire: to the caller that is a
+            # lost link (no reply would ever arrive)
+            raise RpcDisconnected(
+                f"[fault-injection seed={inj.seed}] dropped call "
+                f"{method} to {self.address}")
         with self._id_lock:
             req_id = self._next_id
             self._next_id += 1
@@ -308,6 +487,9 @@ class RpcClient:
 
     def notify(self, method: str, payload: Any = None) -> None:
         """One-way message (no response expected)."""
+        inj = get_fault_injector()
+        if inj is not None and inj.on_send(method, self) == "drop":
+            return  # one-way message silently lost, like the real fault
         self._send(_encode(PUSH, 0, method, payload))
 
     @property
@@ -336,29 +518,77 @@ class ReconnectingClient:
     across one reconnect, `notify()` is best-effort, and `on_reconnect(raw)`
     replays session state (registrations, subscriptions) on every fresh
     connection before other calls proceed. Built for long-lived links to the
-    control plane, which may restart (GCS fault tolerance)."""
+    control plane, which may restart (GCS fault tolerance) or be REPLACED on
+    a new address (control-plane HA): `resolve()` — when given — is invoked
+    before every connection attempt and may return an updated address (from
+    the GCS address file, an in-band announce, or the local raylet), so the
+    link follows the head wherever it comes back. Reconnect attempts sleep
+    with exponential backoff + full jitter (util/backoff.py): a replacement
+    head sees the whole fleet re-register without a synchronized stampede."""
 
     def __init__(self, address: str,
                  push_handler: Optional[Callable[[str, Any], None]] = None,
                  timeout: float = 30.0,
                  on_reconnect: Optional[Callable[["RpcClient"], None]] = None,
-                 reconnect_timeout: float = 30.0):
+                 reconnect_timeout: float = 30.0,
+                 resolve: Optional[Callable[[], Optional[str]]] = None):
         self.address = address
         self._push_handler = push_handler
         self._on_reconnect = on_reconnect
         self._reconnect_timeout = reconnect_timeout
+        self._resolve = resolve
         self._lock = threading.Lock()
         self._closed = False
         self._reconnecting = False
         self._client = self._connect(timeout)
 
+    def _backoff(self):
+        from ray_tpu.core.config import get_config
+        from ray_tpu.util.backoff import ExponentialBackoff
+
+        cfg = get_config()
+        return ExponentialBackoff(
+            base_s=cfg.reconnect_backoff_base_ms / 1000.0,
+            cap_s=cfg.reconnect_backoff_cap_ms / 1000.0)
+
+    def _resolved_address(self) -> str:
+        if self._resolve is not None:
+            try:
+                addr = self._resolve()
+            except Exception:
+                logger.debug("address resolve failed; keeping %s",
+                             self.address, exc_info=True)
+                addr = None
+            if addr and addr != self.address:
+                logger.info("control-plane address re-resolved: %s -> %s",
+                            self.address, addr)
+                self.address = addr
+        return self.address
+
     def _connect(self, timeout: float) -> RpcClient:
         # Eager recovery: a drop triggers a background reconnect so even a
         # process that never initiates calls (an idle actor worker) promptly
-        # re-registers with a restarted control plane.
-        return connect_with_retry(
-            self.address, timeout=timeout, push_handler=self._push_handler,
-            on_disconnect=self._schedule_reconnect)
+        # re-registers with a restarted control plane. The address is
+        # RE-resolved on every attempt — a head replacement may publish its
+        # new address while we are mid-retry against the old one.
+        deadline = time.monotonic() + timeout
+        backoff = self._backoff()
+        last: Exception | None = None
+        while True:
+            addr = self._resolved_address()
+            try:
+                return RpcClient(
+                    addr, push_handler=self._push_handler,
+                    on_disconnect=self._schedule_reconnect,
+                    connect_timeout=min(timeout, 5.0))
+            except (ConnectionRefusedError, OSError) as e:
+                last = e
+            remaining = deadline - time.monotonic()
+            if self._closed or remaining <= 0:
+                raise ConnectionError(
+                    f"could not connect to {self.address} within "
+                    f"{timeout}s: {last}")
+            time.sleep(min(max(0.02, backoff.next_delay()), remaining))
 
     def _schedule_reconnect(self) -> None:
         if self._closed or self._reconnecting:
@@ -367,13 +597,14 @@ class ReconnectingClient:
         def run():
             self._reconnecting = True
             try:
-                time.sleep(0.2)
+                backoff = self._backoff()
+                backoff.sleep()
                 while not self._closed:
                     try:
                         self._live_client()
                         return
                     except Exception:
-                        time.sleep(1.0)
+                        backoff.sleep()
             finally:
                 self._reconnecting = False
 

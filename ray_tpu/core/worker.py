@@ -323,10 +323,14 @@ class CoreWorker:
             timeout=connect_timeout or get_config().rpc_connect_timeout_s)
         # Reconnecting control-plane link: survives a GCS restart by
         # re-registering this process's durable facts (job, subscriptions,
-        # hosted actor) on every fresh connection.
+        # hosted actor) on every fresh connection. The resolver follows a
+        # REPLACEMENT head to a new address: the address file when
+        # configured, else this node's raylet (whose own reconnect loop
+        # tracks the head) answers get_gcs_address.
         self.gcs = rpc.ReconnectingClient(
             gcs_address, push_handler=self._on_gcs_push,
-            on_reconnect=self._replay_gcs_state)
+            on_reconnect=self._replay_gcs_state,
+            resolve=self._resolve_gcs_address)
 
         # task-path fast lanes: export-once function table + batched
         # task-event/profile shipping (both ride self.gcs)
@@ -2003,9 +2007,27 @@ class CoreWorker:
                              daemon=True).start()
         return q
 
+    def _resolve_gcs_address(self) -> Optional[str]:
+        """Current-best GCS address for a reconnect attempt (control-plane
+        HA): the address file when configured, else ask our raylet — its
+        own reconnect loop follows a replacement head, so its answer is
+        the freshest in-band source. None = keep the last-known address."""
+        addr = rpc.read_gcs_address_file()
+        if addr:
+            return addr
+        raylet = getattr(self, "raylet", None)
+        if raylet is not None and not raylet.closed:
+            try:
+                return raylet.call("get_gcs_address", {}, timeout=2)
+            except Exception:
+                pass
+        return None
+
     def _replay_gcs_state(self, raw: rpc.RpcClient) -> None:
         """Rebuild this process's GCS-side state after a GCS restart (uses
         the RAW client — the reconnecting wrapper's lock is held)."""
+        # the link may have followed a head replacement to a new address
+        self.gcs_address = raw.address
         # re-export the function table entries this process owns: a fresh
         # GCS (no snapshot) must still resolve ids from in-flight specs
         self.function_table.replay_exports(raw)

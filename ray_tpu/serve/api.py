@@ -178,11 +178,27 @@ class ServeController:
         from ray_tpu.core.actor import ActorHandle
         from ray_tpu.core.api import _global_worker
 
-        try:
-            blob = _global_worker().gcs.call(
-                "kv_get", {"namespace": "serve", "key": self._KV_KEY}, timeout=5)
-        except (OSError, RuntimeError, TimeoutError):  # GCS unreachable:
-            return  # cold-start without a checkpoint
+        from ray_tpu.util.backoff import ExponentialBackoff
+
+        # Retry the checkpoint read across a control-plane outage: a
+        # controller restarting DURING a head replacement would otherwise
+        # cold-start and silently orphan every running replica. Bounded —
+        # a checkpoint that truly doesn't exist still cold-starts fast.
+        backoff = ExponentialBackoff(base_s=0.2, cap_s=2.0)
+        blob = None
+        for attempt in range(4):
+            try:
+                blob = _global_worker().gcs.call(
+                    "kv_get", {"namespace": "serve", "key": self._KV_KEY},
+                    timeout=5)
+                break
+            except (OSError, RuntimeError, TimeoutError):  # GCS unreachable
+                if attempt == 3:
+                    logger.warning(
+                        "serve controller checkpoint unreadable (GCS down?); "
+                        "cold-starting without re-adoption")
+                    return
+                backoff.sleep()
         if not blob:
             return
         try:

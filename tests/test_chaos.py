@@ -2,16 +2,24 @@
 `release/nightly_tests/chaos_test/` + NodeKillerActor,
 `python/ray/_private/test_utils.py:1366`): every submitted task must still
 complete correctly through retries, lineage recovery and control-plane
-re-registration."""
+re-registration. The head-replacement scenarios use the deterministic
+fault-injection hooks (rpc.FaultInjector) to cut/stall RPCs at exact
+protocol points instead of relying on timing luck; the seed is printed so
+failures reproduce."""
 
+import os
 import tempfile
+import threading
 import time
 
 import numpy as np
 import pytest
 
 import ray_tpu
+from ray_tpu.core import rpc
 from ray_tpu.core.cluster import Cluster
+
+FAULT_SEED = int(os.environ.get("RAY_TPU_FAULT_INJECTION_SEED", "20260804"))
 
 
 @pytest.mark.slow
@@ -46,6 +54,167 @@ def test_tasks_survive_node_kill_and_gcs_restart():
         a = A.remote()
         assert ray_tpu.get(a.ping.remote(), timeout=60) == "ok"
     finally:
+        cluster.shutdown()
+
+
+def test_transient_prepare_failure_self_heals():
+    """A severed GCS->raylet link during phase 1 leaves the group PENDING
+    (retryable) instead of stranded: the health loop's paced retry
+    reconnects the dispatch client and re-runs the 2PC to completion —
+    deterministically injected, no timing luck."""
+    print(f"fault injection seed: {FAULT_SEED}")
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    try:
+        rpc.install_fault_injector("sever_once:prepare_bundle",
+                                   seed=FAULT_SEED)
+        from ray_tpu.core.placement_group import placement_group
+
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        inj = rpc.get_fault_injector()
+        assert inj.stats["sever"] == 1, "injected sever never fired"
+        # the paced background retry must complete the group by itself
+        assert pg.ready(timeout=30), \
+            "PENDING placement group was never retried"
+        info = ray_tpu.core.worker.current_worker().gcs.call(
+            "get_placement_group", {"pg_id": pg.id})
+        assert info["state"] == "CREATED"
+    finally:
+        rpc.clear_fault_injector()
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_head_killed_mid_pg_creation_completes_on_replacement():
+    """Kill the head DURING placement-group creation (deterministically:
+    injected delay on prepare_bundle holds the 2-phase protocol open while
+    the kill lands). The replacement head finds the PREPARING marker in the
+    snapshot and resumes the creation — idempotent raylet-side prepares
+    mean no double-charge — so the client's retried create completes.
+    No hang, no timing luck."""
+    print(f"fault injection seed: {FAULT_SEED}")
+    snap = tempfile.mkdtemp(prefix="rtpu_ha_pg_")
+    cluster = Cluster(snapshot_uri=f"file://{snap}")
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    try:
+        # each prepare stalls 600ms: with 2 bundles the creation is
+        # guaranteed to still be in flight when we kill the head
+        rpc.install_fault_injector("delay:prepare_bundle:600",
+                                   seed=FAULT_SEED)
+        from ray_tpu.core.placement_group import placement_group
+
+        result = {}
+
+        def create():
+            try:
+                result["pg"] = placement_group(
+                    [{"CPU": 1}, {"CPU": 1}], strategy="SPREAD",
+                    name="chaos-pg")
+            except Exception as e:  # pragma: no cover - surfaced below
+                result["error"] = e
+
+        t = threading.Thread(target=create, daemon=True)
+        t.start()
+
+        # deterministic kill point: the 2PC has durably entered PREPARING
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with cluster.gcs._lock:
+                if any(p.get("state") == "PREPARING"
+                       for p in cluster.gcs._pgs.values()):
+                    break
+            time.sleep(0.01)
+        else:
+            pytest.fail("PG creation never reached PREPARING")
+        cluster.gcs._write_snapshot()   # the crash point the snapshot saw
+        cluster.kill_head()
+        rpc.clear_fault_injector()      # faults were for the kill window
+        cluster.replace_head()
+
+        t.join(timeout=120)
+        assert not t.is_alive(), "PG creation hung across head replacement"
+        assert "error" not in result, f"create raised: {result.get('error')}"
+        pg = result["pg"]
+        # either the client's retried create or the replacement head's
+        # resume completes it; ready_or_raise would surface the typed
+        # PlacementInfeasibleError if neither could
+        assert pg.ready_or_raise(timeout=120) is pg
+        info = ray_tpu.core.worker.current_worker().gcs.call(
+            "get_placement_group", {"pg_id": pg.id})
+        assert info["state"] == "CREATED"
+        assert len(info["placement"]) == 2
+
+        # the group is actually usable on the rebuilt cluster: the GCS
+        # routes a PG actor to the bundle's node and charges the bundle
+        @ray_tpu.remote(num_cpus=1)
+        class Placed:
+            def ping(self):
+                return "placed"
+
+        a = Placed.options(placement_group=pg,
+                           placement_group_bundle_index=0).remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == "placed"
+    finally:
+        rpc.clear_fault_injector()
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_head_killed_mid_pg_creation_infeasible_is_typed():
+    """Same kill point, but the capacity the PG needs dies with the
+    window: the replacement head must FAIL the group so the client sees
+    the typed PlacementInfeasibleError — never a silent hang."""
+    from ray_tpu.core.exceptions import PlacementInfeasibleError
+
+    print(f"fault injection seed: {FAULT_SEED}")
+    snap = tempfile.mkdtemp(prefix="rtpu_ha_pg2_")
+    cluster = Cluster(snapshot_uri=f"file://{snap}")
+    cluster.add_node(num_cpus=1)
+    big = cluster.add_node(num_cpus=8, resources={"big": 1})
+    cluster.connect()
+    try:
+        rpc.install_fault_injector("delay:prepare_bundle:600",
+                                   seed=FAULT_SEED)
+        from ray_tpu.core.placement_group import placement_group
+
+        result = {}
+
+        def create():
+            try:
+                # only the big node can hold these bundles
+                result["pg"] = placement_group(
+                    [{"CPU": 4}, {"CPU": 4}], strategy="PACK")
+            except Exception as e:
+                result["error"] = e
+
+        t = threading.Thread(target=create, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with cluster.gcs._lock:
+                if any(p.get("state") == "PREPARING"
+                       for p in cluster.gcs._pgs.values()):
+                    break
+            time.sleep(0.01)
+        else:
+            pytest.fail("PG creation never reached PREPARING")
+        cluster.gcs._write_snapshot()
+        cluster.kill_head()
+        rpc.clear_fault_injector()
+        cluster.remove_node(big)        # the needed capacity dies too
+        cluster.replace_head()
+
+        t.join(timeout=120)
+        assert not t.is_alive(), "PG creation hung across head replacement"
+        if "error" not in result:
+            # creation RPC survived; the typed outcome comes from polling
+            with pytest.raises(PlacementInfeasibleError):
+                result["pg"].ready_or_raise(timeout=120)
+    finally:
+        rpc.clear_fault_injector()
         cluster.shutdown()
 
 

@@ -1,0 +1,145 @@
+"""Deterministic fault injection at named RPC boundaries (rpc.FaultInjector):
+seeded drop/delay/sever rules fire at the client send side, so chaos tests
+cut connections at exact protocol points instead of relying on timing luck."""
+
+import time
+
+import pytest
+
+from ray_tpu.core import rpc
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    rpc.clear_fault_injector()
+    yield
+    rpc.clear_fault_injector()
+
+
+class _EchoServer:
+    def rpc_ping(self, conn, req_id, payload):
+        return {"pong": payload}
+
+    def rpc_other(self, conn, req_id, payload):
+        return "other"
+
+
+@pytest.fixture
+def echo():
+    srv = rpc.RpcServer("127.0.0.1", 0)
+    srv.register_all(_EchoServer())
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_spec_parsing_and_validation():
+    inj = rpc.FaultInjector(
+        "drop:ping:0.5; delay:other:250:0.9, sever_once:commit_bundle",
+        seed=7)
+    actions = [(r.action, r.method) for r in inj.rules]
+    assert actions == [("drop", "ping"), ("delay", "other"),
+                       ("sever_once", "commit_bundle")]
+    assert inj.rules[0].prob == 0.5
+    assert inj.rules[1].delay_s == pytest.approx(0.25)
+    with pytest.raises(ValueError):
+        rpc.FaultInjector("explode:ping")
+    with pytest.raises(ValueError):
+        rpc.FaultInjector("drop:")
+
+
+def test_drop_is_seeded_and_deterministic(echo):
+    outcomes = []
+    for _ in range(2):
+        rpc.install_fault_injector("drop:ping:0.5", seed=1234)
+        cli = rpc.RpcClient(echo.address)
+        seq = []
+        for i in range(20):
+            try:
+                cli.call("ping", i, timeout=5)
+                seq.append(True)
+            except rpc.RpcDisconnected:
+                seq.append(False)
+        cli.close()
+        outcomes.append(seq)
+        rpc.clear_fault_injector()
+    assert outcomes[0] == outcomes[1], "same seed must replay identically"
+    assert not all(outcomes[0]) and any(outcomes[0])
+
+
+def test_drop_scopes_to_named_method(echo):
+    rpc.install_fault_injector("drop:ping", seed=0)
+    cli = rpc.RpcClient(echo.address)
+    with pytest.raises(rpc.RpcDisconnected):
+        cli.call("ping", 1, timeout=5)
+    assert cli.call("other", None, timeout=5) == "other"
+    cli.close()
+
+
+def test_dropped_notify_vanishes_silently(echo):
+    rpc.install_fault_injector("drop:ping", seed=0)
+    cli = rpc.RpcClient(echo.address)
+    cli.notify("ping", 1)  # no exception: one-way message just lost
+    assert cli.call("other", None, timeout=5) == "other"
+    inj = rpc.get_fault_injector()
+    assert inj.stats["drop"] == 1
+    cli.close()
+
+
+def test_delay_stalls_send(echo):
+    rpc.install_fault_injector("delay:ping:200", seed=0)
+    cli = rpc.RpcClient(echo.address)
+    t0 = time.monotonic()
+    cli.call("ping", 1, timeout=5)
+    assert time.monotonic() - t0 >= 0.2
+    cli.close()
+
+
+def test_sever_once_cuts_connection_then_disarms(echo):
+    inj = rpc.install_fault_injector("sever_once:ping", seed=0)
+    cli = rpc.RpcClient(echo.address)
+    with pytest.raises(rpc.RpcDisconnected):
+        cli.call("ping", 1, timeout=5)
+    assert cli.closed  # the connection really was cut
+    # rule disarmed: a fresh connection works on the next attempt
+    cli2 = rpc.RpcClient(echo.address)
+    assert cli2.call("ping", 2, timeout=5) == {"pong": 2}
+    assert inj.stats["sever"] == 1
+    assert not inj.rules[0].armed
+    cli2.close()
+
+
+def test_backoff_full_jitter_grows_and_caps():
+    """util/backoff.py: delays are uniform in [0, min(cap, base*f^n)] —
+    the schedule every reconnect/retry loop now shares."""
+    import random
+
+    from ray_tpu.util.backoff import ExponentialBackoff
+
+    bo = ExponentialBackoff(base_s=0.1, cap_s=1.0, factor=2.0,
+                            rng=random.Random(42))
+    for attempt, ceiling in [(0, 0.1), (1, 0.2), (3, 0.8), (10, 1.0)]:
+        for _ in range(50):
+            assert 0.0 <= bo.delay_for(attempt) <= ceiling
+    # stateful counter advances and resets
+    assert bo.attempt == 0
+    bo.next_delay()
+    bo.next_delay()
+    assert bo.attempt == 2
+    bo.reset()
+    assert bo.attempt == 0
+    # same seed -> identical schedule (deterministic tests)
+    a = ExponentialBackoff(0.1, 1.0, rng=random.Random(7))
+    b = ExponentialBackoff(0.1, 1.0, rng=random.Random(7))
+    assert [a.next_delay() for _ in range(8)] == \
+        [b.next_delay() for _ in range(8)]
+
+
+def test_sever_engages_reconnecting_client(echo):
+    """A severed control-plane link heals through ReconnectingClient's
+    backoff loop — the exact path a head replacement exercises."""
+    rpc.install_fault_injector("sever_once:ping", seed=0)
+    cli = rpc.ReconnectingClient(echo.address, timeout=10)
+    # first call severs (attempt 0) then retries across the reconnect
+    assert cli.call("ping", 3, timeout=10) == {"pong": 3}
+    cli.close()

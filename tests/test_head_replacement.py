@@ -1,0 +1,160 @@
+"""Control-plane HA: a REPLACEMENT head on a NEW address (reference
+`gcs_table_storage.h` externalized-tables pattern) restores node/actor/
+PG/KV state from the pluggable SnapshotStore, announces itself to the
+snapshot-known raylets, and the fleet re-registers over re-resolving
+reconnecting clients with jittered backoff. Seeded fault injection makes
+the recovery path run under message loss without timing luck — the seed is
+printed so a failure reproduces exactly."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import rpc
+from ray_tpu.core.cluster import Cluster
+
+FAULT_SEED = int(os.environ.get("RAY_TPU_FAULT_INJECTION_SEED", "20260804"))
+
+
+@pytest.fixture
+def ha_cluster(tmp_path):
+    cluster = Cluster(snapshot_uri=f"file://{tmp_path}/gcs_snaps")
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    yield cluster
+    rpc.clear_fault_injector()
+    cluster.shutdown()
+
+
+def _wait(pred, timeout=60, period=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(period)
+    return pred()
+
+
+def _wait_nodes(cluster, n, timeout=60):
+    """n nodes alive AND actually re-registered (not just snapshot-restored
+    provisional entries)."""
+    return _wait(lambda: sum(
+        1 for node in cluster.gcs._nodes.values()
+        if node["alive"] and not node.get("restored")) >= n, timeout)
+
+
+def test_head_replacement_restores_full_state(ha_cluster):
+    """The acceptance scenario: named actor (with a spent restart budget),
+    PG, KV and an in-flight workload all survive the head being killed and
+    replaced on a DIFFERENT address."""
+    cluster = ha_cluster
+
+    @ray_tpu.remote(max_restarts=3)
+    class Counter:
+        def __init__(self):
+            self.v = 0
+
+        def incr(self):
+            self.v += 1
+            return self.v
+
+    counter = Counter.options(name="survivor", namespace="ha").remote()
+    assert ray_tpu.get(counter.incr.remote(), timeout=60) == 1
+
+    # spend one restart so the budget (num_restarts=1 of 3) is non-trivial
+    ray_tpu.kill(counter, no_restart=False)
+    w = ray_tpu.core.worker.current_worker()
+
+    def _restarted():
+        info = w.gcs.call("get_actor_info",
+                          {"name": "survivor", "namespace": "ha"})
+        return info is not None and info["state"] == "ALIVE" \
+            and info["num_restarts"] == 1
+    assert _wait(_restarted, 60), "actor did not restart before the kill"
+    assert ray_tpu.get(counter.incr.remote(), timeout=60) == 1  # fresh state
+
+    # durable KV + a placement group with committed bundles
+    w.gcs.call("kv_put", {"namespace": "ha", "key": b"k", "value": b"v1"})
+    from ray_tpu.core.placement_group import placement_group
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="SPREAD")
+    assert pg.ready(timeout=60)
+
+    # a workload in flight across the head loss (tasks ride raylet/worker
+    # links, but their completions must land AFTER the replacement)
+    @ray_tpu.remote(max_retries=3)
+    def slow(i):
+        time.sleep(2.0)
+        return i * 10
+
+    refs = [slow.remote(i) for i in range(8)]
+
+    # deterministic snapshot point (the periodic loop is timer-driven)
+    cluster.gcs._write_snapshot()
+
+    # seeded message loss on the recovery path itself: re-registration and
+    # heartbeats must converge through drops + jittered-backoff retries
+    print(f"fault injection seed: {FAULT_SEED}")
+    rpc.install_fault_injector(
+        "drop:register_node:0.3;drop:heartbeat:0.5", seed=FAULT_SEED)
+
+    old_address = cluster.gcs.address
+    cluster.kill_head()
+    new_address = cluster.replace_head()
+    assert new_address != old_address, "replacement must use a NEW address"
+
+    # 1. raylets re-registered with the replacement head
+    assert _wait_nodes(cluster, 2), "raylets did not re-register"
+
+    # 2. the in-flight workload completes after the replacement
+    assert ray_tpu.get(refs, timeout=120) == [i * 10 for i in range(8)]
+
+    # 3. named actor: identity, namespace AND restart budget restored
+    def _readopted():
+        info = w.gcs.call("get_actor_info",
+                          {"name": "survivor", "namespace": "ha"})
+        return info is not None and info["state"] == "ALIVE"
+    assert _wait(_readopted, 60), "named actor not restored on new head"
+    info = w.gcs.call("get_actor_info",
+                      {"name": "survivor", "namespace": "ha"})
+    assert info["num_restarts"] == 1, "restart budget lost in replacement"
+    assert ray_tpu.get(counter.incr.remote(), timeout=60) == 2
+
+    # 4. the PG table survived with its bundle->node placement
+    restored = w.gcs.call("get_placement_group", {"pg_id": pg.id})
+    assert restored is not None, "placement group forgotten by new head"
+    assert restored["state"] == "CREATED"
+    assert restored["placement"] is not None
+    assert len(restored["placement"]) == 2
+
+    # 5. KV survived through the snapshot store
+    assert w.gcs.call("kv_get", {"namespace": "ha", "key": b"k"}) == b"v1"
+
+    # 6. the rebuilt cluster schedules NEW work (actors + tasks)
+    rpc.clear_fault_injector()
+    fresh = Counter.remote()
+    assert ray_tpu.get(fresh.incr.remote(), timeout=60) == 1
+
+
+def test_head_replacement_without_faults_is_fast_path(ha_cluster):
+    """No injection: plain task path + KV + re-resolution via the raylet
+    answerback (no address file configured)."""
+    cluster = ha_cluster
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1), timeout=60) == 2
+    w = ray_tpu.core.worker.current_worker()
+    w.gcs.call("kv_put", {"namespace": "t", "key": b"a", "value": b"b"})
+    cluster.gcs._write_snapshot()
+
+    cluster.kill_head()
+    cluster.replace_head()
+    assert _wait_nodes(cluster, 2)
+    assert ray_tpu.get(f.remote(41), timeout=60) == 42
+    assert w.gcs.call("kv_get", {"namespace": "t", "key": b"a"}) == b"b"

@@ -1,0 +1,111 @@
+"""Pluggable control-plane snapshot storage (snapshot_store.py): keyed
+blob stores selected by URI, checksummed envelope, and the versioned
+save/load-latest layer the GCS persists through (reference
+`gcs_table_storage.h` role)."""
+
+import pytest
+
+from ray_tpu.core.snapshot_store import (
+    FileSnapshotStore,
+    MemorySnapshotStore,
+    SnapshotCorruptError,
+    VersionedSnapshots,
+    decode_blob,
+    encode_blob,
+    store_from_uri,
+)
+
+
+def test_envelope_roundtrip_and_checksum():
+    payload = b"control-plane tables" * 100
+    blob = encode_blob(payload)
+    assert decode_blob(blob) == payload
+    # a flipped payload byte fails the checksum instead of decoding garbage
+    corrupt = bytearray(blob)
+    corrupt[-1] ^= 0xFF
+    with pytest.raises(SnapshotCorruptError):
+        decode_blob(bytes(corrupt))
+    with pytest.raises(SnapshotCorruptError):
+        decode_blob(b"not a snapshot")
+
+
+def test_file_store_put_get_list_delete(tmp_path):
+    store = FileSnapshotStore(str(tmp_path / "snaps"))
+    store.put("gcs-1", b"one")
+    store.put("gcs-2", b"two")
+    assert store.get("gcs-1") == b"one"
+    assert store.get("missing") is None
+    assert store.list_keys(prefix="gcs-") == ["gcs-1", "gcs-2"]
+    store.delete("gcs-1")
+    assert store.get("gcs-1") is None
+    store.delete("gcs-1")  # idempotent
+
+
+def test_memory_store_survives_object_swap():
+    MemorySnapshotStore.wipe("t1")
+    a = MemorySnapshotStore("t1")
+    a.put("k", b"v")
+    # a NEW store object over the same name sees the blob — the in-process
+    # analog of a replacement head reading an external store
+    b = MemorySnapshotStore("t1")
+    assert b.get("k") == b"v"
+    MemorySnapshotStore.wipe("t1")
+    assert MemorySnapshotStore("t1").get("k") is None
+
+
+def test_store_from_uri(tmp_path):
+    f = store_from_uri(f"file://{tmp_path}/s")
+    assert isinstance(f, FileSnapshotStore)
+    assert isinstance(store_from_uri(str(tmp_path / "bare")),
+                      FileSnapshotStore)
+    assert isinstance(store_from_uri("memory://x"), MemorySnapshotStore)
+    with pytest.raises(ValueError):
+        store_from_uri("s3://unsupported/bucket")
+
+
+def test_versioned_save_prunes_and_loads_latest(tmp_path):
+    vs = VersionedSnapshots(FileSnapshotStore(str(tmp_path)), keep=2)
+    for i in range(5):
+        vs.save(f"snapshot-{i}".encode())
+    assert vs.load_latest() == b"snapshot-4"
+    # pruned to the newest `keep` versions
+    assert len(vs.store.list_keys(prefix="gcs-")) == 2
+
+
+def test_versioned_load_falls_back_past_corruption(tmp_path):
+    store = FileSnapshotStore(str(tmp_path))
+    vs = VersionedSnapshots(store, keep=3)
+    vs.save(b"good-old")
+    seq = vs.save(b"newest")
+    # simulate a torn write of the newest version
+    store.put(f"gcs-{seq:016d}", b"garbage that is not an envelope")
+    assert vs.load_latest() == b"good-old"
+
+
+def test_versioned_load_empty(tmp_path):
+    vs = VersionedSnapshots(FileSnapshotStore(str(tmp_path)))
+    assert vs.load_latest() is None
+
+
+def test_legacy_single_pickle_snapshot_migrates(tmp_path):
+    """A pre-HA head wrote one pickle FILE at snapshot_path; a new head
+    given the same path must still boot AND restore that data (the store
+    roots beside the file and imports it as version 1)."""
+    import pickle
+
+    from ray_tpu.core import rpc
+    from ray_tpu.core.gcs import GcsServer
+
+    legacy = str(tmp_path / "gcs.snapshot")
+    with open(legacy, "wb") as f:
+        pickle.dump({"kv": {"app": {b"model": b"v17"}}, "jobs": {},
+                     "functions": {}, "actor_meta": {}}, f)
+    gcs = GcsServer(snapshot_path=legacy)
+    addr = gcs.start()
+    c = rpc.connect_with_retry(addr)
+    try:
+        assert c.call("kv_get",
+                      {"namespace": "app", "key": b"model"}) == b"v17"
+    finally:
+        c.close()
+        gcs.stop()
