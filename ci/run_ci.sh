@@ -1,23 +1,28 @@
 #!/usr/bin/env bash
 # One-command CI for ray_tpu (reference role: .buildkite/pipeline.build.yml).
 #
-#   ci/run_ci.sh            # native sanitizers + fast tier + stress x20 + chaos
+#   ci/run_ci.sh            # native + fast tier + stress x20 + chaos + storm
 #   ci/run_ci.sh --fast     # fast test tier only
 #   ci/run_ci.sh --native   # native ASAN/UBSAN harness only
 #   ci/run_ci.sh --stress   # actor-ordering stress x20 only
 #   ci/run_ci.sh --chaos    # control-plane HA chaos suite only
+#   ci/run_ci.sh --storm    # serve traffic-storm chaos only
 #
 # Stages:
-#   1. native    : arena + scheduler + token-loader compiled whole-program
-#                  with -fsanitize=address,undefined and exercised by
-#                  src/tests/sanitize_main.cpp (allocation churn, shared
-#                  mappings, thread shutdown).
-#   2. fast tier : pytest tests/ (the "not slow" default tier).
-#   3. stress    : the actor-ordering race test repeated 20x (the round-1
-#                  ordering bug class must stay dead).
-#   4. chaos     : head-replacement + fault-injection suite under its own
-#                  timeout, with the injection seed printed so any failure
-#                  reproduces exactly.
+#   1. native      : arena + scheduler + token-loader compiled whole-program
+#                    with -fsanitize=address,undefined and exercised by
+#                    src/tests/sanitize_main.cpp (allocation churn, shared
+#                    mappings, thread shutdown).
+#   2. fast tier   : pytest tests/ (the "not slow" default tier).
+#   3. stress      : the actor-ordering race test repeated 20x (the round-1
+#                    ordering bug class must stay dead).
+#   4. chaos       : head-replacement + fault-injection suite under its own
+#                    timeout, with the injection seed printed so any failure
+#                    reproduces exactly.
+#   5. serve-storm : quick traffic-storm profile against a multi-replica
+#                    autoscaling deployment under seeded replica-call drops
+#                    + kills; prints the seed and shed/retry counters and
+#                    fails on ANY unresolved (hung) request.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -80,13 +85,31 @@ run_chaos() {
          exit 1; }
 }
 
+run_serve_storm() {
+  echo "=== [5/5] serve traffic-storm chaos ==="
+  : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
+  export RAY_TPU_FAULT_INJECTION_SEED
+  echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
+  # --quick: ~6 s of ~4x overload with seeded serve_replica_call drops and
+  # periodic replica kills. The harness prints submitted/accepted/shed/
+  # timeout + retry/failover counters and exits nonzero if ANY request
+  # failed to resolve (hung) — the serve plane's overload contract.
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m ray_tpu.serve.storm \
+    --quick --seed "${RAY_TPU_FAULT_INJECTION_SEED}" \
+    --json /tmp/ray_tpu_servestorm_ci.json \
+    || { echo "serve storm failed (seed ${RAY_TPU_FAULT_INJECTION_SEED})"
+         exit 1; }
+}
+
 case "$STAGE" in
   --native) run_native ;;
   --fast)   run_fast ;;
   --stress) run_stress ;;
   --chaos)  run_chaos ;;
-  all)      run_native; run_fast; run_stress; run_chaos ;;
-  *) echo "unknown stage: $STAGE (use --native|--fast|--stress|--chaos)" >&2
+  --storm)  run_serve_storm ;;
+  all)      run_native; run_fast; run_stress; run_chaos; run_serve_storm ;;
+  *) echo "unknown stage: $STAGE" \
+     "(use --native|--fast|--stress|--chaos|--storm)" >&2
      exit 2 ;;
 esac
 echo "CI green"

@@ -54,6 +54,8 @@ from ray_tpu.core.exceptions import (
     ObjectLostError,
     GetTimeoutError,
     PlacementInfeasibleError,
+    RequestTimeoutError,
+    BackPressureError,
 )
 
 __all__ = [
@@ -88,6 +90,8 @@ __all__ = [
     "ObjectLostError",
     "GetTimeoutError",
     "PlacementInfeasibleError",
+    "RequestTimeoutError",
+    "BackPressureError",
 ]
 
 __all__.append("util")

@@ -77,6 +77,23 @@ class PendingCallsLimitExceeded(RayTpuError):
     """Back-pressure: too many in-flight calls to an actor."""
 
 
+class RequestTimeoutError(RayTpuError, TimeoutError):
+    """A serve request exceeded its end-to-end deadline (reference
+    `RequestTimeoutError` semantics of serve's request_timeout_s). Raised
+    at whichever point first observes expiry — the replica's pre-dequeue
+    check, the batcher's batch-assembly check, or the router's deadline
+    reaper — and mapped to HTTP 504 at the ingress. Matched BY TYPE by the
+    storm harness and the edges; don't match the message."""
+
+
+class BackPressureError(RayTpuError):
+    """A serve request was shed by admission control: every replica of the
+    target deployment is at its configured in-flight cap (or the ingress
+    itself is at its cap). A fast, typed rejection — mapped to HTTP 503 —
+    so sustained overload degrades to bounded-latency sheds instead of
+    unbounded queue growth. Matched BY TYPE (edges, storm harness)."""
+
+
 class PlacementInfeasibleError(RayTpuError):
     """A placement group's bundles cannot be satisfied by the current
     cluster. Raised at the reservation source and matched BY TYPE (elastic

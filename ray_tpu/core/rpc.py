@@ -103,9 +103,12 @@ class FaultInjector:
         prob = float(parts[2]) if len(parts) > 2 else 1.0
         return _FaultRule(action, method, prob=prob)
 
-    def on_send(self, method: str, client: "RpcClient") -> Optional[str]:
+    def on_send(self, method: str,
+                client: Optional["RpcClient"]) -> Optional[str]:
         """Apply matching rules; returns "drop" when the message must be
-        lost, raises RpcDisconnected after severing the connection."""
+        lost, raises RpcDisconnected after severing the connection.
+        `client` may be None for socket-less named injection points
+        (`fault_point`): sever then cuts nothing but still raises."""
         for rule in self.rules:
             if not rule.matches(method):
                 continue
@@ -126,10 +129,13 @@ class FaultInjector:
                 return "drop"
             else:  # sever / sever_once
                 self.stats["sever"] += 1
-                client.close()
+                addr = "(no socket)"
+                if client is not None:
+                    client.close()
+                    addr = client.address
                 raise RpcDisconnected(
                     f"[fault-injection seed={self.seed}] severed "
-                    f"{method} to {client.address}")
+                    f"{method} to {addr}")
         return None
 
 
@@ -167,6 +173,21 @@ def install_fault_injector(spec: str, seed: int = 0) -> FaultInjector:
                    "(reproduce with RAY_TPU_FAULT_INJECTION_SPEC/"
                    "RAY_TPU_FAULT_INJECTION_SEED)", spec, seed)
     return inj
+
+
+def fault_point(name: str) -> None:
+    """Named, socket-less injection point for boundaries that are not a
+    single RPC send (e.g. the serve router's replica-call submission,
+    name `serve_replica_call`). Rules target it exactly like an RPC
+    method: `drop`/`sever`/`sever_once` raise RpcDisconnected here (the
+    caller's failover path takes over), `delay` stalls the caller. A
+    no-op (zero overhead beyond one None check) without an injector."""
+    inj = get_fault_injector()
+    if inj is None:
+        return
+    if inj.on_send(name, None) == "drop":
+        raise RpcDisconnected(
+            f"[fault-injection seed={inj.seed}] dropped {name}")
 
 
 def clear_fault_injector() -> None:

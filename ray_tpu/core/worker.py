@@ -625,6 +625,79 @@ class CoreWorker:
         ref._counted = True
         return ref
 
+    # ------------------------------------------------------------- promises
+    def create_promise(self) -> ObjectRef:
+        """An owned object with no producing task: the creator resolves it
+        later via fulfill_promise(). Every consumer path (get/wait/
+        add_done_callback/try_get_local) works unchanged. Serve's router
+        returns one per routed request so a mid-request replica failover
+        can re-point the work without changing the caller-visible ref."""
+        with self._put_lock:
+            self._put_counter += 1
+            put_index = self._put_counter
+        oid = ObjectID.for_put(self._current_task_id, put_index)
+        with self._obj_lock:
+            self._objects[oid] = _ObjectState(local_refs=1)
+        ref = ObjectRef(oid, owner_address=self.address)
+        ref._counted = True
+        return ref
+
+    def fulfill_promise(self, ref: ObjectRef, value: Any = None,
+                        error: Optional[BaseException] = None) -> bool:
+        """Resolve a pending promise with a value or an exception. First
+        resolution wins; returns False if the promise was already terminal
+        (a lost race with the deadline reaper is normal, not an error)."""
+        if error is not None:
+            return self.fulfill_promise_blob(
+                ref, serialization.dumps(error), is_error=True)
+        s = serialization.serialize(value)
+        self._mark_shipped(s.contained_refs)
+        ok = self.fulfill_promise_blob(ref, s.to_bytes(), is_error=False)
+        if ok:
+            # same nested-ref containment as put(): owned refs inside the
+            # stored value get a container pin for the promise's lifetime —
+            # a reader may deserialize (and only then register its borrow)
+            # arbitrarily late, which the shipped grace window alone cannot
+            # cover (reference reference_count.h:834)
+            with self._obj_lock:
+                st = self._objects.get(ref.id)
+                if st is not None:
+                    seen = set()
+                    for r in s.contained_refs or ():
+                        if (r.owner_address == self.address and r.id != ref.id
+                                and r.id not in seen
+                                and r.id in self._objects):
+                            seen.add(r.id)
+                            self._objects[r.id].container_pinned += 1
+                            st.contained_pins.append(r.id)
+        return ok
+
+    def fulfill_promise_blob(self, ref: ObjectRef, blob: bytes,
+                             is_error: bool) -> bool:
+        """Resolve a promise with an already-serialized payload — the
+        zero-reserialization path for relaying another owned object's
+        terminal inline/error blob (serve router success/error relay)."""
+        with self._obj_lock:
+            st = self._objects.get(ref.id)
+            if st is None or st.state != "pending":
+                return False
+            st.state = "error" if is_error else "inline"
+            st.inline_blob = blob
+            st.size = len(blob)
+            self._obj_cv.notify_all()
+        self._notify_info_waiters(ref.id)
+        return True
+
+    def peek_local(self, ref: ObjectRef):
+        """(state, inline_blob) snapshot of an owned object's record —
+        (None, None) if unknown. Non-blocking; lets completion callbacks
+        classify a terminal object without a get()."""
+        with self._obj_lock:
+            st = self._objects.get(ref.id)
+            if st is None:
+                return None, None
+            return st.state, st.inline_blob
+
     def _put_to_store(self, oid: ObjectID, s: SerializedObject) -> None:
         """Write a serialized object into the node store (zero-copy write)."""
         size = s.total_bytes + 12 + 8 * len(s.buffers)

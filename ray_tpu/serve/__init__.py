@@ -9,10 +9,18 @@ from ray_tpu.serve.api import (
     start_http_proxies_per_node,
     start_grpc_proxy,
     start_rpc_proxy,
+    router_stats,
+    reset_router_stats,
     AutoscalingConfig,
     Deployment,
     DeploymentHandle,
 )
-from ray_tpu.serve.config import deploy_config_file, load_config
+from ray_tpu.serve.config import (
+    ServeConfig,
+    deploy_config_file,
+    get_serve_config,
+    load_config,
+    set_serve_config,
+)
 from ray_tpu.serve.ingress import App, Request, RouteNotFound, ingress
 from ray_tpu.serve.batching import batch

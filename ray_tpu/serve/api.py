@@ -17,6 +17,7 @@ chips/slices; model weights travel to replicas through the object store.
 
 from __future__ import annotations
 
+import heapq
 import json
 import logging
 import random
@@ -29,6 +30,10 @@ from typing import Any, Callable, Dict, List, Optional
 import cloudpickle
 
 import ray_tpu
+from ray_tpu.core import rpc as _rpc
+from ray_tpu.core.exceptions import (ActorDiedError, BackPressureError,
+                                     ObjectLostError, RequestTimeoutError,
+                                     WorkerCrashedError)
 
 logger = logging.getLogger(__name__)
 
@@ -36,6 +41,159 @@ CONTROLLER_NAME = "_serve_controller"
 SERVE_VERSIONS_CHANNEL = "serve_replica_versions"
 PROXY_NAME = "_serve_http_proxy"
 GRPC_PROXY_NAME = "_serve_grpc_proxy"
+
+# The named fault-injection point at the router->replica call boundary
+# (rpc.fault_point): chaos rules like `sever:serve_replica_call:0.02`
+# sever/drop/delay individual replica submissions without touching the
+# rest of the worker's links, driving the failover path deterministically.
+REPLICA_CALL_FAULT_POINT = "serve_replica_call"
+
+
+def _serve_cfg():
+    """Serve runtime knobs; imported lazily (serve.config imports this
+    module for the declarative-deploy half)."""
+    from ray_tpu.serve.config import get_serve_config
+
+    return get_serve_config()
+
+
+# Process-local router outcome counters (storm harness + tests read these
+# without a metrics scrape; the tagged metrics below feed dashboards).
+_router_stats_lock = threading.Lock()
+_router_stats: Dict[str, int] = {
+    "retries": 0, "failovers": 0, "shed": 0, "timeouts": 0}
+
+
+def _bump_router_stat(key: str, n: int = 1) -> None:
+    with _router_stats_lock:
+        _router_stats[key] = _router_stats.get(key, 0) + n
+
+
+def router_stats() -> Dict[str, int]:
+    """Snapshot of this process's router outcome counters: `retries`
+    (re-routed attempts), `failovers` (requests that succeeded only after
+    a retry), `shed` (admission-control rejections), `timeouts` (promises
+    failed by the deadline reaper)."""
+    with _router_stats_lock:
+        return dict(_router_stats)
+
+
+def reset_router_stats() -> None:
+    with _router_stats_lock:
+        for k in _router_stats:
+            _router_stats[k] = 0
+
+
+_router_pool_lock = threading.Lock()
+_router_pool_inst = None
+
+
+def _router_pool():
+    """Small shared executor for router work that must not run on the RPC
+    reader thread: failover resubmissions (socket sends + backoff sleeps)
+    and plasma-sized result relays (a blocking pull)."""
+    global _router_pool_inst
+    with _router_pool_lock:
+        if _router_pool_inst is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _router_pool_inst = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="serve-router")
+        return _router_pool_inst
+
+
+class _DeadlineReaper:
+    """Shared wall-clock timer for the router. Two entry kinds: `watch`
+    entries resolve still-pending router promises with a typed
+    RequestTimeoutError at their deadline — the guarantee that no serve
+    request outlives its deadline even when every other signal (replica
+    death notice, result push) is lost — and `schedule` entries run a
+    (cheap) callable at a time, which failover uses for its backoff waits
+    so no router-pool thread ever sleeps. One heap + one lazy thread per
+    process."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._heap: List[tuple] = []
+        self._seq = 0
+        self._thread: Optional[threading.Thread] = None
+
+    def watch(self, deadline_ts: float, promise, name: str,
+              timeout_s: float) -> None:
+        # store the bare ObjectID, NOT the ObjectRef: holding the ref would
+        # pin every promise (and its inline result blob) in the worker's
+        # object table for the full timeout after the request completed —
+        # memory scaling with rps x timeout x response size. With only the
+        # id, a completed-and-dropped promise is freed normally and the
+        # expire entry finds nothing to do.
+        self._push(deadline_ts, ("expire", promise.id, name, timeout_s))
+
+    def schedule(self, when_ts: float, fn: Callable[[], None]) -> None:
+        """Run `fn` at wall-clock `when_ts` on the timer thread — `fn`
+        must be cheap/non-blocking (hand real work to the router pool)."""
+        self._push(when_ts, ("call", fn))
+
+    def _push(self, ts: float, entry: tuple) -> None:
+        with self._cv:
+            self._seq += 1
+            # the unique seq means heapq never compares the entry payload
+            heapq.heappush(self._heap, (ts, self._seq, entry))
+            t = self._thread
+            if t is None or not t.is_alive():
+                t = threading.Thread(target=self._loop,
+                                     name="serve-deadline-reaper", daemon=True)
+                self._thread = t
+                t.start()
+            self._cv.notify_all()
+
+    def _loop(self) -> None:
+        from ray_tpu.core.api import _global_worker
+
+        while True:
+            with self._cv:
+                if not self._heap:
+                    self._cv.wait(timeout=1.0)
+                    if not self._heap:
+                        # exit decision under the cv (watch() holds it while
+                        # pushing + checking liveness) so no entry strands
+                        self._thread = None
+                        return
+                due = self._heap[0][0]
+                wait = due - time.time()
+                if wait > 0:
+                    self._cv.wait(timeout=min(wait, 1.0))
+                    continue
+                _, _, entry = heapq.heappop(self._heap)
+            try:
+                if entry[0] == "call":
+                    entry[1]()
+                    continue
+                _, oid, name, timeout_s = entry
+                from ray_tpu.core.object_ref import ObjectRef
+
+                # ad-hoc ref (never _counted): carries the id for the
+                # table lookup without touching the distributed refcount
+                promise = ObjectRef(oid)
+                w = _global_worker()
+                state, _ = w.peek_local(promise)
+                if state == "pending" and w.fulfill_promise(
+                        promise, error=RequestTimeoutError(
+                            f"request to {name} exceeded its "
+                            f"{timeout_s:.1f}s deadline")):
+                    _bump_router_stat("timeouts")
+                    _serve_metrics()["timeouts"].inc(
+                        tags={"deployment": name})
+            except Exception:
+                logger.exception("deadline reaper entry failed")
+
+
+_deadline_reaper = _DeadlineReaper()
+
+# errors that mean "this replica (or the link to it) died mid-request" —
+# the request itself is intact and an idempotent one may be re-routed
+# (ConnectionError covers rpc.RpcDisconnected, e.g. a severed submission)
+_RETRYABLE_ERRORS = (ActorDiedError, WorkerCrashedError, ObjectLostError,
+                     ConnectionError)
 
 
 @dataclass
@@ -100,8 +258,21 @@ class _ReplicaActor:
         fn(user_config)
         return True
 
-    def handle_request(self, method_name: str, args, kwargs):
+    def handle_request(self, method_name: str, args, kwargs,
+                       deadline_ts: Optional[float] = None):
+        # Remaining-time check BEFORE dispatch: a request whose end-to-end
+        # deadline expired while queued on this replica is dropped with the
+        # typed error instead of occupying an execution slot — under
+        # overload the slots go to requests that can still meet their
+        # deadline (reference request_timeout_s semantics).
+        if deadline_ts is not None and time.time() >= deadline_ts:
+            raise RequestTimeoutError(
+                f"request expired in replica queue (deadline exceeded by "
+                f"{time.time() - deadline_ts:.3f}s before dispatch)")
+        from ray_tpu.serve import batching as _batching
+
         self._inflight += 1
+        prev = _batching.push_request_deadline(deadline_ts)
         try:
             # function deployments and class __call__ both route through the
             # callable itself; named methods are looked up on the instance
@@ -109,6 +280,7 @@ class _ReplicaActor:
                   else getattr(self._callable, method_name))
             return fn(*args, **(kwargs or {}))
         finally:
+            _batching.pop_request_deadline(prev)
             self._inflight -= 1
 
     def health(self) -> bool:
@@ -510,8 +682,13 @@ class ServeController:
             replicas.append(self._new_replica(d))
             changed = True
         while len(replicas) > d["target"]:
+            # Downscale DRAINS like a rolling update: the displaced replica
+            # leaves the routable set now (handles stop picking it on the
+            # version bump) but keeps serving its in-flight requests until
+            # idle, hard-killed only past the same drain_deadline_s knob.
             r = replicas.pop()
-            self._kill_replica(name, r)
+            d.setdefault("_draining", []).append(
+                (r, time.monotonic() + _serve_cfg().drain_deadline_s))
             changed = True
         if self._advance_rollout(name, d, replicas):
             changed = True
@@ -523,7 +700,7 @@ class ServeController:
         DeploymentState rollout): start a new-definition replica, wait for
         its health probe, then swap it in for ONE stale replica — the old
         version keeps serving throughout, and the displaced replica drains
-        (kill once idle, or after a 30 s deadline)."""
+        (kill once idle, or after the configurable drain_deadline_s)."""
         ver = d.get("def_version", 0)
         # reap draining replicas that are idle (or past deadline)
         draining = d.setdefault("_draining", [])
@@ -574,7 +751,8 @@ class ServeController:
             return False
         replicas.append(nr)
         replicas.remove(victim)
-        d["_draining"].append((victim, time.monotonic() + 30.0))
+        d["_draining"].append(
+            (victim, time.monotonic() + _serve_cfg().drain_deadline_s))
         return True
 
     def _evict_stats_client(self, replica) -> None:
@@ -668,6 +846,8 @@ class DeploymentHandle:
         self._version = -1
         self._incarnation = None  # controller incarnation the version is from
         self._stream = False
+        self._timeout_s: Optional[float] = None  # None -> config default
+        self._idempotent = True  # False disables mid-request failover
         self._replicas: List[Any] = []
         # keyed by replica actor id, NOT list index: a replica-set change
         # must not let stale completions decrement a new replica's count
@@ -806,66 +986,316 @@ class DeploymentHandle:
                 pass  # worker shutting down; channel dies with it
             self._sub_cb = None
 
-    def options(self, method_name: str = "__call__",
-                stream: bool = False) -> "DeploymentHandle":
+    def options(self, method_name: str = "__call__", stream: bool = False,
+                timeout_s: Optional[float] = None,
+                idempotent: bool = True) -> "DeploymentHandle":
         h = DeploymentHandle(self._name, method_name)
         h._stream = stream
+        h._timeout_s = timeout_s
+        h._idempotent = idempotent
         return h
 
-    def remote(self, *args, **kwargs):
-        _serve_metrics()["requests"].inc(tags={"deployment": self._name})
-        with self._lock:
-            replicas = list(self._replicas)
-        if not replicas:
-            self._refresh()
-            with self._lock:
-                replicas = list(self._replicas)
-            if not replicas:
-                raise RuntimeError(f"deployment {self._name} has no replicas")
-        self._ensure_refresher()
-        # power of two choices on locally-tracked in-flight counts
-        if len(replicas) == 1:
-            replica = replicas[0]
-        else:
-            a, b = random.sample(range(len(replicas)), 2)
-            ka, kb = self._rkey(replicas[a]), self._rkey(replicas[b])
-            with self._lock:
-                replica = (replicas[a]
-                           if self._inflight.get(ka, 0) <= self._inflight.get(kb, 0)
-                           else replicas[b])
-        key = self._rkey(replica)
+    # ------------------------------------------------------------- routing
+    def _inc(self, key: bytes) -> None:
         with self._lock:
             self._inflight[key] = self._inflight.get(key, 0) + 1
 
-        def _dec():
-            with self._lock:
-                self._inflight[key] = max(0, self._inflight.get(key, 1) - 1)
+    def _dec(self, key: bytes) -> None:
+        with self._lock:
+            self._inflight[key] = max(0, self._inflight.get(key, 1) - 1)
 
+    def _pick_replica(self, exclude=()):
+        """Power-of-two-choices among replicas that are under the
+        configured in-flight cap and not in `exclude` (replicas already
+        tried by this request's failover). Returns (replica, key) or
+        (None, None) when no replica is eligible — the admission-control
+        shed signal when `exclude` is empty."""
+        cap = _serve_cfg().max_queue_per_replica
+        with self._lock:
+            candidates = []
+            for r in self._replicas:
+                k = self._rkey(r)
+                if k in exclude:
+                    continue
+                if self._inflight.get(k, 0) < cap:
+                    candidates.append((r, k))
+            if not candidates:
+                return None, None
+            if len(candidates) == 1:
+                return candidates[0]
+            a, b = random.sample(range(len(candidates)), 2)
+            pick = (a if self._inflight.get(candidates[a][1], 0)
+                    <= self._inflight.get(candidates[b][1], 0) else b)
+            return candidates[pick]
+
+    def _resolve_deadline(self, timeout_s: Optional[float],
+                          deadline_ts: Optional[float]):
+        """(deadline_ts, timeout_s): explicit deadline wins (an ingress
+        already started the request's clock at parse time), else per-call
+        timeout, else the handle default, else the config default. Wall
+        clock, so the deadline survives the hop into the replica process."""
+        if deadline_ts is not None:
+            return deadline_ts, max(0.0, deadline_ts - time.time())
+        t = timeout_s if timeout_s is not None else self._timeout_s
+        if t is None:
+            t = _serve_cfg().request_timeout_s
+        return time.time() + t, t
+
+    def remote(self, *args, _timeout_s: Optional[float] = None,
+               _deadline_ts: Optional[float] = None, **kwargs):
+        _serve_metrics()["requests"].inc(tags={"deployment": self._name})
+        deadline_ts, timeout_s = self._resolve_deadline(
+            _timeout_s, _deadline_ts)
+        with self._lock:
+            have = bool(self._replicas)
+        if not have:
+            self._refresh()
+            with self._lock:
+                if not self._replicas:
+                    raise RuntimeError(
+                        f"deployment {self._name} has no replicas")
+        self._ensure_refresher()
+        if getattr(self, "_stream", False):
+            return self._submit_stream(args, kwargs, deadline_ts)
+
+        replica, key = self._pick_replica()
+        if replica is None:
+            self._shed()
+        budget = (_serve_cfg().request_retry_budget
+                  if self._idempotent else 0)
+        req = _RouterRequest(self, args, kwargs, deadline_ts, timeout_s,
+                             budget)
+        try:
+            req._submit_to(replica, key)
+        except Exception as e:
+            # a submit-time severed link is the same failure class as a
+            # mid-request death: route it through the failover budget
+            if isinstance(e, _RETRYABLE_ERRORS) and req.retries_left > 0:
+                req.tried.add(key)
+                _router_pool().submit(req._failover, e)
+            else:
+                # resolve the already-watched promise so the reaper
+                # doesn't later count a spurious timeout for an error
+                # the caller received synchronously
+                from ray_tpu.core.api import _global_worker
+
+                _global_worker().fulfill_promise(req.promise, error=e)
+                raise
+        return req.promise
+
+    def _submit_stream(self, args, kwargs, deadline_ts: float):
+        """Streaming call (reference handle.options(stream=True)): the
+        replica method returns a generator; items arrive as a dynamic-
+        return stream consumable while the replica still runs. Failover
+        covers the SUBMIT boundary only — once items may have been
+        produced, a replay could duplicate them, so a mid-stream death
+        surfaces as the typed ActorDiedError instead (promptly: the
+        ownership layer fails the stream when the actor dies)."""
         from ray_tpu.core.api import _global_worker
 
-        if getattr(self, "_stream", False):
-            # streaming call (reference handle.options(stream=True)): the
-            # replica method returns a generator; its items arrive as a
-            # dynamic-return stream consumable while the replica still runs
-            gen = replica.handle_request.options(
-                num_returns="dynamic").remote(self._method, args, kwargs)
-            _global_worker().add_done_callback(gen._gen_ref, _dec)
+        budget = _serve_cfg().request_retry_budget if self._idempotent else 0
+        tried: set = set()
+        last_err: Optional[Exception] = None
+        for attempt in range(budget + 1):
+            replica, key = self._pick_replica(tried)
+            if replica is None:
+                if last_err is not None:
+                    raise last_err
+                self._shed()
+            self._inc(key)
+            try:
+                _rpc.fault_point(REPLICA_CALL_FAULT_POINT)
+                gen = replica.handle_request.options(
+                    num_returns="dynamic").remote(
+                        self._method, args, kwargs, deadline_ts)
+            except Exception as e:
+                self._dec(key)
+                if isinstance(e, _RETRYABLE_ERRORS) and attempt < budget:
+                    tried.add(key)
+                    last_err = e
+                    _bump_router_stat("retries")
+                    continue
+                raise
+            _global_worker().add_done_callback(
+                gen._gen_ref, lambda k=key: self._dec(k))
             return gen
-        ref = replica.handle_request.remote(self._method, args, kwargs)
-        _global_worker().add_done_callback(ref, _dec)
-        return ref
+        raise last_err  # budget spent
+
+    def _shed(self):
+        _bump_router_stat("shed")
+        _serve_metrics()["shed"].inc(tags={"deployment": self._name})
+        cfg = _serve_cfg()
+        with self._lock:
+            n = len(self._replicas)
+        raise BackPressureError(
+            f"deployment {self._name} shed request: all {n} replicas at "
+            f"the in-flight cap ({cfg.max_queue_per_replica})")
 
     def __reduce__(self):
-        # the stream flag must survive serialization: a stream handle passed
-        # into another deployment keeps streaming after deserialization
+        # routing options must survive serialization: a handle passed into
+        # another deployment keeps its stream/timeout/idempotence behavior
         return (_rebuild_handle,
-                (self._name, self._method, getattr(self, "_stream", False)))
+                (self._name, self._method, getattr(self, "_stream", False),
+                 self._timeout_s, self._idempotent))
 
 
-def _rebuild_handle(name: str, method: str, stream: bool) -> "DeploymentHandle":
+def _rebuild_handle(name: str, method: str, stream: bool,
+                    timeout_s: Optional[float] = None,
+                    idempotent: bool = True) -> "DeploymentHandle":
     h = DeploymentHandle(name, method)
     h._stream = stream
+    h._timeout_s = timeout_s
+    h._idempotent = idempotent
     return h
+
+
+class _RouterRequest:
+    """One routed unary request. Owns the caller-visible PROMISE ref
+    (worker.create_promise) and chases replica attempts until success, a
+    non-retryable error, a spent retry budget, or the deadline — so a
+    replica dying mid-request re-routes the work without changing the ref
+    the caller (or the HTTP edge's completion callback) is holding.
+    Completion callbacks run on the RPC reader thread and only relay
+    blobs; anything that sleeps or touches sockets (failover resubmits,
+    plasma-sized result pulls) hops to the shared router pool."""
+
+    __slots__ = ("h", "args", "kwargs", "deadline_ts", "retries_left",
+                 "tried", "promise", "backoff", "retried")
+
+    def __init__(self, h: DeploymentHandle, args, kwargs,
+                 deadline_ts: float, timeout_s: float, budget: int):
+        from ray_tpu.core.api import _global_worker
+        from ray_tpu.util.backoff import ExponentialBackoff
+
+        cfg = _serve_cfg()
+        self.h = h
+        self.args = args
+        self.kwargs = kwargs
+        self.deadline_ts = deadline_ts
+        self.retries_left = budget
+        self.tried: set = set()
+        self.retried = False
+        self.backoff = ExponentialBackoff(
+            base_s=cfg.retry_backoff_base_ms / 1000.0,
+            cap_s=cfg.retry_backoff_cap_ms / 1000.0)
+        self.promise = _global_worker().create_promise()
+        _deadline_reaper.watch(deadline_ts, self.promise, h._name, timeout_s)
+
+    def _submit_to(self, replica, key: bytes) -> None:
+        h = self.h
+        h._inc(key)
+        try:
+            _rpc.fault_point(REPLICA_CALL_FAULT_POINT)
+            ref = replica.handle_request.remote(
+                h._method, self.args, self.kwargs, self.deadline_ts)
+        except BaseException:
+            h._dec(key)
+            raise
+        from ray_tpu.core.api import _global_worker
+
+        _global_worker().add_done_callback(
+            ref, lambda: self._on_done(ref, key))
+
+    def _on_done(self, ref, key: bytes) -> None:
+        """Attempt completed (runs on the RPC reader thread: cheap,
+        non-blocking — classify and relay, or hand off to the pool)."""
+        from ray_tpu.core import serialization
+        from ray_tpu.core.api import _global_worker
+
+        h = self.h
+        h._dec(key)
+        w = _global_worker()
+        state, blob = w.peek_local(ref)
+        if state == "inline":
+            # count the failover only if this result actually WON the
+            # promise — a success landing after the deadline reaper already
+            # timed the request out must not count as both
+            if (w.fulfill_promise_blob(self.promise, blob, is_error=False)
+                    and self.retried):
+                _bump_router_stat("failovers")
+            return
+        if state == "plasma":
+            _router_pool().submit(self._relay_plasma, ref)
+            return
+        if state != "error":
+            logger.warning("router attempt for %s resolved in unexpected "
+                           "state %r", h._name, state)
+            return
+        try:
+            err = serialization.loads(blob)
+        except Exception as e:
+            err = e
+        if (isinstance(err, _RETRYABLE_ERRORS) and self.retries_left > 0
+                and time.time() < self.deadline_ts):
+            self.tried.add(key)
+            _router_pool().submit(self._failover, err)
+            return
+        w.fulfill_promise_blob(self.promise, blob, is_error=True)
+
+    def _relay_plasma(self, ref) -> None:
+        """Pool: pull a plasma-sized result and resolve the promise.
+        Costs one deserialize+reserialize (the promise stores the value
+        inline under its own id — the store copy lives under the ATTEMPT's
+        id, which the caller never sees); true zero-copy would need object
+        aliasing in the store. Serve results are overwhelmingly small, so
+        this path is rare; revisit if large-result serving appears."""
+        from ray_tpu.core.api import _global_worker
+
+        try:
+            value = ray_tpu.get(
+                ref, timeout=max(1.0, self.deadline_ts - time.time() + 5.0))
+        except Exception as e:
+            _global_worker().fulfill_promise(self.promise, error=e)
+            return
+        if (_global_worker().fulfill_promise(self.promise, value=value)
+                and self.retried):
+            _bump_router_stat("failovers")
+
+    def _failover(self, err: BaseException, ready: bool = False) -> None:
+        """Pool: budget/deadline-bounded re-route onto a surviving replica.
+        The full-jitter backoff wait (util/backoff.py) is SCHEDULED on the
+        shared timer, never slept in the pool — a mass replica kill with
+        many requests in flight must not park every pool thread in sleeps
+        and starve plasma relays. The root-cause error is preserved across
+        no-eligible-replica scans (each still charges the budget, so the
+        loop stays bounded even before the deadline)."""
+        from ray_tpu.core.api import _global_worker
+
+        h = self.h
+        if time.time() >= self.deadline_ts:
+            return  # the deadline reaper resolves the promise (typed)
+        if self.retries_left <= 0:
+            _global_worker().fulfill_promise(self.promise, error=err)
+            return
+        if not ready:
+            remaining = self.deadline_ts - time.time()
+            delay = min(self.backoff.next_delay(), max(0.0, remaining))
+            _deadline_reaper.schedule(
+                time.time() + delay,
+                lambda: _router_pool().submit(self._failover, err, True))
+            return
+        self.retries_left -= 1
+        try:
+            # the controller may already have replaced the dead replica:
+            # pick up the freshest set without parking on a long-poll
+            h._refresh(block=False)
+        except Exception:
+            pass  # stale set still usable; push refresh is the backstop
+        replica, key = h._pick_replica(self.tried)
+        if replica is None:
+            # keep the root-cause error: the controller may replace the
+            # dead replica before the next scan, and if the budget runs
+            # out the caller should see what actually failed
+            self._failover(err)
+            return
+        self.retried = True
+        _bump_router_stat("retries")
+        _serve_metrics()["retries"].inc(tags={"deployment": h._name})
+        try:
+            self._submit_to(replica, key)
+        except Exception as e:
+            self.tried.add(key)
+            self._failover(e)
 
 
 # ------------------------------------------------------------------ public
@@ -1032,6 +1462,18 @@ def _serve_metrics() -> Dict[str, Any]:
             tag_keys=("deployment",)),
         "errors": get_or_create(
             "counter", "ray_tpu_serve_errors_total", "failed requests",
+            tag_keys=("deployment",)),
+        "shed": get_or_create(
+            "counter", "ray_tpu_serve_shed_total",
+            "requests rejected by admission control",
+            tag_keys=("deployment",)),
+        "retries": get_or_create(
+            "counter", "ray_tpu_serve_retries_total",
+            "failover re-routes after replica loss",
+            tag_keys=("deployment",)),
+        "timeouts": get_or_create(
+            "counter", "ray_tpu_serve_timeouts_total",
+            "requests failed at their end-to-end deadline",
             tag_keys=("deployment",)),
         "latency": get_or_create(
             "histogram", "ray_tpu_serve_latency_seconds", "request latency",

@@ -22,15 +22,41 @@ import threading
 import time
 from typing import Any, Callable, List, Optional
 
+from ray_tpu.core.exceptions import RequestTimeoutError
+
+# Per-thread serve-request context: the replica's handle_request pushes the
+# request's end-to-end deadline before dispatching into user code, so a
+# @serve.batch waiter knows its own deadline without threading it through
+# user signatures. Stack-disciplined (push returns the previous value) so
+# nested deployment calls within one thread restore correctly.
+_request_ctx = threading.local()
+
+
+def push_request_deadline(deadline_ts: Optional[float]) -> Optional[float]:
+    prev = getattr(_request_ctx, "deadline_ts", None)
+    _request_ctx.deadline_ts = deadline_ts
+    return prev
+
+
+def pop_request_deadline(prev: Optional[float]) -> None:
+    _request_ctx.deadline_ts = prev
+
+
+def current_request_deadline() -> Optional[float]:
+    """Wall-clock deadline of the serve request on this thread (None
+    outside a deadline-carrying request)."""
+    return getattr(_request_ctx, "deadline_ts", None)
+
 
 class _Waiter:
-    __slots__ = ("arg", "event", "result", "error")
+    __slots__ = ("arg", "event", "result", "error", "deadline_ts")
 
     def __init__(self, arg):
         self.arg = arg
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
+        self.deadline_ts = current_request_deadline()
 
 
 class _Batcher:
@@ -79,6 +105,22 @@ class _Batcher:
                                  daemon=True).start()
             else:
                 self._leader_running = False
+        # Drop waiters whose end-to-end deadline expired while queued for
+        # the batch window: they get the typed error immediately and the
+        # underlying invocation is spent only on requests a caller is
+        # still waiting for (the same pre-dequeue discipline the replica
+        # applies before dispatch).
+        now = time.time()
+        expired = [w for w in batch
+                   if w.deadline_ts is not None and now >= w.deadline_ts]
+        if expired:
+            batch = [w for w in batch if w not in expired]
+            for w in expired:
+                w.error = RequestTimeoutError(
+                    "request expired in batch queue before the batch ran")
+                w.event.set()
+            if not batch:
+                return
         try:
             args = [w.arg for w in batch]
             results = (self.fn(self_arg, args) if self_arg is not _NO_SELF

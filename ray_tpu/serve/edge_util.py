@@ -9,7 +9,34 @@ is the single home for that plumbing; the edges stay thin."""
 from __future__ import annotations
 
 import asyncio
-from typing import Any
+from typing import Any, Optional
+
+
+def typed_error_kind(e: BaseException) -> Optional[str]:
+    """Classify a serve-plane error for edge status mapping: "timeout"
+    (end-to-end deadline spent), "shed" (admission control), or
+    "route_not_found" (app ingress); None for everything else. One home
+    for the isinstance-plus-type-name check — the NAME fallback matters
+    because an error deserialized from a replica process must map the
+    same as the live class."""
+    from ray_tpu.core.exceptions import (BackPressureError, GetTimeoutError,
+                                         RequestTimeoutError)
+
+    name = type(e).__name__
+    if (isinstance(e, (RequestTimeoutError, GetTimeoutError,
+                       asyncio.TimeoutError))
+            or name in ("RequestTimeoutError", "GetTimeoutError")):
+        return "timeout"
+    if isinstance(e, BackPressureError) or name == "BackPressureError":
+        return "shed"
+    try:
+        from ray_tpu.serve.ingress import RouteNotFound
+
+        if isinstance(e, RouteNotFound) or name == "RouteNotFound":
+            return "route_not_found"
+    except ImportError:
+        pass
+    return None
 
 
 async def await_ref(loop, ref, timeout: float) -> None:

@@ -13,8 +13,15 @@ HTTP edge (thread-free, no per-stream parking).
 
 Routing metadata: `deployment` (required), `method` (default `__call__`),
 `content-type` (`application/json` decodes the request bytes to a JSON
-payload; anything else passes raw bytes through). Responses: bytes pass
-through; str encodes utf-8; other values JSON-encode.
+payload; anything else passes raw bytes through), `timeout-s` (per-request
+end-to-end deadline, default `ServeConfig.request_timeout_s`). Responses:
+bytes pass through; str encodes utf-8; other values JSON-encode.
+
+Overload robustness mirrors the HTTP edge: the deadline is threaded
+through the router into the replica; expiry aborts with
+DEADLINE_EXCEEDED, an admission-control shed (typed BackPressureError)
+aborts with RESOURCE_EXHAUSTED — both with the typed error name in the
+status details.
 """
 
 from __future__ import annotations
@@ -23,12 +30,15 @@ import asyncio
 import json
 import logging
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Tuple
 
 logger = logging.getLogger(__name__)
 
-_REQUEST_TIMEOUT_S = 60.0
+# backstop past the request deadline (the router's deadline reaper
+# resolves the promise AT the deadline; this only fires if that broke)
+_EDGE_GRACE_S = 5.0
 
 SERVICE = "rayserve.Ingress"
 
@@ -64,27 +74,61 @@ class GrpcIngress:
         from ray_tpu.serve.edge_util import (await_next_stream_item,
                                              await_ref, fetch_value)
 
+        async def _abort_typed(context, e: BaseException):
+            """Typed status mapping (the HTTP edge's 504/503 analog)."""
+            from ray_tpu.serve.edge_util import typed_error_kind
+
+            kind = typed_error_kind(e)
+            if kind == "timeout":
+                code = grpc.StatusCode.DEADLINE_EXCEEDED
+            elif kind == "shed":
+                code = grpc.StatusCode.RESOURCE_EXHAUSTED
+            elif isinstance(e, ValueError):
+                # bad routing/timeout metadata (the HTTP edge's 400)
+                code = grpc.StatusCode.INVALID_ARGUMENT
+            else:
+                raise e
+            await context.abort(code, f"{type(e).__name__}: {e}")
+
         async def predict(request: bytes, context) -> bytes:
-            name, method, payload = self._route(request, context)
-            ref = await self._submit(self._get_handle(name, method), payload)
-            await await_ref(self._loop, ref, _REQUEST_TIMEOUT_S)
-            return _encode(await fetch_value(self._loop, self._pool, ref,
-                                             _REQUEST_TIMEOUT_S))
+            try:
+                name, method, payload, deadline_ts, timeout_s = \
+                    self._route(request, context)
+                ref = await self._submit(self._get_handle(name, method),
+                                         payload, deadline_ts)
+                await await_ref(self._loop, ref, timeout_s + _EDGE_GRACE_S)
+                return _encode(await fetch_value(
+                    self._loop, self._pool, ref, timeout_s + _EDGE_GRACE_S))
+            except Exception as e:
+                await _abort_typed(context, e)
 
         async def predict_stream(request: bytes, context):
-            name, method, payload = self._route(request, context)
-            gen = await self._submit(
-                self._get_stream_handle(name, method), payload)
-            while True:
-                if not gen._done:
-                    await await_next_stream_item(self._loop, gen,
-                                                 _REQUEST_TIMEOUT_S)
-                try:
-                    ref = next(gen)
-                except StopIteration:
-                    break
-                yield _encode(await fetch_value(self._loop, self._pool, ref,
-                                                _REQUEST_TIMEOUT_S))
+            try:
+                name, method, payload, deadline_ts, timeout_s = \
+                    self._route(request, context)
+                gen = await self._submit(
+                    self._get_stream_handle(name, method), payload,
+                    deadline_ts)
+                while True:
+                    remaining = deadline_ts - time.time()
+                    if remaining <= 0:
+                        from ray_tpu.core.exceptions import \
+                            RequestTimeoutError
+
+                        raise RequestTimeoutError(
+                            "stream exceeded its request deadline")
+                    if not gen._done:
+                        await await_next_stream_item(
+                            self._loop, gen, remaining + _EDGE_GRACE_S)
+                    try:
+                        ref = next(gen)
+                    except StopIteration:
+                        break
+                    yield _encode(await fetch_value(
+                        self._loop, self._pool, ref,
+                        remaining + _EDGE_GRACE_S))
+            except Exception as e:
+                await _abort_typed(context, e)
 
         def run() -> None:
             asyncio.set_event_loop(self._loop)
@@ -112,20 +156,33 @@ class GrpcIngress:
 
     # --------------------------------------------------------------- helpers
     @staticmethod
-    def _route(request: bytes, context) -> Tuple[str, str, Any]:
+    def _route(request: bytes, context):
+        from ray_tpu.serve.config import get_serve_config
+
         md = dict(context.invocation_metadata())
         name = md.get("deployment")
         if not name:
             raise ValueError("missing 'deployment' metadata")
         method = md.get("method", "__call__")
         payload = _decode(request, md.get("content-type", "application/json"))
-        return name, method, payload
+        import math
 
-    async def _submit(self, handle, payload):
+        try:
+            timeout_s = float(md.get("timeout-s") or
+                              get_serve_config().request_timeout_s)
+        except ValueError:
+            raise ValueError(f"bad timeout-s metadata: {md.get('timeout-s')!r}")
+        if not math.isfinite(timeout_s) or timeout_s <= 0:
+            raise ValueError(f"timeout-s must be finite and > 0, "
+                             f"got {md.get('timeout-s')!r}")
+        return name, method, payload, time.time() + timeout_s, timeout_s
+
+    async def _submit(self, handle, payload, deadline_ts):
         if getattr(handle, "_replicas", None):
-            return handle.remote(payload)
+            return handle.remote(payload, _deadline_ts=deadline_ts)
         return await self._loop.run_in_executor(
-            self._pool, handle.remote, payload)
+            self._pool,
+            lambda: handle.remote(payload, _deadline_ts=deadline_ts))
 
     def stop(self) -> None:
         async def _shutdown() -> None:

@@ -18,6 +18,14 @@ Features the reference edge has that the old one lacked:
   (reference streaming HTTP responses, http_proxy.py + serve handles'
   `options(stream=True)`).
 - keep-alive connections.
+
+Overload robustness: every request carries an end-to-end deadline
+(`?timeout_s=` query param or `X-Request-Timeout-S` header; default
+`ServeConfig.request_timeout_s`) threaded through the router into the
+replica. Deadline expiry answers 504 and an admission-control shed — the
+router's per-replica in-flight cap, or this proxy's own in-flight cap —
+answers 503, both with the typed error name in the JSON body, so a hung or
+dying replica can never hold a proxy connection open forever.
 """
 
 from __future__ import annotations
@@ -35,7 +43,25 @@ logger = logging.getLogger(__name__)
 
 _MAX_HEADER_BYTES = 64 * 1024
 _MAX_BODY_BYTES = 512 * 1024 * 1024
-_REQUEST_TIMEOUT_S = 60.0
+# grace past the request deadline before the edge's own await gives up: the
+# router's deadline reaper resolves the promise AT the deadline, so this
+# backstop only fires if the promise machinery itself is broken
+_EDGE_GRACE_S = 5.0
+
+
+def _error_payload(e: BaseException) -> bytes:
+    """JSON error body with the TYPED name — clients and the storm harness
+    key on `type`, not the message."""
+    return json.dumps({"error": str(e), "type": type(e).__name__}).encode()
+
+
+def _error_status(e: BaseException) -> int:
+    """Map typed serve errors to HTTP statuses (504 deadline, 503 shed,
+    404 unmatched app route, 500 everything else)."""
+    from ray_tpu.serve.edge_util import typed_error_kind
+
+    return {"route_not_found": 404, "shed": 503,
+            "timeout": 504}.get(typed_error_kind(e), 500)
 
 
 class _BadRequest(Exception):
@@ -50,6 +76,9 @@ class AsyncHTTPProxy:
         deployment handles (injected so this module stays import-light)."""
         self._get_handle = get_handle
         self._get_stream_handle = get_stream_handle
+        # proxy-level admission control: in-flight requests this edge will
+        # hold before shedding with 503 (mutated only on the loop thread)
+        self._inflight = 0
         # submissions + ready-object fetches; sized generously because every
         # operation on it is short (submit) or instant (terminal-state get).
         # Streams don't park threads here: item arrival is event-driven
@@ -116,7 +145,8 @@ class AsyncHTTPProxy:
     def _response(status: int, body: bytes, content_type: str,
                   close: bool) -> bytes:
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                  500: "Internal Server Error"}.get(status, "")
+                  500: "Internal Server Error", 503: "Service Unavailable",
+                  504: "Gateway Timeout"}.get(status, "")
         return (f"HTTP/1.1 {status} {reason}\r\n"
                 f"Content-Type: {content_type}\r\n"
                 f"Content-Length: {len(body)}\r\n"
@@ -162,12 +192,30 @@ class AsyncHTTPProxy:
     def _parse_target(self, req: dict):
         """Route `/<deployment>[/<method>]` with `?stream=1` selecting the
         chunked streaming path (the method must return a generator).
-        Returns (name, method, payload, stream, subpath, query): app-
-        ingress deployments re-route on subpath at dispatch time."""
+        Returns (name, method, payload, stream, subpath, query, timeout_s):
+        app-ingress deployments re-route on subpath at dispatch time; the
+        per-request deadline comes from `?timeout_s=` / the
+        `X-Request-Timeout-S` header, default ServeConfig.request_timeout_s."""
+        from ray_tpu.serve.config import get_serve_config
+
         parsed = urlparse(req["target"])
         parts = [p for p in parsed.path.split("/") if p]
         query = dict(parse_qsl(parsed.query))
         stream = query.pop("stream", "0") in ("1", "true")
+        import math
+
+        raw_timeout = (query.pop("timeout_s", None)
+                       or req["headers"].get("x-request-timeout-s"))
+        try:
+            timeout_s = float(raw_timeout) if raw_timeout else \
+                get_serve_config().request_timeout_s
+        except ValueError:
+            raise _BadRequest(f"bad timeout_s: {raw_timeout!r}")
+        # NaN passes a naive <= 0 check and poisons the deadline math;
+        # inf would park a reaper entry forever
+        if not math.isfinite(timeout_s) or timeout_s <= 0:
+            raise _BadRequest(f"timeout_s must be finite and > 0, "
+                              f"got {raw_timeout!r}")
         if not parts:
             raise _BadRequest("no deployment in path")
         name = parts[0]
@@ -192,7 +240,7 @@ class AsyncHTTPProxy:
                     payload = req["body"]
             else:
                 payload = req["body"]  # raw/binary passthrough
-        return name, method, payload, stream, subpath, query
+        return name, method, payload, stream, subpath, query, timeout_s
 
     async def _is_app_ingress(self, name: str) -> bool:
         """Whether `name` is an @serve.ingress app deployment. The flag
@@ -207,13 +255,14 @@ class AsyncHTTPProxy:
         return getattr(call_handle, "_app_ingress", False)
 
     async def _dispatch(self, req: dict, writer) -> None:
+        from ray_tpu.core.exceptions import BackPressureError
         from ray_tpu.serve.api import _serve_metrics
+        from ray_tpu.serve.config import get_serve_config
         from ray_tpu.serve.edge_util import await_ref, fetch_value
-        from ray_tpu.serve.ingress import RouteNotFound
 
         t0 = time.monotonic()
         try:
-            name, method, payload, stream, subpath, query = \
+            name, method, payload, stream, subpath, query, timeout_s = \
                 self._parse_target(req)
         except _BadRequest as e:
             writer.write(self._response(
@@ -221,6 +270,19 @@ class AsyncHTTPProxy:
                 "application/json", req["close"]))
             await writer.drain()
             return
+        deadline_ts = time.time() + timeout_s
+        # proxy-level admission control (shed site #1): bound the requests
+        # this edge holds open so a storm degrades to fast 503s here
+        # before it can exhaust proxy memory/file descriptors
+        if self._inflight >= get_serve_config().proxy_max_inflight:
+            e = BackPressureError(
+                f"proxy at in-flight cap "
+                f"({get_serve_config().proxy_max_inflight}); request shed")
+            writer.write(self._response(
+                503, _error_payload(e), "application/json", req["close"]))
+            await writer.drain()
+            return
+        self._inflight += 1
         # no requests.inc here: the handle's remote() counts it (this
         # process), exactly as the edge always has
         try:
@@ -231,7 +293,8 @@ class AsyncHTTPProxy:
                 if app_ingress:
                     raise _BadRequest(
                         "app-ingress deployments do not support ?stream=1")
-                await self._dispatch_stream(name, method, payload, req, writer)
+                await self._dispatch_stream(name, method, payload, req,
+                                            writer, deadline_ts)
             else:
                 if app_ingress:
                     method = "__call__"
@@ -245,13 +308,17 @@ class AsyncHTTPProxy:
                 if getattr(handle, "_replicas", None):
                     # warm handle: submission is sample + one socket send —
                     # cheaper than a thread hop
-                    ref = handle.remote(payload)
+                    ref = handle.remote(payload, _deadline_ts=deadline_ts)
                 else:
                     ref = await self._loop.run_in_executor(
-                        self._pool, handle.remote, payload)
-                await await_ref(self._loop, ref, _REQUEST_TIMEOUT_S)
+                        self._pool,
+                        lambda: handle.remote(payload,
+                                              _deadline_ts=deadline_ts))
+                # the router's deadline reaper resolves the promise AT the
+                # deadline; the edge timeout is only the backstop behind it
+                await await_ref(self._loop, ref, timeout_s + _EDGE_GRACE_S)
                 out = await fetch_value(self._loop, self._pool, ref,
-                                        _REQUEST_TIMEOUT_S)
+                                        timeout_s + _EDGE_GRACE_S)
                 body, ctype = self._encode_result(out)
                 writer.write(self._response(200, body, ctype, req["close"]))
                 await writer.drain()
@@ -262,38 +329,51 @@ class AsyncHTTPProxy:
             await writer.drain()
         except Exception as e:
             _serve_metrics()["errors"].inc(tags={"deployment": name})
-            # unmatched app routes surface as 404, not server errors (the
-            # type check handles both the live exception and its
+            # typed mapping: 504 on deadline expiry, 503 on shed, 404 on
+            # unmatched app routes, 500 otherwise — with the error type
+            # name in the body (works for both the live exception and its
             # deserialized-from-the-replica form)
-            status = 404 if (isinstance(e, RouteNotFound)
-                             or type(e).__name__ == "RouteNotFound") else 500
             writer.write(self._response(
-                status, json.dumps({"error": str(e)}).encode(),
+                _error_status(e), _error_payload(e),
                 "application/json", req["close"]))
             await writer.drain()
         finally:
+            self._inflight -= 1
             _serve_metrics()["latency"].observe(
                 time.monotonic() - t0, tags={"deployment": name})
 
     async def _dispatch_stream(self, name: str, method: str, payload: Any,
-                               req: dict, writer) -> None:
+                               req: dict, writer,
+                               deadline_ts: Optional[float] = None) -> None:
         """Chunked-encoding relay of a streaming deployment: each object the
         replica's generator yields becomes one HTTP chunk as soon as it is
         reported — tokens reach the client while the model still decodes.
         Item arrival rides the same add_done_callback mechanism as the
         non-streaming path (reference http_proxy.py's async streaming
-        model), so there is NO thread-per-live-stream and no stream cap."""
+        model), so there is NO thread-per-live-stream and no stream cap.
+        The request deadline bounds the WHOLE stream: when it expires
+        mid-stream, a typed error chunk + clean terminator go out instead
+        of the connection hanging on a stalled replica."""
+        from ray_tpu.serve.config import get_serve_config
         from ray_tpu.serve.edge_util import (await_next_stream_item,
                                              fetch_value)
 
+        if deadline_ts is None:
+            deadline_ts = time.time() + get_serve_config().request_timeout_s
+
+        def _remaining() -> float:
+            return max(0.001, deadline_ts - time.time() + _EDGE_GRACE_S)
+
         # submit BEFORE the 200 goes out: submission failures (no replicas,
-        # unknown deployment) still produce a clean 500 via the caller
+        # unknown deployment, back-pressure shed) still produce a clean
+        # typed 503/500 via the caller
         handle = self._get_stream_handle(name, method)
         if getattr(handle, "_replicas", None):
-            gen = handle.remote(payload)
+            gen = handle.remote(payload, _deadline_ts=deadline_ts)
         else:
             gen = await self._loop.run_in_executor(
-                self._pool, handle.remote, payload)
+                self._pool,
+                lambda: handle.remote(payload, _deadline_ts=deadline_ts))
         writer.write((
             "HTTP/1.1 200 OK\r\n"
             "Content-Type: application/x-ndjson\r\n"
@@ -307,15 +387,20 @@ class AsyncHTTPProxy:
         # Errors become a final error chunk + a CLEAN chunk terminator.
         try:
             while True:
+                if time.time() >= deadline_ts:
+                    from ray_tpu.core.exceptions import RequestTimeoutError
+
+                    raise RequestTimeoutError(
+                        "stream exceeded its request deadline")
                 if not gen._done:
                     await await_next_stream_item(self._loop, gen,
-                                                 _REQUEST_TIMEOUT_S)
+                                                 _remaining())
                 try:
                     ref = next(gen)
                 except StopIteration:
                     break
                 item = await fetch_value(self._loop, self._pool, ref,
-                                         _REQUEST_TIMEOUT_S)
+                                         _remaining())
                 if isinstance(item, (bytes, bytearray, memoryview)):
                     chunk = bytes(item)
                 elif isinstance(item, str):
@@ -328,7 +413,8 @@ class AsyncHTTPProxy:
             from ray_tpu.serve.api import _serve_metrics
 
             _serve_metrics()["errors"].inc(tags={"deployment": name})
-            err = json.dumps({"error": str(e)}).encode() + b"\n"
+            err = json.dumps({"error": str(e),
+                              "type": type(e).__name__}).encode() + b"\n"
             writer.write(f"{len(err):x}\r\n".encode() + err + b"\r\n")
         writer.write(b"0\r\n\r\n")
         await writer.drain()

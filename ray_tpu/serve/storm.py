@@ -1,0 +1,382 @@
+"""Traffic-storm chaos harness for the serve plane.
+
+Drives sustained synthetic load at a configurable multiple of a
+deployment's estimated capacity (default ~4x) against a multi-replica
+autoscaling deployment while chaos runs underneath it: PR 3's seeded
+`FaultInjector` drops/severs router->replica submissions at the named
+`serve_replica_call` boundary, and a kill loop hard-kills a live replica
+every few seconds (the health check replaces it; in-flight requests fail
+over). The harness then asserts the serve plane's overload contract:
+
+  EVERY submitted request resolves — as a result, a typed
+  `RequestTimeoutError`, or a typed `BackPressureError` shed — within its
+  deadline (+ grace). Zero hung requests, ever.
+
+Results (accepted/shed/retried counts, p50/p99 latency of accepted
+requests, the injection seed) are written as a tracked JSON artifact
+(SERVESTORM_r09.json). Run directly:
+
+    python -m ray_tpu.serve.storm            # 30 s storm, writes artifact
+    python -m ray_tpu.serve.storm --quick    # ~6 s CI profile
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_ARTIFACT = "SERVESTORM_r09.json"
+DEFAULT_FAULT_SPEC = "drop:serve_replica_call:0.02"
+
+
+@dataclass
+class StormProfile:
+    """One storm's shape. Capacity is estimated as
+    `num_replicas * replica_concurrency / service_time_s`; the offered
+    rate is `overload * capacity`."""
+
+    duration_s: float = 30.0
+    overload: float = 4.0
+    request_timeout_s: float = 2.0
+    service_time_s: float = 0.1
+    num_replicas: int = 2
+    max_replicas: int = 4
+    replica_concurrency: int = 4
+    max_queue_per_replica: int = 8
+    retry_budget: int = 3
+    kill_period_s: float = 5.0
+    fault_spec: str = DEFAULT_FAULT_SPEC
+    seed: int = 0
+    submitter_threads: int = 4
+    resolve_grace_s: float = 10.0
+
+    @property
+    def capacity_rps(self) -> float:
+        # the controller floors replica max_concurrency at 4 — use the
+        # effective value so "4x capacity" means what it says. With the
+        # defaults the offered rate exceeds even the fully-autoscaled
+        # (max_replicas) capacity 2x, so overload persists through scale-up.
+        return (self.num_replicas * max(4, self.replica_concurrency)
+                / self.service_time_s)
+
+    @property
+    def offered_rps(self) -> float:
+        return self.overload * self.capacity_rps
+
+
+QUICK_PROFILE = dict(duration_s=6.0, kill_period_s=2.0)
+
+
+@dataclass
+class _Outcomes:
+    submitted: int = 0
+    accepted: int = 0       # resolved with a result
+    shed: int = 0           # typed BackPressureError (router or submit)
+    timeout: int = 0        # typed RequestTimeoutError / GetTimeoutError
+    replica_death: int = 0  # typed ActorDiedError & co past the budget
+    other_error: int = 0
+    hung: int = 0           # never resolved: the contract violation
+    latencies_ms: List[float] = field(default_factory=list)
+
+
+def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+def run_storm(profile: Optional[StormProfile] = None,
+              out_path: Optional[str] = DEFAULT_ARTIFACT) -> Dict[str, Any]:
+    """Run one storm against a fresh deployment on the CURRENT cluster
+    (caller has already ray_tpu.init'd). Returns the result dict (also
+    written to `out_path` unless None). Raises nothing on a dirty storm —
+    the caller asserts on `result["requests"]["hung"]` etc."""
+    from ray_tpu.core import rpc as _rpc
+    from ray_tpu.serve.config import get_serve_config
+
+    p = profile or StormProfile()
+    rng = random.Random(p.seed)
+    cfg = get_serve_config()
+    saved = {k: getattr(cfg, k) for k in
+             ("max_queue_per_replica", "request_retry_budget")}
+    cfg.max_queue_per_replica = p.max_queue_per_replica
+    cfg.request_retry_budget = p.retry_budget
+    injector = (_rpc.install_fault_injector(p.fault_spec, p.seed)
+                if p.fault_spec else None)
+    try:
+        return _run_storm_inner(p, rng, injector, out_path)
+    finally:
+        # an aborted storm must not leave the process dropping 2% of every
+        # replica call (or storm-sized caps) for whatever runs next
+        if injector is not None:
+            _rpc.clear_fault_injector()
+        for k, v in saved.items():
+            setattr(cfg, k, v)
+
+
+def _run_storm_inner(p: StormProfile, rng: random.Random, injector,
+                     out_path: Optional[str]) -> Dict[str, Any]:
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.core.exceptions import (ActorDiedError, BackPressureError,
+                                         GetTimeoutError,
+                                         RequestTimeoutError,
+                                         WorkerCrashedError)
+
+    service_time_s = p.service_time_s
+
+    @serve.deployment(
+        name="storm_target",
+        num_replicas=p.num_replicas,
+        max_concurrent_queries=p.replica_concurrency,
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=p.num_replicas, max_replicas=p.max_replicas,
+            target_num_ongoing_requests_per_replica=p.replica_concurrency,
+            upscale_delay_s=1.0, downscale_delay_s=30.0),
+    )
+    class StormTarget:
+        def __call__(self, i):
+            time.sleep(service_time_s)
+            return i
+
+    handle = serve.run(StormTarget.bind(), name="storm")
+    # warm: every replica answered once before the clock starts
+    ray_tpu.get([handle.remote(i) for i in range(p.num_replicas * 2)],
+                timeout=60)
+    serve.reset_router_stats()
+
+    out = _Outcomes()
+    out_lock = threading.Lock()
+    done_q: "queue.Queue" = queue.Queue()
+    outstanding = threading.Semaphore(0)  # released once per resolution
+    stop = threading.Event()
+    kills = 0
+
+    from ray_tpu.core.api import _global_worker
+
+    w = _global_worker()
+
+    def classify(err: Optional[BaseException]) -> str:
+        if err is None:
+            return "accepted"
+        if isinstance(err, BackPressureError):
+            return "shed"
+        if isinstance(err, (RequestTimeoutError, GetTimeoutError)):
+            return "timeout"
+        if isinstance(err, (ActorDiedError, WorkerCrashedError,
+                            ConnectionError)):
+            return "replica_death"
+        return "other_error"
+
+    def collector() -> None:
+        while True:
+            item = done_q.get()
+            if item is None:
+                return
+            ref, t0, t1 = item
+            err = None
+            try:
+                ray_tpu.get(ref, timeout=5)  # terminal: instant
+            except Exception as e:
+                err = e
+            kind = classify(err)
+            with out_lock:
+                setattr(out, kind, getattr(out, kind) + 1)
+                if kind == "accepted":
+                    out.latencies_ms.append((t1 - t0) * 1e3)
+            outstanding.release()
+
+    def submitter(idx: int) -> None:
+        interval = p.submitter_threads / p.offered_rps
+        next_t = time.perf_counter() + rng.random() * interval
+        i = 0
+        while not stop.is_set():
+            now = time.perf_counter()
+            if now < next_t:
+                time.sleep(min(interval, next_t - now))
+                continue
+            next_t += interval
+            i += 1
+            with out_lock:
+                out.submitted += 1
+            t0 = time.perf_counter()
+            try:
+                ref = handle.remote((idx, i),
+                                    _timeout_s=p.request_timeout_s)
+            except BackPressureError:
+                with out_lock:
+                    out.shed += 1
+                outstanding.release()
+                continue
+            except Exception:
+                with out_lock:
+                    out.other_error += 1
+                outstanding.release()
+                continue
+            w.add_done_callback(
+                ref, lambda r=ref, t=t0: done_q.put(
+                    (r, t, time.perf_counter())))
+
+    def killer() -> None:
+        # victims come from the HANDLE's push-refreshed replica set (local,
+        # no controller RPC: under a storm the controller's exec slots are
+        # busy autoscaling/health-checking and an RPC here can starve)
+        nonlocal kills
+        while not stop.wait(p.kill_period_s):
+            try:
+                with handle._lock:
+                    replicas = list(handle._replicas)
+                if len(replicas) < 2:
+                    continue  # never kill the last replica
+                victim = replicas[rng.randrange(len(replicas))]
+                ray_tpu.kill(victim)
+                kills += 1
+                logger.info("storm killed replica %s", victim)
+            except Exception:
+                logger.warning("storm kill pass failed", exc_info=True)
+
+    collect_t = threading.Thread(target=collector, daemon=True)
+    collect_t.start()
+    kill_t = threading.Thread(target=killer, daemon=True)
+    kill_t.start()
+    subs = [threading.Thread(target=submitter, args=(k,), daemon=True)
+            for k in range(p.submitter_threads)]
+    t_start = time.perf_counter()
+    for t in subs:
+        t.start()
+    time.sleep(p.duration_s)
+    stop.set()
+    for t in subs:
+        t.join(timeout=10)
+    kill_t.join(timeout=p.kill_period_s + 10)
+    elapsed = time.perf_counter() - t_start
+
+    # Every submitted request must RESOLVE (result / typed timeout / typed
+    # shed) within deadline + grace; anything left is a hung request.
+    resolve_deadline = time.monotonic() + p.request_timeout_s + p.resolve_grace_s
+    with out_lock:
+        submitted = out.submitted
+    resolved = 0
+    while resolved < submitted and time.monotonic() < resolve_deadline:
+        if outstanding.acquire(timeout=0.25):
+            resolved += 1
+    done_q.put(None)
+    collect_t.join(timeout=10)
+    with out_lock:
+        out.hung = submitted - resolved
+
+    stats = serve.router_stats()
+    lat = sorted(out.latencies_ms)
+    result: Dict[str, Any] = {
+        "bench": "serve_storm",
+        "round": 9,
+        "seed": p.seed,
+        "fault_spec": p.fault_spec,
+        "fault_stats": dict(injector.stats) if injector else {},
+        "duration_s": round(elapsed, 2),
+        "capacity_rps_est": round(p.capacity_rps, 1),
+        "offered_rps": round(p.offered_rps, 1),
+        "overload_x": p.overload,
+        "request_timeout_s": p.request_timeout_s,
+        "replicas": {"min": p.num_replicas, "max": p.max_replicas,
+                     "concurrency": p.replica_concurrency,
+                     "kills": kills},
+        "requests": {
+            "submitted": out.submitted,
+            "accepted": out.accepted,
+            "shed": out.shed,
+            "timeout": out.timeout,
+            "replica_death": out.replica_death,
+            "other_error": out.other_error,
+            "hung": out.hung,
+        },
+        "router": stats,
+        "latency_ms": {
+            "p50_accepted": round(_percentile(lat, 0.50) or 0.0, 2),
+            "p99_accepted": round(_percentile(lat, 0.99) or 0.0, 2),
+        },
+        "zero_hung": out.hung == 0,
+    }
+    serve.delete("storm_target")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+    return result
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    import ray_tpu
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--overload", type=float, default=4.0)
+    ap.add_argument("--seed", type=int, default=None,
+                    help="fault-injection + kill-choice seed (default: "
+                         "RAY_TPU_FAULT_INJECTION_SEED or 0)")
+    ap.add_argument("--quick", action="store_true",
+                    help="short CI profile (~6 s)")
+    ap.add_argument("--json", default=DEFAULT_ARTIFACT,
+                    help=f"artifact path (default {DEFAULT_ARTIFACT})")
+    args = ap.parse_args(argv)
+
+    import os
+
+    seed = (args.seed if args.seed is not None
+            else int(os.environ.get("RAY_TPU_FAULT_INJECTION_SEED", "0")))
+    kw: Dict[str, Any] = dict(seed=seed, overload=args.overload,
+                              duration_s=args.duration)
+    if args.quick:
+        kw.update(QUICK_PROFILE)
+    profile = StormProfile(**kw)
+
+    ray_tpu.init(num_cpus=max(8, profile.max_replicas + 2),
+                 resources={"TPU": 8})
+    try:
+        result = run_storm(profile, out_path=args.json)
+    finally:
+        try:
+            from ray_tpu import serve
+
+            serve.shutdown()
+        finally:
+            ray_tpu.shutdown()
+
+    req = result["requests"]
+    print(f"serve storm: seed={result['seed']} "
+          f"offered={result['offered_rps']}rps "
+          f"(~{result['overload_x']}x capacity "
+          f"{result['capacity_rps_est']}rps) for {result['duration_s']}s, "
+          f"kills={result['replicas']['kills']}")
+    print(f"  submitted={req['submitted']} accepted={req['accepted']} "
+          f"shed={req['shed']} timeout={req['timeout']} "
+          f"replica_death={req['replica_death']} "
+          f"other={req['other_error']} hung={req['hung']}")
+    print(f"  router retries={result['router']['retries']} "
+          f"failovers={result['router']['failovers']} "
+          f"p50_accepted={result['latency_ms']['p50_accepted']}ms "
+          f"p99_accepted={result['latency_ms']['p99_accepted']}ms")
+    if args.json:
+        print(f"  artifact: {args.json}")
+    if req["hung"] or not result["zero_hung"]:
+        print(f"STORM FAILED: {req['hung']} hung request(s) "
+              f"(seed {result['seed']})")
+        return 1
+    print("storm clean: every request resolved within its deadline")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,466 @@
+"""Serve-plane overload robustness: end-to-end deadlines, admission
+control (typed shed), and mid-request failover when replicas die with
+requests in flight (ISSUE 9 tentpole).
+
+Contract under test: a serve request NEVER hangs — it resolves as a
+result, a typed RequestTimeoutError, or a typed BackPressureError, within
+its deadline. Tests that need a knob inside worker processes (controller,
+proxy) stage it via RAY_TPU_SERVE_* env vars before init; driver-process
+knobs use set_serve_config (restored per test)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core import rpc as _rpc
+from ray_tpu.core.exceptions import (ActorDiedError, BackPressureError,
+                                     RequestTimeoutError)
+from ray_tpu.serve.config import reset_serve_config, set_serve_config
+
+
+@pytest.fixture
+def serve_cluster(ray_start_regular):
+    yield ray_start_regular
+    serve.shutdown()
+    reset_serve_config()
+    serve.reset_router_stats()
+
+
+# ------------------------------------------------------------- deadlines
+
+
+def test_request_timeout_is_typed_and_fast(serve_cluster):
+    """A request on a stalled replica resolves with the typed error at its
+    deadline — not after the edge's old fixed 60 s, and never a hang."""
+
+    @serve.deployment
+    def stall(_):
+        time.sleep(8)
+        return "late"
+
+    h = serve.run(stall.bind())
+    t0 = time.monotonic()
+    with pytest.raises(RequestTimeoutError):
+        ray_tpu.get(h.remote(None, _timeout_s=0.5), timeout=10)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 5, f"typed timeout took {elapsed:.1f}s (deadline 0.5s)"
+
+
+def test_expired_request_dropped_before_dispatch(serve_cluster):
+    """A request whose deadline expired while queued on the replica is
+    dropped by the pre-dispatch check — the user callable never runs, so
+    overload slots go to requests that can still make their deadline."""
+
+    @serve.deployment(max_concurrent_queries=1)
+    class Slow:
+        def __init__(self):
+            self.calls = 0
+
+        def __call__(self, _):
+            self.calls += 1
+            time.sleep(1.0)
+            return self.calls
+
+        def count(self):
+            return self.calls
+
+    h = serve.run(Slow.bind())
+    assert ray_tpu.get(h.remote(None), timeout=30) == 1  # warm
+
+    # the replica executes up to 4 concurrently (the controller floors
+    # max_concurrency at 4): fill every slot so the doomed request QUEUES
+    long_refs = [h.remote(None) for _ in range(4)]
+    time.sleep(0.2)
+    doomed = h.remote(None, _timeout_s=0.2)  # expires while queued
+    with pytest.raises(RequestTimeoutError):
+        ray_tpu.get(doomed, timeout=10)
+    ray_tpu.get(long_refs, timeout=30)
+    # the doomed request must NOT have executed (pre-dequeue drop)
+    count_h = h.options(method_name="count")
+    assert ray_tpu.get(count_h.remote(), timeout=30) == 5
+
+
+def test_handle_options_timeout_default(serve_cluster):
+    """options(timeout_s=...) sets a per-handle deadline default."""
+
+    @serve.deployment
+    def stall2(_):
+        time.sleep(8)
+
+    serve.run(stall2.bind())
+    h = serve.get_deployment_handle("stall2").options(timeout_s=0.4)
+    with pytest.raises(RequestTimeoutError):
+        ray_tpu.get(h.remote(None), timeout=10)
+
+
+# ------------------------------------------------------ admission control
+
+
+def test_router_sheds_typed_backpressure(serve_cluster):
+    """With every replica at the in-flight cap, remote() raises the typed
+    BackPressureError immediately (fast rejection, no queue growth)."""
+    set_serve_config(max_queue_per_replica=2)
+
+    @serve.deployment(max_concurrent_queries=1)
+    def slow(_):
+        time.sleep(1.5)
+        return "ok"
+
+    h = serve.run(slow.bind())
+    ray_tpu.get(h.remote(None), timeout=30)  # warm
+
+    held = [h.remote(None) for _ in range(2)]  # fill the cap
+    t0 = time.monotonic()
+    with pytest.raises(BackPressureError):
+        h.remote(None)
+    assert time.monotonic() - t0 < 0.5, "shed must be immediate"
+    assert serve.router_stats()["shed"] >= 1
+    for r in held:  # the accepted requests still complete
+        assert ray_tpu.get(r, timeout=30) == "ok"
+
+
+# --------------------------------------------------- mid-request failover
+
+
+def test_unary_failover_replica_killed_mid_request(serve_cluster):
+    """Replica killed with requests in flight: idempotent requests re-route
+    to a surviving replica and COMPLETE; nothing hangs."""
+    from ray_tpu.serve.api import CONTROLLER_NAME
+
+    @serve.deployment(num_replicas=2, max_concurrent_queries=4)
+    class Work:
+        def __call__(self, x):
+            time.sleep(0.8)
+            return x * 2
+
+    h = serve.run(Work.bind())
+    ray_tpu.get([h.remote(i) for i in range(4)], timeout=30)  # warm both
+    serve.reset_router_stats()
+
+    refs = [h.remote(i, _timeout_s=30.0) for i in range(8)]
+    time.sleep(0.2)  # in flight on both replicas
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    info = ray_tpu.get(controller.get_replicas.remote("Work", -1, 0.0),
+                       timeout=10)
+    ray_tpu.kill(info["replicas"][0])
+
+    out = ray_tpu.get(refs, timeout=60)
+    assert out == [i * 2 for i in range(8)]
+    stats = serve.router_stats()
+    assert stats["retries"] >= 1, f"kill mid-request must re-route: {stats}"
+    assert stats["failovers"] >= 1
+
+
+def test_unary_failover_budget_spent_is_typed(serve_cluster):
+    """Single replica killed, no survivor: the request surfaces the typed
+    ActorDiedError once the retry budget is spent — never a hang."""
+    set_serve_config(request_retry_budget=1,
+                     retry_backoff_base_ms=5.0, retry_backoff_cap_ms=10.0)
+
+    @serve.deployment(num_replicas=1)
+    def lone(_):
+        time.sleep(5)
+        return "done"
+
+    h = serve.run(lone.bind())
+    ref = h.remote(None, _timeout_s=20.0)
+    time.sleep(0.3)
+    from ray_tpu.serve.api import CONTROLLER_NAME
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    info = ray_tpu.get(controller.get_replicas.remote("lone", -1, 0.0),
+                       timeout=10)
+    ray_tpu.kill(info["replicas"][0])
+    t0 = time.monotonic()
+    with pytest.raises((ActorDiedError, BackPressureError,
+                        RequestTimeoutError)):
+        ray_tpu.get(ref, timeout=30)
+    assert time.monotonic() - t0 < 25
+
+
+def test_streaming_failover_never_hangs(serve_cluster):
+    """Streaming path: replica killed mid-stream surfaces the typed error
+    (or the stream completes on a fast replica) — the consumer never
+    blocks past its deadline (satellite: both unary and streaming)."""
+
+    @serve.deployment(num_replicas=1)
+    class Tokens:
+        def gen(self, n):
+            for i in range(n):
+                time.sleep(0.3)
+                yield i
+
+    serve.run(Tokens.bind())
+    h = serve.get_deployment_handle("Tokens").options(
+        method_name="gen", stream=True)
+    gen = h.remote(12, _timeout_s=30.0)
+    got = [ray_tpu.get(next(gen), timeout=10)]  # first token flowing
+
+    from ray_tpu.serve.api import CONTROLLER_NAME
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    info = ray_tpu.get(controller.get_replicas.remote("Tokens", -1, 0.0),
+                       timeout=10)
+    ray_tpu.kill(info["replicas"][0])
+
+    outcome = {}
+
+    def consume():
+        try:
+            for ref in gen:
+                got.append(ray_tpu.get(ref, timeout=10))
+            outcome["end"] = "completed"
+        except Exception as e:
+            outcome["end"] = type(e).__name__
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    t.join(timeout=15)
+    assert not t.is_alive(), "stream consumer hung after replica kill"
+    assert outcome["end"] in ("completed", "ActorDiedError",
+                              "WorkerCrashedError", "TaskError",
+                              "ObjectLostError", "RequestTimeoutError"), \
+        outcome
+
+
+def test_severed_submit_fails_over_seeded(serve_cluster):
+    """FaultInjector sever at the named serve_replica_call boundary: the
+    first submission is cut, the router re-routes, the request completes
+    on a surviving replica (deterministic: sever_once, seeded)."""
+
+    @serve.deployment(num_replicas=2)
+    def echo(x):
+        return x
+
+    h = serve.run(echo.bind())
+    ray_tpu.get([h.remote(i) for i in range(4)], timeout=30)  # warm
+    serve.reset_router_stats()
+    inj = _rpc.install_fault_injector("sever_once:serve_replica_call",
+                                      seed=20260804)
+    try:
+        assert ray_tpu.get(h.remote(41), timeout=30) == 41
+        assert inj.stats["sever"] == 1
+        assert serve.router_stats()["retries"] >= 1
+    finally:
+        _rpc.clear_fault_injector()
+
+
+def test_streaming_severed_submit_retries(serve_cluster):
+    """The stream submit boundary is covered by the same failover budget
+    (pre-first-item only: replay past that could duplicate tokens)."""
+
+    @serve.deployment(num_replicas=2)
+    class S:
+        def gen(self, n):
+            yield from range(n)
+
+    serve.run(S.bind())
+    h = serve.get_deployment_handle("S").options(
+        method_name="gen", stream=True)
+    first = h.remote(3)
+    assert [ray_tpu.get(r, timeout=10) for r in first] == [0, 1, 2]  # warm
+    inj = _rpc.install_fault_injector("sever_once:serve_replica_call",
+                                      seed=7)
+    try:
+        gen = h.remote(3)
+        assert [ray_tpu.get(r, timeout=10) for r in gen] == [0, 1, 2]
+        assert inj.stats["sever"] == 1
+    finally:
+        _rpc.clear_fault_injector()
+
+
+# ------------------------------------------------------- batching deadline
+
+
+def test_batch_drops_expired_waiters_without_running_them():
+    """@serve.batch: waiters whose deadline expired while the batch window
+    was open get the typed error at assembly; the underlying fn runs only
+    for live waiters (no wasted batch slots). Unit test, no cluster."""
+    from ray_tpu.serve import batching
+
+    ran = []
+
+    @batching.batch(max_batch_size=8, batch_wait_timeout_s=0.15)
+    def handler(items):
+        ran.append(list(items))
+        return [i * 10 for i in items]
+
+    results = {}
+
+    def call(i, deadline_offset):
+        prev = batching.push_request_deadline(time.time() + deadline_offset)
+        try:
+            results[i] = handler(i)
+        except Exception as e:
+            results[i] = e
+        finally:
+            batching.pop_request_deadline(prev)
+
+    threads = [threading.Thread(target=call, args=(0, 10.0)),
+               threading.Thread(target=call, args=(1, 0.01))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert results[0] == 0
+    assert isinstance(results[1], RequestTimeoutError)
+    assert ran and all(1 not in batch for batch in ran), \
+        f"expired waiter executed: {ran}"
+
+
+def test_batch_all_expired_skips_invocation():
+    from ray_tpu.serve import batching
+
+    calls = []
+
+    @batching.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+    def fn(items):
+        calls.append(items)
+        return items
+
+    prev = batching.push_request_deadline(time.time() - 1.0)
+    try:
+        with pytest.raises(RequestTimeoutError):
+            fn(1)
+    finally:
+        batching.pop_request_deadline(prev)
+    assert calls == []
+
+
+# ----------------------------------------------------------- HTTP mapping
+
+
+def _post(port, path, body, timeout=30):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", path, body=json.dumps(body),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def test_http_504_on_deadline_with_typed_body(serve_cluster):
+    @serve.deployment
+    def naps(_):
+        time.sleep(8)
+
+    serve.run(naps.bind())
+    _, port = serve.start_http_proxy()
+    t0 = time.monotonic()
+    status, body = _post(port, "/naps?timeout_s=0.5", {"x": 1})
+    assert status == 504, body
+    assert json.loads(body)["type"] == "RequestTimeoutError"
+    assert time.monotonic() - t0 < 8, "504 must beat the stalled replica"
+
+
+def test_http_rejects_nonfinite_timeout(serve_cluster):
+    """NaN passes a naive <=0 check and would poison the deadline math;
+    inf would park a reaper entry forever — both are 400s, not requests."""
+    @serve.deployment
+    def ok(_):
+        return 1
+
+    serve.run(ok.bind())
+    _, port = serve.start_http_proxy()
+    for bad in ("nan", "inf", "-1", "0", "bogus"):
+        status, body = _post(port, f"/ok?timeout_s={bad}", {})
+        assert status == 400, (bad, status, body)
+
+
+def test_http_503_on_shed_with_typed_body():
+    """Router cap staged via env so the PROXY worker process inherits it;
+    concurrent requests past the cap answer 503/BackPressureError while
+    accepted ones answer 200."""
+    os.environ["RAY_TPU_SERVE_MAX_QUEUE_PER_REPLICA"] = "1"
+    reset_serve_config()
+    ray_tpu.init(num_cpus=4, resources={"TPU": 8})
+    try:
+        @serve.deployment(max_concurrent_queries=1)
+        def slowpoke(_):
+            time.sleep(1.5)
+            return "ok"
+
+        serve.run(slowpoke.bind())
+        _, port = serve.start_http_proxy()
+        status, _ = _post(port, "/slowpoke", {})  # warm
+        assert status == 200
+
+        results = []
+
+        def hit():
+            results.append(_post(port, "/slowpoke?timeout_s=10", {}))
+
+        threads = [threading.Thread(target=hit) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        statuses = sorted(s for s, _ in results)
+        assert 503 in statuses, statuses
+        assert 200 in statuses, statuses
+        shed_bodies = [json.loads(b) for s, b in results if s == 503]
+        assert all(b["type"] == "BackPressureError" for b in shed_bodies)
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        del os.environ["RAY_TPU_SERVE_MAX_QUEUE_PER_REPLICA"]
+        reset_serve_config()
+
+
+# ------------------------------------------------------------ drain knob
+
+
+def test_drain_deadline_knob_honored():
+    """drain_deadline_s (was a hardcoded 30.0): with a short deadline a
+    permanently-busy displaced replica dies within seconds of a rolling
+    redeploy, and the stranded in-flight request fails over to the new
+    version instead of waiting out 30 s."""
+    os.environ["RAY_TPU_SERVE_DRAIN_DEADLINE_S"] = "1.0"
+    reset_serve_config()
+    ray_tpu.init(num_cpus=4, resources={"TPU": 8})
+    try:
+        @serve.deployment(name="svc_drain")
+        def v1(_):
+            time.sleep(6)
+            return "v1"
+
+        h = serve.run(v1.bind())
+        ref = h.remote(None, _timeout_s=40.0)  # in flight on v1
+        time.sleep(0.3)
+        old_replica = h._replicas[0]
+
+        @serve.deployment(name="svc_drain")
+        def v2(_):
+            return "v2"
+
+        serve.run(v2.bind())  # rolling update displaces the busy v1 replica
+        t0 = time.monotonic()
+        from ray_tpu.core.api import _global_worker
+
+        deadline = time.monotonic() + 20
+        dead = False
+        while time.monotonic() < deadline:
+            info = _global_worker().get_actor_info(
+                actor_id=old_replica.actor_id)
+            if not info or info.get("state") == "DEAD":
+                dead = True
+                break
+            time.sleep(0.25)
+        assert dead, "displaced replica outlived the 1 s drain deadline"
+        assert time.monotonic() - t0 < 15, \
+            "drain reaper ignored RAY_TPU_SERVE_DRAIN_DEADLINE_S"
+        # the stranded request fails over to the v2 replica and completes
+        assert ray_tpu.get(ref, timeout=40) == "v2"
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        del os.environ["RAY_TPU_SERVE_DRAIN_DEADLINE_S"]
+        reset_serve_config()

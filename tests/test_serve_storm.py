@@ -1,0 +1,63 @@
+"""Traffic-storm chaos suite (ISSUE 9 acceptance): sustained ~4x-capacity
+synthetic load against a multi-replica autoscaling deployment while seeded
+chaos (FaultInjector drops at the serve_replica_call boundary + periodic
+replica kills) runs underneath. Asserts the overload contract — zero hung
+requests; every request resolves as a result, a typed timeout, or a typed
+shed — and writes SERVESTORM_r09.json as the tracked artifact."""
+
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.storm import DEFAULT_ARTIFACT, StormProfile, run_storm
+
+SEED = int(os.environ.get("RAY_TPU_FAULT_INJECTION_SEED", "20260804"))
+
+
+@pytest.fixture
+def storm_cluster():
+    ray_tpu.init(num_cpus=8, resources={"TPU": 8})
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_storm_overload_with_chaos_zero_hung(storm_cluster):
+    profile = StormProfile(duration_s=30.0, overload=4.0, seed=SEED,
+                           kill_period_s=5.0)
+    artifact = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), DEFAULT_ARTIFACT)
+    result = run_storm(profile, out_path=artifact)
+    req = result["requests"]
+    print(f"storm (seed {SEED}): {req}")
+
+    # the contract: zero hung, every request accounted for
+    assert req["hung"] == 0, f"hung requests under storm: {req}"
+    assert req["submitted"] == (
+        req["accepted"] + req["shed"] + req["timeout"]
+        + req["replica_death"] + req["other_error"]), req
+    assert req["other_error"] == 0, req
+
+    # the storm actually stormed: real overload, real chaos, real failover
+    assert req["submitted"] > profile.capacity_rps * profile.duration_s, \
+        "offered load never exceeded capacity"
+    assert req["accepted"] > 0, req
+    assert req["shed"] > 0, "4x overload must shed"
+    assert result["replicas"]["kills"] >= 3, result["replicas"]
+    assert result["router"]["retries"] >= 1, result["router"]
+    assert result["fault_stats"].get("drop", 0) >= 1, result["fault_stats"]
+
+    # bounded latency for ACCEPTED requests: nothing resolved as a result
+    # can have outlived its deadline (+ scheduling slack)
+    p99 = result["latency_ms"]["p99_accepted"]
+    assert p99 <= profile.request_timeout_s * 1000 + 500, \
+        f"accepted p99 {p99}ms blew past the deadline"
+
+    # the tracked artifact is on disk and parseable
+    with open(artifact) as f:
+        on_disk = json.load(f)
+    assert on_disk["zero_hung"] is True
+    assert on_disk["seed"] == SEED
