@@ -1,12 +1,13 @@
 #!/usr/bin/env bash
 # One-command CI for ray_tpu (reference role: .buildkite/pipeline.build.yml).
 #
-#   ci/run_ci.sh            # native + fast tier + stress x20 + chaos + storm
+#   ci/run_ci.sh            # native + fast + stress x20 + chaos + storm + burst
 #   ci/run_ci.sh --fast     # fast test tier only
 #   ci/run_ci.sh --native   # native ASAN/UBSAN harness only
 #   ci/run_ci.sh --stress   # actor-ordering stress x20 only
 #   ci/run_ci.sh --chaos    # control-plane HA chaos suite only
 #   ci/run_ci.sh --storm    # serve traffic-storm chaos only
+#   ci/run_ci.sh --burst    # warm-pool elasticity burst only
 #
 # Stages:
 #   1. native      : arena + scheduler + token-loader compiled whole-program
@@ -23,13 +24,18 @@
 #                    autoscaling deployment under seeded replica-call drops
 #                    + kills; prints the seed and shed/retry counters and
 #                    fails on ANY unresolved (hung) request.
+#   6. burst       : warm-pool elasticity chaos (quick profile): scale a
+#                    loaded fleet 4 -> 40 workers with seeded worker kills;
+#                    prints cold/warm start counts + the seed and fails if
+#                    any lease is served by neither a warm fork nor a cold
+#                    fallback (or any kill fails to recover).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGE="${1:-all}"
 
 run_native() {
-  echo "=== [1/4] native modules under ASan/UBSan ==="
+  echo "=== [1/6] native modules under ASan/UBSan ==="
   mkdir -p build
   g++ -std=c++17 -O1 -g -fsanitize=address,undefined \
       -fno-omit-frame-pointer -o build/sanitize_native \
@@ -41,7 +47,7 @@ run_native() {
 }
 
 run_fast() {
-  echo "=== [2/4] fast test tier ==="
+  echo "=== [2/6] fast test tier ==="
   python -m pytest tests/ -q
   # core-primitives smoke: the submission AND completion hot paths
   # (function table, event batching, batched result delivery, put/get)
@@ -63,7 +69,7 @@ EOF
 }
 
 run_stress() {
-  echo "=== [3/4] actor ordering stress x20 ==="
+  echo "=== [3/6] actor ordering stress x20 ==="
   for i in $(seq 1 20); do
     python -m pytest tests/test_actor_ordering_stress.py -q -x \
       || { echo "ordering stress failed on iteration $i"; exit 1; }
@@ -71,7 +77,7 @@ run_stress() {
 }
 
 run_chaos() {
-  echo "=== [4/4] control-plane HA chaos suite ==="
+  echo "=== [4/6] control-plane HA chaos suite ==="
   # Deterministic fault injection: pin + print the seed so a red run
   # reproduces bit-for-bit (override by exporting the variable).
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
@@ -86,7 +92,7 @@ run_chaos() {
 }
 
 run_serve_storm() {
-  echo "=== [5/5] serve traffic-storm chaos ==="
+  echo "=== [5/6] serve traffic-storm chaos ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -101,15 +107,34 @@ run_serve_storm() {
          exit 1; }
 }
 
+run_burst() {
+  echo "=== [6/6] warm-pool elasticity burst ==="
+  : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
+  export RAY_TPU_FAULT_INJECTION_SEED
+  echo "burst seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
+  # --quick: a 4-actor fleet under closed-loop load bursts to 40 while a
+  # seeded killer SIGKILLs live workers. The harness prints warm/cold
+  # start counts + fork latency and exits nonzero if any lease ends up
+  # served by neither a warm fork nor a cold fallback, any killed actor
+  # fails to recover, or any load call never resolves.
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m ray_tpu.core.burst \
+    --quick --seed "${RAY_TPU_FAULT_INJECTION_SEED}" \
+    --json /tmp/ray_tpu_burst_ci.json \
+    || { echo "elasticity burst failed (seed ${RAY_TPU_FAULT_INJECTION_SEED})"
+         exit 1; }
+}
+
 case "$STAGE" in
   --native) run_native ;;
   --fast)   run_fast ;;
   --stress) run_stress ;;
   --chaos)  run_chaos ;;
   --storm)  run_serve_storm ;;
-  all)      run_native; run_fast; run_stress; run_chaos; run_serve_storm ;;
+  --burst)  run_burst ;;
+  all)      run_native; run_fast; run_stress; run_chaos; run_serve_storm
+            run_burst ;;
   *) echo "unknown stage: $STAGE" \
-     "(use --native|--fast|--stress|--chaos|--storm)" >&2
+     "(use --native|--fast|--stress|--chaos|--storm|--burst)" >&2
      exit 2 ;;
 esac
 echo "CI green"

@@ -129,6 +129,8 @@ def main(argv=None) -> int:
         "broadcast + microbenchmark), writes a JSON artifact")
     p_env.add_argument("--out", default=None)
     p_env.add_argument("--scale", type=float, default=1.0)
+    p_env.add_argument("--elastic", action="store_true",
+                       help="also run the burst-elasticity chaos scenario")
 
     p_serve = sub.add_parser("serve", help="model serving")
     serve_sub = p_serve.add_subparsers(dest="serve_cmd", required=True)
@@ -260,6 +262,8 @@ def main(argv=None) -> int:
         if args.out:
             argv += ["--out", args.out]
         argv += ["--scale", str(args.scale)]
+        if args.elastic:
+            argv += ["--elastic"]
         return env_main(argv)
 
     if args.cmd == "timeline":

@@ -1,0 +1,364 @@
+"""Burst-elasticity chaos harness: scale a synthetic fleet 10 -> 1000
+workers under queued load with seeded worker kills.
+
+This is the elasticity story behind "millions of users" made into a
+repeatable scenario: a small serving/RL-style fleet of actors is already
+busy with a continuous stream of calls when demand arrives and the fleet
+must burst to two orders of magnitude more workers — the thing a 4.5 s
+cold worker start made a non-starter and the warm worker pool
+(`core/worker_pool.py` fork-template zygotes) exists to make routine.
+While the fleet scales, a seeded kill loop SIGKILLs random live workers
+(fleet actors restart on fresh — warm — workers; the raylet's
+recently-completed failover covers results dying in their buffers).
+
+The harness asserts the elasticity contract:
+
+  * every lease is served — each fleet actor ends up alive on a worker
+    that was started either by a WARM FORK or a COLD FALLBACK spawn
+    (`registered_warm + registered_cold` covers every worker; a lease
+    served by neither means the pool invented a worker it can't account
+    for, or dropped one);
+  * every seeded kill recovers — killed actors come back and answer;
+  * the load stream never wedges — every submitted call resolves as a
+    result or a typed error within the deadline.
+
+Writes a JSON artifact (burst section of ENVELOPE_r10.json) with
+cold-vs-warm start counts, fork latency p50/p99, and
+actors-to-first-ping for the scale-up wave. Run directly:
+
+    python -m ray_tpu.core.burst                # full 10 -> 1000 profile
+    python -m ray_tpu.core.burst --quick        # 4 -> 40 CI profile
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class BurstProfile:
+    n_start: int = 10            # steady-state fleet before the burst
+    n_target: int = 1000         # fleet size after the burst
+    n_kills: int = 8             # seeded SIGKILLs during the scale-up
+    kill_period_s: float = 1.0
+    load_inflight: int = 32      # closed-loop in-flight calls on the fleet
+    load_warmup_s: float = 2.0   # load runs this long before the burst
+    seed: int = 0
+    call_timeout_s: float = 120.0
+    settle_timeout_s: float = 180.0
+
+
+QUICK_PROFILE = dict(n_start=4, n_target=40, n_kills=3,
+                     kill_period_s=0.5, load_inflight=8,
+                     load_warmup_s=1.0, settle_timeout_s=90.0)
+
+
+class _LoadGen:
+    """Closed-loop call stream against the live fleet: keeps
+    `inflight` calls outstanding, counts resolutions by outcome. Calls to
+    killed actors resolve as typed errors (counted, not fatal) — the one
+    forbidden outcome is a call that never resolves."""
+
+    def __init__(self, actors: List, inflight: int, timeout_s: float):
+        import ray_tpu
+
+        self._ray = ray_tpu
+        self._actors = actors        # shared, grows under the lock
+        self._lock = threading.Lock()
+        self._inflight = inflight
+        self._timeout_s = timeout_s
+        self._stop = threading.Event()
+        self.completed = 0
+        self.errored = 0
+        self.hung = 0
+        self._threads = [threading.Thread(target=self._run, daemon=True,
+                                          name=f"burst-load-{i}")
+                         for i in range(min(4, inflight))]
+
+    def add_actors(self, actors: List) -> None:
+        with self._lock:
+            self._actors.extend(actors)
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> Dict[str, int]:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=self._timeout_s + 10)
+            if t.is_alive():
+                self.hung += 1
+        return {"completed": self.completed, "errored": self.errored,
+                "hung": self.hung}
+
+    def _run(self) -> None:
+        rng = random.Random(threading.get_ident())
+        per_thread = max(1, self._inflight // max(1, len(self._threads)))
+        while not self._stop.is_set():
+            with self._lock:
+                targets = [rng.choice(self._actors)
+                           for _ in range(per_thread)]
+            refs = [a.work.remote(1) for a in targets]
+            for r in refs:
+                try:
+                    self._ray.get(r, timeout=self._timeout_s)
+                    with self._lock:
+                        self.completed += 1
+                except Exception:
+                    # typed resolution (actor died mid-kill, retry budget,
+                    # timeout) — the contract only forbids silent hangs,
+                    # and a worker killed mid-call surfaces here
+                    with self._lock:
+                        self.errored += 1
+
+
+def _pool_stats() -> Dict[str, Any]:
+    from ray_tpu.core.worker import current_worker
+
+    return current_worker().raylet.call("worker_pool_stats", {}, timeout=30)
+
+
+def _list_workers() -> List[Dict[str, Any]]:
+    from ray_tpu.core.worker import current_worker
+
+    try:
+        return current_worker().raylet.call("list_workers", {}, timeout=30)
+    except Exception:
+        return []
+
+
+def _idle_worker_count() -> int:
+    return sum(1 for w in _list_workers() if w.get("idle"))
+
+
+def run_burst(profile: Optional[BurstProfile] = None,
+              out_path: Optional[str] = None) -> Dict[str, Any]:
+    """Run one burst on the CURRENT cluster (caller already init'd).
+    Returns the result dict; the caller asserts on `ok` / `violations`."""
+    import ray_tpu
+
+    p = profile or BurstProfile()
+    rng = random.Random(p.seed)
+
+    @ray_tpu.remote
+    class FleetWorker:
+        def __init__(self):
+            self._n = 0
+
+        def work(self, x):
+            self._n += 1
+            return (os.getpid(), self._n)
+
+        def ping(self):
+            return os.getpid()
+
+    def make_actors(n: int) -> List:
+        return [FleetWorker.options(num_cpus=0, max_restarts=4).remote()
+                for _ in range(n)]
+
+    stats0 = _pool_stats()
+    # leases may legitimately be served by workers that were ALREADY idle
+    # when the burst began (e.g. envelope phases that ran before
+    # --elastic): those start nothing and are still warm-pool-served
+    idle0 = _idle_worker_count()
+    violations: List[str] = []
+
+    # ---- phase 1: steady-state fleet under load -------------------------
+    fleet = make_actors(p.n_start)
+    pids = ray_tpu.get([a.ping.remote() for a in fleet],
+                       timeout=p.settle_timeout_s)
+    load = _LoadGen(list(fleet), p.load_inflight, p.call_timeout_s)
+    load.start()
+    time.sleep(p.load_warmup_s)
+
+    # ---- phase 2: burst to n_target under load + seeded kills -----------
+    kills_done = []
+    kill_stop = threading.Event()
+
+    def killer():
+        # SIGKILL a random live worker every kill_period_s — drawn from a
+        # LIVE snapshot so mid-burst forks are fair game too (a recovery
+        # bug specific to freshly-forked workers must not hide behind a
+        # victim list frozen at burst start). The actor restarts
+        # (max_restarts) on a fresh — warm — worker, and results buffered
+        # in the dead process fail over via recent_done.
+        while len(kills_done) < p.n_kills and not kill_stop.is_set():
+            live = [w["pid"] for w in _list_workers()] or list(pids)
+            victim = rng.choice(live)
+            try:
+                os.kill(victim, 9)
+                kills_done.append(victim)
+            except OSError:
+                pass  # raced its own exit; snapshot refreshes next tick
+            if kill_stop.wait(p.kill_period_s):
+                return
+
+    t0 = time.perf_counter()
+    wave = make_actors(p.n_target - p.n_start)
+    load.add_actors(wave)
+    kt = threading.Thread(target=killer, daemon=True, name="burst-killer")
+    kt.start()
+    # first-ping with kill-recovery: the killer may SIGKILL a wave actor
+    # mid-ping (typed error); the restarted actor is re-pinged until the
+    # settle budget runs out — only an actor that NEVER answers violates
+    wave_pids = []
+    deadline = t0 + p.settle_timeout_s
+    pending = [(a, a.ping.remote()) for a in wave]
+    while pending and time.perf_counter() < deadline:
+        retry = []
+        for a, r in pending:
+            try:
+                wave_pids.append(ray_tpu.get(
+                    r, timeout=max(0.5, deadline - time.perf_counter())))
+            except Exception:
+                retry.append((a, a.ping.remote()))
+        pending = retry
+        if pending:
+            time.sleep(0.2)
+    if pending:
+        violations.append(
+            f"{len(pending)} scale-up actors never answered first ping")
+    t_wave = time.perf_counter() - t0
+    # a fast scale-up must not let the chaos off the hook: the killer
+    # finishes its seeded budget (bounded) before recovery is judged
+    kt.join(timeout=p.n_kills * p.kill_period_s + 10)
+    kill_stop.set()
+    kt.join(timeout=10)
+
+    # ---- phase 3: settle — every actor (incl. killed ones) answers ------
+    recovered = 0
+    t_settle0 = time.perf_counter()
+    deadline = t_settle0 + p.settle_timeout_s
+    for a in fleet + list(wave):
+        try:
+            ray_tpu.get(a.ping.remote(),
+                        timeout=max(1.0, deadline - time.perf_counter()))
+            recovered += 1
+        except Exception as e:
+            violations.append(f"actor never recovered: {type(e).__name__}")
+    load_counts = load.stop()
+    if load_counts["hung"]:
+        violations.append(f"{load_counts['hung']} load calls never resolved")
+
+    stats1 = _pool_stats()
+    warm = stats1["registered_warm"] - stats0["registered_warm"]
+    cold = stats1["registered_cold"] - stats0["registered_cold"]
+    total_actors = p.n_target
+    # every lease must be served by a warm fork, a cold fallback, or a
+    # worker that was already idle at burst start; kills and restarts only
+    # ADD workers on top of the fleet itself
+    if warm + cold + idle0 < recovered:
+        violations.append(
+            f"workers unaccounted for: {recovered} live actors but only "
+            f"{warm} warm + {cold} cold starts recorded "
+            f"(+{idle0} pre-burst idle)")
+    if recovered < total_actors:
+        violations.append(
+            f"only {recovered}/{total_actors} leases ended up served")
+
+    result = {
+        "suite": "burst-elasticity (warm worker pool chaos)",
+        "profile": {
+            "n_start": p.n_start, "n_target": p.n_target,
+            "n_kills": p.n_kills, "seed": p.seed,
+            "load_inflight": p.load_inflight,
+        },
+        "scale_up": {
+            "actors_to_first_ping_s": round(t_wave, 2),
+            "actors_per_s": round((p.n_target - p.n_start) / t_wave, 1),
+            "distinct_workers": len(set(wave_pids)),
+        },
+        "worker_pool": {
+            "warm_starts": warm, "cold_starts": cold,
+            "pre_burst_idle_workers": idle0,
+            "warm_fraction": round(warm / max(1, warm + cold), 3),
+            "fork_p50_ms": stats1["fork_p50_ms"],
+            "fork_p99_ms": stats1["fork_p99_ms"],
+            "template_respawns": stats1["template_respawns"]
+            - stats0["template_respawns"],
+        },
+        "chaos": {
+            "kills": len(kills_done),
+            "actors_recovered": recovered,
+        },
+        "load": load_counts,
+        "violations": violations,
+        "ok": not violations,
+    }
+    for a in fleet + list(wave):
+        try:
+            ray_tpu.kill(a)
+        except Exception:
+            pass
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+    return result
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="scaled-down CI profile (4 -> 40 workers)")
+    ap.add_argument("--seed", type=int,
+                    default=int(os.environ.get(
+                        "RAY_TPU_FAULT_INJECTION_SEED", "0")))
+    ap.add_argument("--start", type=int, default=None)
+    ap.add_argument("--target", type=int, default=None)
+    ap.add_argument("--kills", type=int, default=None)
+    ap.add_argument("--json", default=None, help="write the result here")
+    args = ap.parse_args(argv)
+
+    kw: Dict[str, Any] = dict(QUICK_PROFILE) if args.quick else {}
+    kw["seed"] = args.seed
+    if args.start is not None:
+        kw["n_start"] = args.start
+    if args.target is not None:
+        kw["n_target"] = args.target
+    if args.kills is not None:
+        kw["n_kills"] = args.kills
+    p = BurstProfile(**kw)
+
+    import ray_tpu
+
+    # enough CPU headroom that the fleet (num_cpus=0 actors) and the load
+    # stream never contend on scheduler admission
+    ray_tpu.init(num_cpus=8)
+    try:
+        result = run_burst(p, out_path=args.json)
+    finally:
+        ray_tpu.shutdown()
+
+    print(json.dumps(result, indent=2))
+    wp, su = result["worker_pool"], result["scale_up"]
+    print(f"[burst] seed={p.seed} {p.n_start} -> {p.n_target} workers in "
+          f"{su['actors_to_first_ping_s']}s | warm={wp['warm_starts']} "
+          f"cold={wp['cold_starts']} (warm fraction "
+          f"{wp['warm_fraction']}) fork p50/p99 = {wp['fork_p50_ms']}/"
+          f"{wp['fork_p99_ms']} ms | kills={result['chaos']['kills']} "
+          f"recovered={result['chaos']['actors_recovered']}",
+          file=sys.stderr)
+    if not result["ok"]:
+        print("[burst] VIOLATIONS:", file=sys.stderr)
+        for v in result["violations"]:
+            print(f"  - {v}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -43,11 +43,30 @@ class Config:
     worker_lease_timeout_ms: int = 30000
     max_pending_lease_requests_per_scheduling_category: int = 10
 
-    # --- worker pool (cf. worker_pool.h:156) ---
+    # --- worker pool (cf. worker_pool.h:156, PrestartWorkers
+    # worker_pool.cc:1363) ---
+    # FLOOR of the demand-driven prestart policy (~1 worker/CPU up to the
+    # current backlog): the default env keeps at least this many task
+    # workers ALIVE (busy, idle or starting) from raylet boot onward, and
+    # the idle reaper never shrinks the idle pool below this.
     num_prestart_workers: int = 0
     worker_register_timeout_s: int = 60
     idle_worker_killing_time_s: int = 300
     maximum_startup_concurrency: int = 8
+    # --- warm worker pool (fork-template zygotes; core/worker_pool.py) ---
+    # One template process per runtime-env key imports ray_tpu once and
+    # os.fork()s a ready worker per granted lease; disable to force the
+    # classic cold-Popen path everywhere.
+    worker_template_enabled: bool = True
+    worker_template_boot_timeout_s: float = 60.0
+    worker_template_fork_timeout_s: float = 10.0
+    # template crash -> respawn under full-jitter backoff (cold fallback
+    # serves leases while the clock runs)
+    worker_template_backoff_base_ms: int = 500
+    worker_template_backoff_cap_ms: int = 30000
+    # non-default-env templates close after this long with no fork and no
+    # live worker (releasing their env ref so runtime-env gc can reclaim)
+    worker_template_idle_s: float = 300.0
 
     # --- resource reporting / syncer ---
     resource_broadcast_period_ms: int = 100

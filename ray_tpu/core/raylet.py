@@ -146,11 +146,17 @@ class Raylet:
             skip_deferred=lambda: self._shutdown.is_set())
         self._starting: List[subprocess.Popen] = []
         self._starting_env: Dict[int, str] = {}  # pid -> env_key
+        self._starting_envfile: Dict[int, str] = {}  # pid -> {ENVFILE} path
         self._env_spawning: set = set()          # env_keys mid-creation
         self._pending_actor_specs: deque = deque()
         from ray_tpu.core.runtime_env_manager import RuntimeEnvManager
 
         self._env_manager = RuntimeEnvManager()
+        # warm worker pool: fork-template (zygote) processes + demand-driven
+        # prestart; cold Popen spawns remain the fallback path
+        from ray_tpu.core.worker_pool import WorkerPool
+
+        self._worker_pool = WorkerPool(self)
 
         # cluster view: node_id hex -> {address, total, available, labels, alive}
         self._cluster_view: Dict[str, dict] = {}
@@ -304,9 +310,17 @@ class Raylet:
 
     def stop(self) -> None:
         self._shutdown.set()
+        self._worker_pool.stop()
         with self._lock:
             workers = list(self._workers.values())
             starting = list(self._starting)
+            envfiles = list(self._starting_envfile.values())
+            self._starting_envfile.clear()
+        for path in envfiles:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         for p in starting:
             try:
                 p.terminate()
@@ -472,7 +486,7 @@ class Raylet:
             worker_id=wid, conn=conn, address=payload["address"], pid=payload["pid"],
         )
         with self._lock:
-            # adopt the Popen if we spawned it
+            # adopt the Popen (or forked-worker shim) if we started it
             for p in self._starting:
                 if p.pid == payload["pid"]:
                     handle.proc = p
@@ -481,6 +495,16 @@ class Raylet:
             spawned_env = self._starting_env.pop(payload["pid"], None)
             handle.env_key = payload.get("env_key") or spawned_env
             self._workers[wid] = handle
+            envfile = self._starting_envfile.pop(payload["pid"], None)
+        if envfile is not None:
+            # the worker booted: its {ENVFILE} env file has been consumed
+            try:
+                os.unlink(envfile)
+            except OSError:
+                pass
+        if payload.get("worker_type") != "driver":
+            self._worker_pool.note_registered(
+                handle.proc, forked=bool(payload.get("forked")))
         if handle.env_key:
             # URI-style env refcount: alive while any worker serves it.
             # Bumped OUTSIDE the raylet lock (flock'd disk IO must never
@@ -498,14 +522,8 @@ class Raylet:
                 return {"node_id": self.node_id.binary(), "gcs_address": self.gcs_address}
             # a fresh worker: give it a pending actor spec (from the same
             # runtime-env pool) or mark idle
-            spec = None
-            for s in self._pending_actor_specs:
-                if _env_key(s.runtime_env) == handle.env_key:
-                    spec = s
-                    break
+            spec = self._claim_pending_actor_spec(handle)
             if spec is not None:
-                self._pending_actor_specs.remove(spec)
-                self._assign_actor(handle, spec)
                 # Keep the spawn pipeline primed: creations that arrived
                 # while the startup-concurrency budget was full never got a
                 # spawn (budget 0), so each registration must re-arm it or
@@ -524,8 +542,10 @@ class Raylet:
         self._schedule()
         return {"node_id": self.node_id.binary(), "gcs_address": self.gcs_address}
 
-    def _spawn_worker(self, env_key: Optional[str] = None,
-                      runtime_env: Optional[dict] = None) -> None:
+    def _build_worker_env(self, env_key: Optional[str] = None
+                          ) -> Dict[str, str]:
+        """Environment dict for a worker OR a fork-template process (the
+        template captures it once; every forked child inherits it)."""
         env = dict(os.environ)
         env.update(self.worker_env)
         env.setdefault("JAX_PLATFORMS", "cpu")  # workers default to CPU JAX
@@ -543,14 +563,25 @@ class Raylet:
         existing = env.get("PYTHONPATH", "")
         if pkg_root not in existing.split(os.pathsep):
             env["PYTHONPATH"] = pkg_root + (os.pathsep + existing if existing else "")
+        if env_key is not None:
+            env["RAY_TPU_RUNTIME_ENV_KEY"] = env_key
+        else:
+            env.pop("RAY_TPU_RUNTIME_ENV_KEY", None)
+        env.pop("RAY_TPU_WORKER_FORKED", None)
+        return env
+
+    def _spawn_worker(self, env_key: Optional[str] = None,
+                      runtime_env: Optional[dict] = None) -> bool:
+        """Cold-spawn one worker; False when the spawn was suppressed
+        (another spawn of a still-creating env is already in flight)."""
+        env = self._build_worker_env(env_key)
         python = sys.executable
         if env_key is not None:
             # venv-backed pip env: resolve (and lazily create) the
             # interpreter off the scheduler thread, then spawn from it
-            env["RAY_TPU_RUNTIME_ENV_KEY"] = env_key
             with self._lock:
                 if env_key in self._env_spawning:
-                    return  # one spawn per env at a time while creating
+                    return False  # one spawn per env at a time while creating
                 self._env_spawning.add(env_key)
 
             def create_and_spawn():
@@ -574,14 +605,16 @@ class Raylet:
 
             threading.Thread(target=create_and_spawn, daemon=True,
                              name="runtime-env-create").start()
-            return
+            return True
         self._launch_worker(python, env)
+        return True
 
     def _launch_worker(self, python: str, env: Dict[str, str],
                        command_prefix=None) -> None:
         argv = [python, "-m", "ray_tpu.core.worker_main",
                 "--raylet", self._server.address, "--gcs", self.gcs_address,
                 "--node-id", self.node_id.hex()]
+        envfile = None
         if command_prefix:
             prefix = list(command_prefix)
             if "{ENVFILE}" in prefix:
@@ -603,6 +636,10 @@ class Raylet:
             key = env.get("RAY_TPU_RUNTIME_ENV_KEY")
             if key:
                 self._starting_env[proc.pid] = key
+            if envfile is not None:
+                # tracked for cleanup at registration / startup-death (the
+                # reaper also sweeps stale files as a crash backstop)
+                self._starting_envfile[proc.pid] = envfile
 
     def _fail_env_tasks(self, env_key: str, msg: str) -> None:
         """Fail every queued task/actor whose pip env could not be built."""
@@ -815,6 +852,7 @@ class Raylet:
                         min_idle_s=cfg.idle_worker_killing_time_s)
                 except Exception:
                     logger.exception("runtime env gc failed")
+                self._sweep_stale_envfiles()
             # 2PC orphan cleanup: a bundle PREPARED but never committed
             # means the head died (or gave up) between phases — nothing
             # will ever commit or return it, so the reservation would leak
@@ -837,25 +875,57 @@ class Raylet:
             with self._lock:
                 starting = list(self._starting)
             for p in starting:
-                if p.poll() is not None:
+                expired = (getattr(p, "forked", False) and p.poll() is None
+                           and time.monotonic() - p.started_at
+                           > cfg.worker_register_timeout_s)
+                if expired:
+                    # a forked worker that never registered within the
+                    # budget: signal-0 liveness can't be trusted (the
+                    # template reaped it and the pid may be an unrelated
+                    # process by now) — expire the slot, return its lease
+                    logger.warning(
+                        "forked worker pid %d never registered within %ss; "
+                        "expiring", p.pid, cfg.worker_register_timeout_s)
+                if p.poll() is not None or expired:
                     with self._lock:
                         try:
                             self._starting.remove(p)
                         except ValueError:
                             pass
                         dead_env = self._starting_env.pop(p.pid, None)
+                        dead_envfile = self._starting_envfile.pop(p.pid, None)
                     if dead_env:
                         # died before registering: return its spawn lease
                         self._env_manager.release(dead_env)
+                    if dead_envfile:
+                        try:
+                            os.unlink(dead_envfile)
+                        except OSError:
+                            pass
                     logger.warning("worker pid %d exited during startup rc=%s", p.pid, p.returncode)
-            # idle killing
+            # warm-pool upkeep: dead templates -> backoff respawn state,
+            # idle env templates closed, default-env prestart floor topped up
+            try:
+                self._worker_pool.health_tick()
+            except Exception:
+                logger.exception("worker pool health tick failed")
+            # idle killing (the default-env pool never shrinks below the
+            # prestart floor: killing a floor worker would just respawn it
+            # next tick — a kill/respawn flap instead of a warm reserve)
             now = time.monotonic()
             to_kill: List[WorkerHandle] = []
             with self._lock:
-                for pool in self._idle_pools.values():
+                for pool_key, pool in self._idle_pools.items():
+                    keep = self._worker_pool.floor() if pool_key is None else 0
                     for wid in list(pool):
+                        if len(pool) <= keep:
+                            break
                         w = self._workers.get(wid)
-                        if w and w.proc is not None and now - w.idle_since > cfg.idle_worker_killing_time_s:
+                        # no `proc is not None` guard: the exit push below
+                        # is graceful for ANY worker, and a forked worker
+                        # that registered after its shim expired has
+                        # proc=None — it must still be idle-killable
+                        if w and now - w.idle_since > cfg.idle_worker_killing_time_s:
                             pool.remove(wid)
                             self._workers.pop(wid, None)
                             to_kill.append(w)
@@ -868,7 +938,32 @@ class Raylet:
                 except OSError:
                     pass  # connection already dropped; process reaper owns it
 
+    def _sweep_stale_envfiles(self, max_age_s: float = 3600.0) -> None:
+        """Crash backstop for the tracked {ENVFILE} cleanup: a raylet that
+        died between mkstemp and registration leaves rtpu-worker-*.env
+        files behind; sweep ones old enough that no live spawn owns them."""
+        import glob
+        import tempfile
+
+        with self._lock:
+            live = set(self._starting_envfile.values())
+        cutoff = time.time() - max_age_s
+        pattern = os.path.join(tempfile.gettempdir(), "rtpu-worker-*.env")
+        for path in glob.glob(pattern):
+            if path in live:
+                continue
+            try:
+                if os.path.getmtime(path) < cutoff:
+                    os.unlink(path)
+            except OSError:
+                pass  # raced another sweeper or the owner
+
     # -------------------------------------------------------- observability
+    def rpc_worker_pool_stats(self, conn, req_id, payload):
+        """Warm/cold start counters + fork latency percentiles + template
+        states (envelope, burst harness, dashboards)."""
+        return self._worker_pool.stats()
+
     def rpc_object_store_stats(self, conn, req_id, payload):
         """Store usage for `ray_tpu memory` (reference scripts.py:1881)."""
         return {"node_id": self.node_id.binary(), **self.store.stats()}
@@ -954,6 +1049,20 @@ class Raylet:
             # calls _schedule itself — so skip the per-submit scan and keep
             # submission O(1) under a 20k-task burst (envelope phase 1).
             deep = len(self._queue) > self._SCHED_SCAN_BLOCKED_MAX
+            if not deep:
+                # Demand-driven prestart (reference PrestartWorkers,
+                # worker_pool.cc:1363): keep ~1 worker/CPU booting ahead of
+                # the dispatch pass so a burst's first wave doesn't pay a
+                # worker boot inline. Dedup against idle here, against
+                # in-flight starts in the pool; O(1) per submit, and the
+                # deep regime skips it (demand is already saturated).
+                ekey = _env_key(spec.runtime_env)
+                idle = len(self._idle_pools.get(ekey) or ())
+                target = self._worker_pool.prestart_target(
+                    len(self._queue), ekey)
+                if target > idle:
+                    self._worker_pool.request(
+                        ekey, spec.runtime_env, target, kind="prestart")
         if not deep:
             self._schedule()
 
@@ -1166,20 +1275,134 @@ class Raylet:
         return sum(1 for p in self._starting
                    if self._starting_env.get(p.pid) == env_key)
 
+    # ------------------------------------------------- worker-pool surface
+    # Thread-safe accessors for the WorkerPool (its serve thread runs
+    # outside the raylet lock; everything below takes it).
+    def _spawn_inflight(self, env_key: Optional[str]) -> int:
+        with self._lock:
+            return self._starting_for(env_key)
+
+    def _starting_count(self) -> int:
+        with self._lock:
+            return len(self._starting)
+
+    def _has_workers_for(self, env_key: Optional[str]) -> bool:
+        with self._lock:
+            return any(w.env_key == env_key and not w.is_driver
+                       for w in self._workers.values())
+
+    def _idle_count(self, env_key: Optional[str]) -> int:
+        with self._lock:
+            pool = self._idle_pools.get(env_key)
+            return len(pool) if pool else 0
+
+    def _task_worker_count(self, env_key: Optional[str]) -> int:
+        """Live task-capable (non-driver, non-actor) workers of an env —
+        busy OR idle. The prestart policy dedups against this: a busy
+        worker still occupies its CPU, so prestarting 'replacements' for
+        busy workers just forks an unbounded stream of idlers."""
+        with self._lock:
+            return sum(1 for w in self._workers.values()
+                       if not w.is_driver and w.actor_id is None
+                       and w.env_key == env_key)
+
+    def _live_demand(self, env_key: Optional[str]) -> int:
+        """Workers this env could consume RIGHT NOW: pending actor specs
+        (one dedicated worker each) plus queued tasks that are dispatchable
+        under CURRENT resources (cumulatively simulated over a bounded
+        scan). Counting every queued task would let a stale spawn request
+        fork for tasks that have no CPU to run on — the per-completion
+        release->handoff window makes such requests a steady drip under a
+        deep queue."""
+        from itertools import islice
+
+        with self._lock:
+            n = sum(1 for s in self._pending_actor_specs
+                    if _env_key(s.runtime_env) == env_key)
+            avail = dict(self.resources_available)
+            bundle_avail: Dict[Tuple, Dict[str, float]] = {}
+            for qt in islice(self._queue, 512):
+                spec = qt.spec
+                if _env_key(spec.runtime_env) != env_key:
+                    continue
+                demand = self._effective_demand(spec)
+                pg = spec.scheduling.placement_group_id
+                if pg is not None:
+                    # PG tasks charge their bundle, not the node pool —
+                    # simulated cumulatively too, else 64 queued tasks on a
+                    # 1-CPU bundle all count as live demand
+                    key = (pg, max(spec.scheduling.bundle_index, 0))
+                    pool = bundle_avail.get(key)
+                    if pool is None:
+                        src = self._bundles.get(key)
+                        if src is None:
+                            continue
+                        pool = bundle_avail[key] = dict(src)
+                else:
+                    pool = avail
+                if all(pool.get(r, 0.0) + 1e-9 >= q
+                       for r, q in demand.items()):
+                    for r, q in demand.items():
+                        pool[r] = pool.get(r, 0.0) - q
+                    n += 1
+            return n
+
+    def _adopt_forked(self, pid: int, env_key: Optional[str]) -> None:
+        """A template just forked worker `pid` for us: thread it into the
+        startup pipeline exactly like a cold Popen (same registration
+        adoption, same reaper poll, same spawn-lease refcount). Handles the
+        race where the child registered before the fork reply was read."""
+        from ray_tpu.core.worker_pool import ForkedWorkerProc
+
+        shim = ForkedWorkerProc(pid)
+        with self._lock:
+            # a NEW fork with pid P proves any older _starting entry for P
+            # is dead (live pids are unique) — drop it now or the pid-keyed
+            # _starting_env entry is overwritten and one env lease leaks
+            stale = [p for p in self._starting if p.pid == pid]
+            for p in stale:
+                self._starting.remove(p)
+            stale_env = self._starting_env.pop(pid, None) if stale else None
+        if stale_env is not None:
+            self._env_manager.release(stale_env)
+        if env_key is not None:
+            # spawn LEASE, mirroring the cold path: hold the env's refcount
+            # until the worker registers (takes its own) or dies booting.
+            # Taken BEFORE the shim is visible in _starting so registration
+            # can never release it first (flock IO stays off the raylet
+            # lock, same as the cold path).
+            self._env_manager.acquire(env_key)
+        with self._lock:
+            raced = None
+            for w in self._workers.values():
+                if w.pid == pid:
+                    # raced its own registration: it already took its env
+                    # ref there; just give the handle a killable proc
+                    raced = w
+                    break
+            if raced is None:
+                self._starting.append(shim)
+                if env_key is not None:
+                    self._starting_env[pid] = env_key
+                return
+            if raced.proc is None:
+                raced.proc = shim
+        if env_key is not None:
+            self._env_manager.release(env_key)  # return the unused lease
+
     def _maybe_spawn(self, env_key: Optional[str] = None,
                      runtime_env: Optional[dict] = None,
                      needed: int = 1) -> None:
-        """Spawn at most (needed - already starting) workers for this env.
-        Without the deficit check, every scheduling pass during a worker's
-        multi-second boot would spawn ANOTHER worker per still-pending task
-        — an overspawn storm that serializes all boots on small hosts."""
+        """Ask the warm pool to bring this env's worker count up to
+        `needed` (an absolute backlog figure — the pool dedups against
+        in-flight starts, so every scheduling pass during a worker's boot
+        re-arming with the same count cannot overspawn). The pool serves
+        it with template forks when it can, cold Popen spawns (bounded by
+        maximum_startup_concurrency) when it can't."""
         if env_key is not None and \
                 self._env_manager.creation_error(env_key) is not None:
             return  # creation already failed; don't respawn forever
-        deficit = needed - self._starting_for(env_key)
-        budget = get_config().maximum_startup_concurrency - len(self._starting)
-        for _ in range(max(0, min(deficit, budget))):
-            self._spawn_worker(env_key, runtime_env)
+        self._worker_pool.request(env_key, runtime_env, needed)
 
     def rpc_task_done(self, conn, req_id, payload):
         wid: WorkerID = payload["worker_id"]
@@ -1242,9 +1465,15 @@ class Raylet:
         if handed is None:
             with self._lock:
                 if w.actor_id is None and w.conn.alive:
-                    w.idle_since = time.monotonic()
-                    self._idle_pools.setdefault(
-                        w.env_key, deque()).append(wid)
+                    # a pending actor spec of this env takes the worker
+                    # before it pools: only fresh registrations claimed
+                    # specs before, so a spec could coexist with an idle
+                    # same-env worker forever (the warm pool's demand
+                    # dedup counts that idle worker and spawns nothing)
+                    if self._claim_pending_actor_spec(w) is None:
+                        w.idle_since = time.monotonic()
+                        self._idle_pools.setdefault(
+                            w.env_key, deque()).append(wid)
         self._schedule()
         self._report_resources()
         return True
@@ -1320,6 +1549,18 @@ class Raylet:
                 return True
             self._assign_actor(handle, spec)
         return True
+
+    def _claim_pending_actor_spec(self, handle: WorkerHandle):
+        """Caller holds self._lock. Hand the worker a pending actor spec of
+        its runtime-env pool (assigning it as the actor) — the ONE claim
+        policy shared by fresh registrations and workers going idle.
+        Returns the claimed spec, or None."""
+        for s in self._pending_actor_specs:
+            if _env_key(s.runtime_env) == handle.env_key:
+                self._pending_actor_specs.remove(s)
+                self._assign_actor(handle, s)
+                return s
+        return None
 
     def _assign_actor(self, handle: WorkerHandle, spec) -> None:
         handle.actor_id = spec.actor_id

@@ -351,6 +351,9 @@ class CoreWorker:
             "address": self._server.address,
             "pid": os.getpid(),
             "env_key": os.environ.get("RAY_TPU_RUNTIME_ENV_KEY"),
+            # set by worker_pool._forked_child_main: this process was forked
+            # from a warm template rather than cold-spawned
+            "forked": os.environ.get("RAY_TPU_WORKER_FORKED") == "1",
         })
         self.node_id = reply["node_id"]
         self._registered.set()

@@ -3,6 +3,15 @@
 Equivalent of the reference's `python/ray/_private/workers/default_worker.py`
 (entry `:165`): spawned by the raylet's worker pool, connects back, then
 serves tasks until told to exit.
+
+Two spawn modes share this module:
+
+* cold: `python -m ray_tpu.core.worker_main --raylet ... --gcs ...` boots a
+  fresh interpreter per worker (the classic path, and the fallback).
+* warm: `--template` parks a fork-template ("zygote") process that preloads
+  the heavy imports once and `os.fork()`s a ready worker per granted lease
+  (see `worker_pool.py`); each forked child runs the same `run_worker`
+  body a cold worker runs.
 """
 
 from __future__ import annotations
@@ -12,16 +21,14 @@ import logging
 import time
 
 
-def main() -> None:
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--raylet", required=True)
-    parser.add_argument("--gcs", required=True)
-    parser.add_argument("--node-id", required=True)
-    parser.add_argument("--log-level", default="WARNING")
-    args = parser.parse_args()
-
+def run_worker(raylet_address: str, gcs_address: str,
+               log_level: str = "WARNING") -> None:
+    """The worker body proper: connect, register, serve until the raylet
+    link drops. Runs in cold-spawned processes AND in children forked from
+    a template — keep it free of assumptions about interpreter freshness
+    beyond what `worker_pool._forked_child_main` resets."""
     logging.basicConfig(
-        level=args.log_level,
+        level=log_level,
         format="%(asctime)s %(levelname)s worker %(name)s: %(message)s",
     )
 
@@ -100,8 +107,8 @@ def main() -> None:
 
     try:
         worker = CoreWorker(
-            mode="worker", raylet_address=args.raylet, gcs_address=args.gcs,
-            connect_timeout=10.0)
+            mode="worker", raylet_address=raylet_address,
+            gcs_address=gcs_address, connect_timeout=10.0)
     except ConnectionError:
         return  # raylet is gone (e.g. shut down while we were starting)
     set_current_worker(worker)
@@ -115,6 +122,26 @@ def main() -> None:
             time.sleep(0.5)
     except KeyboardInterrupt:
         pass
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--raylet", required=True)
+    parser.add_argument("--gcs", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--log-level", default="WARNING")
+    parser.add_argument("--template", action="store_true",
+                        help="run as a fork-template (zygote) process")
+    parser.add_argument("--reply-fd", type=int, default=-1,
+                        help="inherited fd for template protocol replies")
+    args = parser.parse_args()
+
+    if args.template:
+        from ray_tpu.core.worker_pool import template_main
+
+        template_main(args)
+        return
+    run_worker(args.raylet, args.gcs, log_level=args.log_level)
 
 
 if __name__ == "__main__":

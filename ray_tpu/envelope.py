@@ -54,10 +54,22 @@ def bench_queued_tasks(n_tasks: int = 20_000) -> Dict:
     }
 
 
+def _worker_pool_stats() -> Dict:
+    from ray_tpu.core.worker import current_worker
+
+    try:
+        return current_worker().raylet.call("worker_pool_stats", {},
+                                            timeout=30)
+    except Exception:
+        return {}
+
+
 def bench_concurrent_actors(n_actors: int = 200) -> Dict:
     """Concurrent alive actors (reference: 40k+ across 2000 nodes). All
     created at once, then one round-trip call to every actor while all are
-    alive proves liveness rather than just registration."""
+    alive proves liveness rather than just registration. Reports the warm
+    worker pool's share of the burst: every actor lease should be served
+    by a template fork, not a cold import-paying spawn."""
     import ray_tpu
 
     @ray_tpu.remote
@@ -65,6 +77,7 @@ def bench_concurrent_actors(n_actors: int = 200) -> Dict:
         def ping(self):
             return os.getpid()
 
+    s0 = _worker_pool_stats()
     t0 = time.perf_counter()
     actors = [A.options(num_cpus=0).remote() for _ in range(n_actors)]
     pids = ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
@@ -73,14 +86,24 @@ def bench_concurrent_actors(n_actors: int = 200) -> Dict:
     t0 = time.perf_counter()
     ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
     t_round = time.perf_counter() - t0
+    s1 = _worker_pool_stats()
     for a in actors:
         ray_tpu.kill(a)
-    return {
+    out = {
         "n_actors": n_actors,
         "distinct_workers": len(set(pids)),
         "create_to_first_ping_s": round(t_up, 2),
         "alive_roundtrip_calls_per_s": round(n_actors / t_round, 1),
     }
+    if s0 and s1:
+        warm = s1["registered_warm"] - s0["registered_warm"]
+        cold = s1["registered_cold"] - s0["registered_cold"]
+        out["warm_starts"] = warm
+        out["cold_starts"] = cold
+        out["warm_start_fraction"] = round(warm / max(1, warm + cold), 3)
+        out["fork_p50_ms"] = s1.get("fork_p50_ms")
+        out["fork_p99_ms"] = s1.get("fork_p99_ms")
+    return out
 
 
 def bench_placement_groups(n_pgs: int = 30) -> Dict:
@@ -139,9 +162,11 @@ def bench_broadcast(size_mib: int = 1024, n_receivers: int = 3) -> Dict:
         cluster.shutdown()
 
 
-def run_envelope(scale: float = 1.0) -> Dict:
+def run_envelope(scale: float = 1.0, elastic: bool = False) -> Dict:
     """Run every envelope bench inside one fresh runtime; returns the
-    artifact dict (committed as ENVELOPE_r{N}.json)."""
+    artifact dict (committed as ENVELOPE_r{N}.json). With `elastic`, the
+    burst-elasticity chaos scenario (core/burst.py: 10 -> 1000 workers
+    under load with seeded kills) runs too and lands in the artifact."""
     import ray_tpu
     from ray_tpu.microbenchmark import run_microbenchmark
 
@@ -167,6 +192,18 @@ def run_envelope(scale: float = 1.0) -> Dict:
             max(1, int(30 * scale)))
         log("microbenchmark...")
         results["microbenchmark"] = run_microbenchmark()
+        if elastic:
+            from ray_tpu.core.burst import BurstProfile, run_burst
+
+            log("elastic burst...")
+            if scale >= 1.0:
+                profile = BurstProfile()
+            else:
+                profile = BurstProfile(
+                    n_start=max(2, int(10 * scale)),
+                    n_target=max(4, int(1000 * scale)),
+                    n_kills=max(1, int(8 * scale)))
+            results["elastic_burst"] = run_burst(profile)
     finally:
         if own:
             ray_tpu.shutdown()
@@ -184,8 +221,11 @@ def main(argv: List[str] | None = None) -> int:
     ap.add_argument("--out", default=None, help="write artifact JSON here")
     ap.add_argument("--scale", type=float, default=1.0,
                     help="scale factor on every count (CI smoke uses 0.01)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="also run the burst-elasticity chaos scenario "
+                         "(10 -> 1000 workers under load + seeded kills)")
     args = ap.parse_args(argv)
-    art = run_envelope(scale=args.scale)
+    art = run_envelope(scale=args.scale, elastic=args.elastic)
     text = json.dumps(art, indent=2)
     if args.out:
         with open(args.out, "w") as f:

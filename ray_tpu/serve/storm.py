@@ -87,11 +87,7 @@ class _Outcomes:
     latencies_ms: List[float] = field(default_factory=list)
 
 
-def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
-    if not sorted_vals:
-        return None
-    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
-    return sorted_vals[idx]
+from ray_tpu.util.stats import percentile as _percentile  # noqa: E402
 
 
 def run_storm(profile: Optional[StormProfile] = None,

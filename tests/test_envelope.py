@@ -16,6 +16,14 @@ _R05 = {
     "actor_call_roundtrip": 158.5,
 }
 _SLACK = 0.5
+# Committed full-scale ENVELOPE_r06.json actor-burst time: 200 actors took
+# 49.21 s to first ping on the all-cold spawn path. The warm worker pool
+# (fork-template zygotes) cut the full-scale number to ~5 s; the smoke's
+# 2-actor wave must never climb back into cold-collapse territory — with
+# the same 0.5x slack discipline the budget is half the r06 burst time,
+# still ~5x what the 2-actor wave needs even if every fork falls back to
+# a cold spawn on a loaded CI box.
+_R06_ACTORS_TO_FIRST_PING_S = 49.21
 
 
 def test_envelope_smoke(tmp_path):
@@ -47,3 +55,28 @@ def test_envelope_smoke(tmp_path):
         _SLACK * _R05["actor_call_roundtrip"], (
         f"actor_call_roundtrip {rates['actor_call_roundtrip']} fell below "
         f"{_SLACK}x the r05 envelope ({_R05['actor_call_roundtrip']})")
+
+    # --- warm-start regression floor vs ENVELOPE_r06.json (PR 10) ---
+    budget = _SLACK * _R06_ACTORS_TO_FIRST_PING_S
+    assert actors["create_to_first_ping_s"] <= budget, (
+        f"create_to_first_ping_s {actors['create_to_first_ping_s']} blew "
+        f"the {budget:.1f}s budget ({_SLACK}x r06's "
+        f"{_R06_ACTORS_TO_FIRST_PING_S}s for 100x the actors): the warm "
+        f"worker pool has collapsed back to cold-spawn behavior")
+    # the burst must ride the warm pool on fork-capable platforms: a
+    # silent fall-through to all-cold spawns is a regression even when
+    # it happens to fit the time budget. Leases served by ALREADY-IDLE
+    # workers start nothing (warm==cold==0) — that's fine; only judge the
+    # fraction when the burst actually started workers.
+    import os as _os
+
+    from ray_tpu.core.config import get_config
+
+    started = (actors.get("warm_starts") or 0) + \
+        (actors.get("cold_starts") or 0)
+    if hasattr(_os, "fork") and started >= 2 \
+            and get_config().worker_template_enabled:
+        frac = actors.get("warm_start_fraction", 0.0)
+        assert frac >= 0.5, (
+            f"warm_start_fraction {frac}: most actor leases were served "
+            f"by cold spawns despite a fork-capable platform")

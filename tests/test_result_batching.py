@@ -151,11 +151,25 @@ def test_stop_flushes_buffered_results(cfg):
     assert got == [b"t0", b"t1"]
 
 
-def test_deep_queue_batches_and_results_correct(ray_start_regular):
+def test_deep_queue_batches_and_results_correct(monkeypatch):
     """Integration: a deep queue of tasks returning distinct values comes
     back correct and ordered THROUGH the batched path — the driver sees
     fewer report_task_result RPCs than tasks, and at least one multi-task
-    batch."""
+    batch.
+
+    Coalescing only happens when a completion lands while a delivery is
+    ON THE WIRE; with warm-forked workers an in-process notify is so fast
+    the window is a coin flip. A seeded 30 ms FaultInjector delay at the
+    workers' report_task_result send boundary makes the window real, so
+    the batching behavior under a slow owner link is what's asserted —
+    deterministically — rather than a GIL-timeslice race."""
+    from ray_tpu.core.config import reset_config
+
+    monkeypatch.setenv("RAY_TPU_FAULT_INJECTION_SPEC",
+                       "delay:report_task_result:30")
+    monkeypatch.setenv("RAY_TPU_FAULT_INJECTION_SEED", "0")
+    reset_config()
+    ray_tpu.init(num_cpus=4, resources={"TPU": 8})
     w = ray_tpu.core.worker.current_worker()
     payloads = []
     orig = w._server._handlers["report_task_result"]
@@ -173,9 +187,11 @@ def test_deep_queue_batches_and_results_correct(ray_start_regular):
     try:
         n = 300
         refs = [ident.remote(i) for i in range(n)]
-        assert ray_tpu.get(refs) == list(range(n))
+        assert ray_tpu.get(refs, timeout=120) == list(range(n))
     finally:
         w._server._handlers["report_task_result"] = orig
+        ray_tpu.shutdown()
+        reset_config()
     entries = sum(len(p["batch"]) if "batch" in p else 1 for p in payloads)
     assert entries == n
     assert len(payloads) < n, "no coalescing happened on a deep queue"

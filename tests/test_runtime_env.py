@@ -337,6 +337,7 @@ def test_envfile_materialized_at_spawn(tmp_path, monkeypatch):
             self._lock = threading.Lock()
             self._starting = []
             self._starting_env = {}
+            self._starting_envfile = {}
 
     sh = Shell()
     sh._launch_worker("python3", {"A": "1", "PATH": "/bin"},
@@ -349,3 +350,9 @@ def test_envfile_materialized_at_spawn(tmp_path, monkeypatch):
     assert envfile != "{ENVFILE}"
     content = open(envfile).read()
     assert "A=1" in content and "PATH=/bin" in content
+    # the file is tracked for deletion at registration / startup-death
+    # (the {ENVFILE} mkstemp used to leak)
+    assert sh._starting_envfile[FakeProc.pid] == envfile
+    import os
+
+    os.unlink(envfile)

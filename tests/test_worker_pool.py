@@ -1,0 +1,285 @@
+"""Warm worker pool (fork-template zygotes) lifecycle tests.
+
+Covers the contract in core/worker_pool.py: template reuse across leases,
+crash -> backoff respawn with cold fallback in between, forked workers
+honoring max_calls recycle + idle killing, runtime-env isolation between
+templates, and unexpected-death failover of recently-completed tasks on a
+FORKED worker behaving exactly like a spawned one."""
+
+import os
+import time
+
+import pytest
+
+from ray_tpu.core.config import reset_config
+
+
+def _pool():
+    from ray_tpu.core import api
+
+    return api._node.raylet._worker_pool
+
+
+def _stats():
+    return _pool().stats()
+
+
+@pytest.fixture
+def fresh_runtime(monkeypatch):
+    """Config is re-read from the env at the NEXT init; every test here
+    boots (and tears down) its own runtime after setting knobs."""
+    reset_config()
+    yield monkeypatch
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    reset_config()
+
+
+def test_fork_template_reuse_across_leases(fresh_runtime):
+    """One template boot serves every lease of its env: N actors = N forks,
+    zero cold spawns, one zygote."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return os.getpid()
+
+    actors = [A.options(num_cpus=0).remote() for _ in range(4)]
+    pids = ray_tpu.get([a.ping.remote() for a in actors], timeout=120)
+    assert len(set(pids)) == 4
+    s = _stats()
+    assert s["fork_supported"]
+    assert s["template_boots"] == 1
+    assert s["registered_warm"] >= 4
+    assert s["registered_cold"] == 0
+    tmpl = s["templates"][""]
+    assert tmpl["state"] == "ready" and tmpl["pid"] is not None
+    # the zygote is alive and is NOT one of the workers
+    os.kill(tmpl["pid"], 0)
+    assert tmpl["pid"] not in pids
+
+
+def test_template_crash_cold_fallback_then_respawn(fresh_runtime):
+    """Template dies -> leases inside the backoff window are served by
+    cold Popen spawns; once the window elapses the template respawns and
+    leases go warm again."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return os.getpid()
+
+    a1 = A.options(num_cpus=0).remote()
+    ray_tpu.get(a1.ping.remote(), timeout=120)
+    pool = _pool()
+    s = _stats()
+    assert s["registered_warm"] >= 1 and s["template_boots"] == 1
+
+    # crash the zygote and pin the backoff window open (deterministic:
+    # the jittered delay could be arbitrarily short)
+    slot = pool._templates[None]
+    os.kill(slot.handle.pid, 9)
+    with pool._lock:
+        pool._mark_failed_locked(slot)
+        slot.retry_at = time.monotonic() + 60.0
+
+    a2 = A.options(num_cpus=0).remote()
+    ray_tpu.get(a2.ping.remote(), timeout=120)
+    s = _stats()
+    assert s["registered_cold"] >= 1, \
+        "lease inside the backoff window must be served cold"
+
+    # elapse the backoff: the next lease respawns the template
+    slot.retry_at = 0.0
+    warm_before = s["registered_warm"]
+    a3 = A.options(num_cpus=0).remote()
+    ray_tpu.get(a3.ping.remote(), timeout=120)
+    s = _stats()
+    assert s["template_boots"] == 2 and s["template_respawns"] == 1
+    assert s["registered_warm"] > warm_before
+    for a in (a1, a2, a3):
+        ray_tpu.kill(a)
+
+
+def test_forked_worker_honors_max_calls_recycle(fresh_runtime):
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote(max_calls=1)
+    def f():
+        return os.getpid()
+
+    p1 = ray_tpu.get(f.remote(), timeout=120)
+    p2 = ray_tpu.get(f.remote(), timeout=120)
+    assert p1 != p2, "max_calls=1 must recycle the forked worker"
+    s = _stats()
+    assert s["registered_warm"] >= 2 and s["registered_cold"] == 0
+    # the recycled worker actually exited
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        try:
+            os.kill(p1, 0)
+            time.sleep(0.1)
+        except OSError:
+            break
+    else:
+        pytest.fail("recycled forked worker still alive")
+
+
+def test_forked_worker_honors_idle_killing(fresh_runtime):
+    import ray_tpu
+
+    fresh_runtime.setenv("RAY_TPU_IDLE_WORKER_KILLING_TIME_S", "1")
+    reset_config()
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote
+    def f():
+        return os.getpid()
+
+    pid = ray_tpu.get(f.remote(), timeout=120)
+    s = _stats()
+    assert s["registered_warm"] >= 1
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+            time.sleep(0.2)
+        except OSError:
+            return  # idle-killed, like any spawned worker
+    pytest.fail("forked idle worker was never reaped")
+
+
+def test_runtime_env_isolation_between_templates(fresh_runtime):
+    """Env A's template (and its forks) never serve env B's lease: each
+    pooled env gets its own zygote, and every worker carries its env key."""
+    import ray_tpu
+    from ray_tpu.core import runtime_env_manager as rem
+
+    class TagPlugin(rem.RuntimeEnvPlugin):
+        name = "test_tag"
+        pooled = True
+
+        def modify_context(self, value, env_dir, ctx):
+            ctx.env_vars["RAY_TPU_TEST_TAG"] = str(value)
+
+    rem.register_plugin(TagPlugin())
+    try:
+        ray_tpu.init(num_cpus=4)
+
+        @ray_tpu.remote(max_calls=1)
+        def who():
+            return (os.environ.get("RAY_TPU_RUNTIME_ENV_KEY"),
+                    os.environ.get("RAY_TPU_TEST_TAG"))
+
+        key_a = rem.env_key({"test_tag": "A"})
+        key_b = rem.env_key({"test_tag": "B"})
+        assert key_a != key_b
+        # max_calls=1 forces a fresh worker per call: later calls fork from
+        # the env's template (the first boots cold while the env builds)
+        for _ in range(3):
+            k, tag = ray_tpu.get(who.options(
+                runtime_env={"test_tag": "A"}).remote(), timeout=120)
+            assert (k, tag) == (key_a, "A")
+            k, tag = ray_tpu.get(who.options(
+                runtime_env={"test_tag": "B"}).remote(), timeout=120)
+            assert (k, tag) == (key_b, "B")
+        s = _stats()
+        tmpl_keys = set(s["templates"]) - {""}
+        assert {key_a, key_b} <= tmpl_keys, \
+            f"expected per-env templates for {key_a}/{key_b}, got {tmpl_keys}"
+    finally:
+        rem.unregister_plugin("test_tag")
+
+
+def test_idle_worker_claims_pending_actor_spec(fresh_runtime):
+    """A pending actor spec must be claimed by a same-env worker going
+    idle, not only by fresh registrations: the pool's demand dedup counts
+    idle workers, so with spawning suppressed entirely the actor would
+    otherwise wait for the idle-kill reaper (or forever under the floor)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote
+    def busy(t):
+        time.sleep(t)
+        return os.getpid()
+
+    # warm up exactly one worker, then suppress ALL further spawning
+    pid = ray_tpu.get(busy.remote(0.0), timeout=120)
+    pool = _pool()
+    fresh_runtime.setattr(pool, "request", lambda *a, **k: None)
+
+    ref = busy.remote(1.0)  # occupies the only worker
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return os.getpid()
+
+    a = A.options(num_cpus=0).remote()  # queues as a pending spec
+    # once the task finishes, the idling worker must take the spec
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == pid
+    assert ray_tpu.get(ref, timeout=30) == pid
+    ray_tpu.kill(a)
+
+
+def test_forked_worker_death_fails_over_recent_done(fresh_runtime):
+    """A forked worker SIGKILLed while its completed task's results are
+    still in flight triggers the same recently-completed failover as a
+    spawned worker: the owner re-runs the task instead of hanging."""
+    import ray_tpu
+
+    # results stall 2.5 s at the client send boundary in every worker
+    # (workers inherit the env-driven spec; the driver never sends this)
+    fresh_runtime.setenv("RAY_TPU_FAULT_INJECTION_SPEC",
+                         "delay:report_task_result:2500")
+    fresh_runtime.setenv("RAY_TPU_FAULT_INJECTION_SEED", "20260804")
+    reset_config()
+    ray_tpu.init(num_cpus=2)
+
+    pid_file = "/tmp/ray_tpu_test_wp_pids.txt"
+    try:
+        os.unlink(pid_file)
+    except OSError:
+        pass
+
+    @ray_tpu.remote(max_retries=1)
+    def f():
+        with open(pid_file, "a") as fh:
+            fh.write(f"{os.getpid()}\n")
+        return "ok"
+
+    ref = f.remote()
+    # wait for the task body to finish (task_done sent; results delayed)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            with open(pid_file) as fh:
+                pid = int(fh.readline())
+            break
+        except (OSError, ValueError):
+            time.sleep(0.05)
+    else:
+        pytest.fail("task never started")
+    time.sleep(0.3)
+    os.kill(pid, 9)  # results die in the buffer; recent_done fails over
+
+    assert ray_tpu.get(ref, timeout=60) == "ok"
+    with open(pid_file) as fh:
+        pids = [int(x) for x in fh.read().split()]
+    assert len(pids) == 2 and pids[0] != pids[1], \
+        "task must have re-run on a fresh worker"
+    s = _stats()
+    assert s["registered_warm"] >= 1  # the killed worker was a fork
+    os.unlink(pid_file)
