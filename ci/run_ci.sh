@@ -1,13 +1,15 @@
 #!/usr/bin/env bash
 # One-command CI for ray_tpu (reference role: .buildkite/pipeline.build.yml).
 #
-#   ci/run_ci.sh            # native + fast + stress x20 + chaos + storm + burst
+#   ci/run_ci.sh            # native + fast + stress x20 + chaos + storm
+#                           #   + burst + head-failover
 #   ci/run_ci.sh --fast     # fast test tier only
 #   ci/run_ci.sh --native   # native ASAN/UBSAN harness only
 #   ci/run_ci.sh --stress   # actor-ordering stress x20 only
 #   ci/run_ci.sh --chaos    # control-plane HA chaos suite only
 #   ci/run_ci.sh --storm    # serve traffic-storm chaos only
 #   ci/run_ci.sh --burst    # warm-pool elasticity burst only
+#   ci/run_ci.sh --failover # standby-head kill-and-promote storm only
 #
 # Stages:
 #   1. native      : arena + scheduler + token-loader compiled whole-program
@@ -29,13 +31,21 @@
 #                    prints cold/warm start counts + the seed and fails if
 #                    any lease is served by neither a warm fork nor a cold
 #                    fallback (or any kill fails to recover).
+#   7. failover    : standby-head kill-and-promote mid-storm (--kill-head):
+#                    the active head is crash-stopped under serve load, a
+#                    warm standby takes over via the lease/fencing-epoch
+#                    CAS. Prints the seed, lease epochs observed and the
+#                    promotion latency (lease-expiry -> first-scheduled-
+#                    task); fails if promotion exceeds the budget, any
+#                    request hangs, or typed errors spike past the shed
+#                    baseline.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGE="${1:-all}"
 
 run_native() {
-  echo "=== [1/6] native modules under ASan/UBSan ==="
+  echo "=== [1/7] native modules under ASan/UBSan ==="
   mkdir -p build
   g++ -std=c++17 -O1 -g -fsanitize=address,undefined \
       -fno-omit-frame-pointer -o build/sanitize_native \
@@ -47,7 +57,7 @@ run_native() {
 }
 
 run_fast() {
-  echo "=== [2/6] fast test tier ==="
+  echo "=== [2/7] fast test tier ==="
   python -m pytest tests/ -q
   # core-primitives smoke: the submission AND completion hot paths
   # (function table, event batching, batched result delivery, put/get)
@@ -69,7 +79,7 @@ EOF
 }
 
 run_stress() {
-  echo "=== [3/6] actor ordering stress x20 ==="
+  echo "=== [3/7] actor ordering stress x20 ==="
   for i in $(seq 1 20); do
     python -m pytest tests/test_actor_ordering_stress.py -q -x \
       || { echo "ordering stress failed on iteration $i"; exit 1; }
@@ -77,14 +87,15 @@ run_stress() {
 }
 
 run_chaos() {
-  echo "=== [4/6] control-plane HA chaos suite ==="
+  echo "=== [4/7] control-plane HA chaos suite ==="
   # Deterministic fault injection: pin + print the seed so a red run
   # reproduces bit-for-bit (override by exporting the variable).
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
   timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
-    tests/test_head_replacement.py tests/test_fault_injection.py \
+    tests/test_head_replacement.py tests/test_head_failover.py \
+    tests/test_fault_injection.py \
     tests/test_chaos.py tests/test_gcs_fault_tolerance.py \
     -q -m '' \
     || { echo "chaos suite failed (seed ${RAY_TPU_FAULT_INJECTION_SEED})"
@@ -92,7 +103,7 @@ run_chaos() {
 }
 
 run_serve_storm() {
-  echo "=== [5/6] serve traffic-storm chaos ==="
+  echo "=== [5/7] serve traffic-storm chaos ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -108,7 +119,7 @@ run_serve_storm() {
 }
 
 run_burst() {
-  echo "=== [6/6] warm-pool elasticity burst ==="
+  echo "=== [6/7] warm-pool elasticity burst ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "burst seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -124,17 +135,37 @@ run_burst() {
          exit 1; }
 }
 
+run_head_failover() {
+  echo "=== [7/7] standby-head kill-and-promote storm ==="
+  : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
+  export RAY_TPU_FAULT_INJECTION_SEED
+  echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
+  # --kill-head: mid-storm the active head is crash-stopped; a warm standby
+  # tails the snapshot store and promotes via the lease/fencing-epoch CAS.
+  # The harness prints the lease epochs observed and the promotion latency
+  # (lease-expiry -> first-scheduled-task) and exits nonzero if promotion
+  # exceeds the budget, any request hangs, or typed errors spike beyond
+  # the shed baseline.
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m ray_tpu.serve.storm \
+    --quick --kill-head --seed "${RAY_TPU_FAULT_INJECTION_SEED}" \
+    --json /tmp/ray_tpu_servestorm_headfail_ci.json \
+    --headfail-json /tmp/ray_tpu_headfail_ci.json \
+    || { echo "head-failover storm failed (seed ${RAY_TPU_FAULT_INJECTION_SEED})"
+         exit 1; }
+}
+
 case "$STAGE" in
-  --native) run_native ;;
-  --fast)   run_fast ;;
-  --stress) run_stress ;;
-  --chaos)  run_chaos ;;
-  --storm)  run_serve_storm ;;
-  --burst)  run_burst ;;
-  all)      run_native; run_fast; run_stress; run_chaos; run_serve_storm
-            run_burst ;;
+  --native)   run_native ;;
+  --fast)     run_fast ;;
+  --stress)   run_stress ;;
+  --chaos)    run_chaos ;;
+  --storm)    run_serve_storm ;;
+  --burst)    run_burst ;;
+  --failover) run_head_failover ;;
+  all)        run_native; run_fast; run_stress; run_chaos; run_serve_storm
+              run_burst; run_head_failover ;;
   *) echo "unknown stage: $STAGE" \
-     "(use --native|--fast|--stress|--chaos|--storm|--burst)" >&2
+     "(use --native|--fast|--stress|--chaos|--storm|--burst|--failover)" >&2
      exit 2 ;;
 esac
 echo "CI green"

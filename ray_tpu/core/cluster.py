@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-from ray_tpu.core.gcs import GcsServer
+from ray_tpu.core.gcs import GcsServer, StandbyHead
 from ray_tpu.core.raylet import Raylet
 
 
@@ -23,11 +23,13 @@ class Cluster:
         """`snapshot_uri` selects the control-plane SnapshotStore
         ("file://<dir>" / "memory://<name>"); `gcs_snapshot_path` is the
         legacy file spelling. Either enables `restart_gcs()` (same
-        address) and `replace_head()` (NEW address)."""
+        address), `replace_head()` (NEW address) and the standby-head
+        paths (`start_standby()` / `rolling_head_upgrade()`)."""
         self.gcs = GcsServer(snapshot_path=gcs_snapshot_path,
                              snapshot_uri=snapshot_uri)
         self.gcs.start()
         self._raylets: list[Raylet] = []
+        self._standbys: list[StandbyHead] = []
         self.head: Optional[Raylet] = None
 
     @property
@@ -95,6 +97,46 @@ class Cluster:
         self.gcs = GcsServer(host=host, snapshot_uri=snapshot_uri, port=0)
         return self.gcs.start()
 
+    def start_standby(self) -> StandbyHead:
+        """Start a warm standby head tailing this cluster's snapshot store:
+        it promotes itself (lease-epoch CAS) when the active head's lease
+        expires or is relinquished. `adopt_promoted()` swaps it in as
+        `self.gcs` once promoted."""
+        uri = self.gcs._snapshot_uri
+        if not uri:
+            raise ValueError("standby head needs a snapshot store "
+                             "(pass snapshot_uri= to Cluster)")
+        standby = StandbyHead(uri, host=self.gcs.address.rsplit(":", 1)[0])
+        standby.start()
+        self._standbys.append(standby)
+        return standby
+
+    def adopt_promoted(self, standby: StandbyHead,
+                       timeout: float = 60.0) -> str:
+        """Wait for `standby` to promote and install it as this cluster's
+        head. Returns the new GCS address."""
+        promoted = standby.wait_promoted(timeout)
+        if promoted is None:
+            raise TimeoutError("standby did not promote within "
+                               f"{timeout}s: {standby.stats()}")
+        self.gcs = promoted
+        return promoted.address
+
+    def rolling_head_upgrade(self, timeout: float = 60.0) -> str:
+        """Zero-downtime head upgrade: start a standby, DRAIN the active
+        head's lease (expire it now, no TTL wait), let the standby promote
+        via the epoch CAS and re-adopt the fleet, then retire the old head
+        (no final flush — the store belongs to the new epoch). In-flight
+        work rides worker/raylet links throughout; control-plane calls
+        retry across the switchover. Returns the new GCS address."""
+        old = self.gcs
+        standby = self.start_standby()
+        old._write_snapshot()  # hand over the freshest possible state
+        old.drain_lease()
+        address = self.adopt_promoted(standby, timeout)
+        old.retire()
+        return address
+
     def remove_node(self, raylet: Raylet) -> None:
         """Simulate node failure: kill raylet + its workers abruptly."""
         self._raylets.remove(raylet)
@@ -117,6 +159,15 @@ class Cluster:
 
         if ray_tpu.is_initialized():
             ray_tpu.shutdown()
+        for s in self._standbys:
+            try:
+                s.stop()
+                # a promoted-but-never-adopted standby owns a live GcsServer
+                if s.promoted is not None and s.promoted is not self.gcs:
+                    s.promoted.stop()
+            except Exception:
+                pass
+        self._standbys.clear()
         for r in self._raylets:
             try:
                 r.stop()

@@ -178,6 +178,19 @@ class Config:
     # a 2-phase PG bundle prepared but never committed (the head died
     # between phases) is returned to the node pool after this timeout
     bundle_prepare_timeout_s: float = 30.0
+    # --- standby head / lease fencing (core/head_lease.py) ---
+    # TTL of the active head's lease (stored beside the snapshots); the
+    # head renews every ttl/3, a standby promotes once it expires. Lower =
+    # faster failover, more store writes.
+    head_lease_ttl_s: float = 3.0
+    # explicit renew period; 0 = ttl/3
+    head_lease_renew_period_s: float = 0.0
+    # standby snapshot-tail + lease-watch poll period; 0 = ttl/4
+    head_standby_poll_s: float = 0.0
+    # CH_RESOURCES fan-out ships per-node DELTAS between full snapshots
+    # (full on topology change / subscriber catch-up) so gossip volume is
+    # O(changes), not O(nodes) per publish x O(nodes) subscribers
+    resource_broadcast_delta_enabled: bool = True
 
     # --- fault injection (deterministic chaos; see rpc.FaultInjector) ---
     # Rules at named client-side RPC boundaries, ";"-separated:

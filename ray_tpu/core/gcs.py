@@ -36,12 +36,34 @@ CH_CONTROL = "control"  # cluster-wide commands (global_gc, ...)
 CH_LOGS = "logs"        # worker stdout/stderr fan-out to drivers
 
 
+def _head_metrics() -> dict:
+    """Lazy HA metric handles (util/metrics.py): shared names across the
+    active head, a promoted standby and the raylet-side announce drops."""
+    from ray_tpu.util.metrics import get_or_create
+
+    return {
+        "failovers": get_or_create(
+            "counter", "ray_tpu_head_failovers_total",
+            "standby head promotions"),
+        "promotion_s": get_or_create(
+            "gauge", "ray_tpu_head_promotion_seconds",
+            "lease-expiry -> first-scheduled-task latency of the last "
+            "promotion"),
+        "fencing": get_or_create(
+            "counter", "ray_tpu_fencing_rejections_total",
+            "stale-head writes/announces rejected by the fencing epoch",
+            tag_keys=("site",)),
+    }
+
+
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1",
                  snapshot_path: Optional[str] = None,
                  snapshot_interval_s: float = 5.0,
                  port: int = 0,
-                 snapshot_uri: Optional[str] = None):
+                 snapshot_uri: Optional[str] = None,
+                 preloaded_snapshot: Optional[bytes] = None,
+                 lease_grant: Optional[dict] = None):
         """Control-plane persistence rides a pluggable `SnapshotStore`
         (snapshot_store.py — the role Redis plays for the reference's HA
         GCS, `gcs_table_storage.h`): the durable tables (internal KV, jobs,
@@ -75,6 +97,61 @@ class GcsServer:
         self._snapshot_interval_s = snapshot_interval_s
         self._dirty = False
         self._snapshot_write_lock = threading.Lock()
+        self._snapshots_written = 0
+        self._snapshot_last_version = 0
+
+        # --- lease / fencing (head_lease.py): the active head renews a TTL
+        # lease stored beside the snapshots; the lease EPOCH is the fencing
+        # token every durable write and raylet-facing announce carries. A
+        # head whose epoch trails the store's is FENCED: its snapshot saves
+        # raise, its announces are dropped by raylets, and on_fenced fires
+        # (node_main exits there; tests assert on it).
+        import uuid as _uuid
+
+        from ray_tpu.core.head_lease import HeadLease
+
+        self.session_id: str = _uuid.uuid4().hex[:16]
+        self._restored_fence_epoch = 0  # epoch floor carried by the snapshot
+        self._preloaded_snapshot = preloaded_snapshot
+        self._lease: Optional[HeadLease] = None
+        self._lease_owner: str = ""
+        self._lease_draining = False
+        self.fence_epoch: int = 0
+        self._fenced = threading.Event()
+        self._fencing_rejections = 0
+        self.on_fenced = None  # callback: a newer head took over
+        # set by a promoting StandbyHead: lease-expiry/promotion timestamps;
+        # first_schedule_at lands when this head first dispatches work
+        self.promotion: Optional[dict] = None
+        if self._snapshots is not None:
+            self._lease = HeadLease(self._snapshots.store)
+            if lease_grant is not None:
+                # a StandbyHead already won the acquire CAS for us
+                self._lease_owner = lease_grant["owner"]
+                self.fence_epoch = lease_grant["epoch"]
+                self.promotion = {
+                    "epoch": self.fence_epoch,
+                    "lease_expired_at": lease_grant.get("lease_expired_at"),
+                    "promoted_at": None,
+                    "first_schedule_at": None,
+                    "tailed_version": lease_grant.get("tailed_version"),
+                }
+            else:
+                from ray_tpu.core.head_lease import new_owner_token
+
+                self._lease_owner = new_owner_token()
+
+        # --- delta-encoded resource fan-out state: per-publish sequence,
+        # the set of nodes whose view changed since the last publish, and
+        # a full-snapshot latch (topology change / new subscriber / first
+        # publish). Guarded by self._lock.
+        self._bcast_seq = 0
+        self._bcast_dirty: set = set()        # node hexids changed
+        self._bcast_removed: set = set()      # node hexids removed
+        self._bcast_full_needed = True
+        self._bcast_fulls = 0
+        self._bcast_deltas = 0
+        self._bcast_bytes = 0                 # payload bytes x subscribers
         # 2-phase PG creations serialize here: a client retry racing the
         # restored head's resume of the same (idempotent) creation must not
         # run two concurrent placements and leak the loser's reservations
@@ -89,7 +166,7 @@ class GcsServer:
         from ray_tpu.util.debounce import Debouncer
 
         self._bcast_debounce = Debouncer(
-            lambda: self._publish(CH_RESOURCES, self.cluster_view()),
+            self._publish_resources,
             lambda: get_config().resource_broadcast_period_ms / 1000.0,
             skip_deferred=lambda: self._shutdown.is_set())
 
@@ -176,7 +253,19 @@ class GcsServer:
     # ------------------------------------------------------------------ boot
     def start(self) -> str:
         self._load_snapshot()
+        if self._lease is not None and self.fence_epoch == 0:
+            # operator-started head: force-take the lease (epoch bump). Any
+            # previous holder — a head this one replaces — is fenced from
+            # this point; only a StandbyHead waits out the TTL instead.
+            # The snapshot's persisted fence_epoch floors the new epoch: a
+            # torn/lost lease RECORD must not reset the epoch below one the
+            # fleet already adopted (that would invert every fencing check).
+            self.fence_epoch = self._lease.acquire(
+                self._lease_owner, force=True, settle_s=0,
+                floor=self._restored_fence_epoch + 1)
         self._server.start()
+        if self.promotion is not None:
+            self.promotion["promoted_at"] = time.time()
         self._write_address_file()
         self._health_thread = threading.Thread(
             target=self._health_loop, name="gcs-health", daemon=True
@@ -185,29 +274,156 @@ class GcsServer:
         if self._snapshots is not None:
             threading.Thread(target=self._snapshot_loop, name="gcs-snapshot",
                              daemon=True).start()
+        if self._lease is not None:
+            threading.Thread(target=self._lease_loop, name="gcs-lease",
+                             daemon=True).start()
         if self._restored_nodes or any(
                 p.get("state") == "PREPARING" for p in self._pgs.values()):
             threading.Thread(target=self._readopt_loop, name="gcs-readopt",
                              daemon=True).start()
-        logger.info("GCS listening on %s", self._server.address)
+        logger.info("GCS listening on %s (session %s epoch %d)",
+                    self._server.address, self.session_id, self.fence_epoch)
         return self._server.address
 
     def _write_address_file(self) -> None:
         """Publish this head's address for re-resolution (config
         gcs_address_file): raylets/workers/drivers re-read the file on
         every reconnect attempt, so a replacement head on a new address is
-        found without restarting anything. Atomic swap — a reader never
-        sees a half-written address."""
+        found without restarting anything. Atomic swap through a tmp file
+        unique per WRITER (pid + thread + object id — an old and a new head
+        in one process must not stomp each other's tmp) and fsynced before
+        the rename — a reader never sees a half-written or empty address,
+        and `read_gcs_address_file` treats an empty read as "no answer"
+        (retry), never as an address."""
         path = get_config().gcs_address_file
         if not path:
             return
         try:
-            tmp = f"{path}.tmp{os.getpid()}"
+            tmp = (f"{path}.tmp{os.getpid()}."
+                   f"{threading.get_ident()}.{id(self)}")
             with open(tmp, "w") as f:
                 f.write(self._server.address)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
         except OSError:
             logger.exception("could not write GCS address file %s", path)
+
+    # ------------------------------------------------------- lease / fencing
+    def _lease_loop(self) -> None:
+        """Renew the head lease every ttl/3. A renewal WRITE lost to the
+        injected `lease_renew` fault (or a store blip) just shortens the
+        runway — the lease expires and a standby takes over; a renewal that
+        READS a bumped epoch means that already happened: fence ourselves."""
+        from ray_tpu.core.head_lease import LeaseLostError
+
+        cfg = get_config()
+        period = cfg.head_lease_renew_period_s or (self._lease.ttl_s / 3.0)
+        while not self._shutdown.wait(period):
+            if self._fenced.is_set():
+                return
+            try:
+                if self._lease_draining:
+                    # rolling upgrade: no renewals (we relinquished), but
+                    # keep READING so the successor's epoch bump fences —
+                    # and thereby retires — this head automatically
+                    self._lease.check(self.fence_epoch)
+                    continue
+                self._lease.renew(self._lease_owner, self.fence_epoch,
+                                  address=self._server.address,
+                                  snapshot_version=self._snapshot_last_version)
+            except LeaseLostError as e:
+                self._note_fenced(f"lease renewal: {e}")
+                return
+            except rpc.RpcDisconnected as e:
+                logger.warning("head lease renewal lost (%s); lease expires "
+                               "unless a later renewal lands", e)
+            except Exception:
+                logger.exception("head lease renewal failed")
+
+    def _note_fenced(self, reason: str) -> None:
+        if self._fenced.is_set():
+            return
+        self._fenced.set()
+        logger.warning("GCS %s FENCED (epoch %d): %s — retiring",
+                       self._server.address, self.fence_epoch, reason)
+        cb = self.on_fenced
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                logger.exception("on_fenced callback failed")
+        # A fenced head must stop SERVING, not just stop writing: still-
+        # connected clients would otherwise keep reading (and mutating) a
+        # dead epoch's view — e.g. its health loop declaring the departed
+        # fleet dead and publishing actor deaths to subscribed drivers.
+        # Dropping the connections makes every client re-resolve (via
+        # address file / raylet answerback) to the head that fenced us.
+        threading.Thread(target=self._retire_after_fence,
+                         name="gcs-fenced-retire", daemon=True).start()
+
+    def _retire_after_fence(self) -> None:
+        time.sleep(0.05)  # let in-flight replies (incl. our rejection) flush
+        if not self._shutdown.is_set():
+            self.retire()
+
+    def rpc_head_fenced(self, conn, req_id, payload):
+        """A successor head telling us it bumped the lease epoch (the
+        promoted standby dials the address the old lease record carried).
+        Shrinks the stale-serving window from a lease-read period to one
+        RPC; epoch-checked so a confused caller can't fence the real
+        head."""
+        if int(payload.get("epoch", 0)) > self.fence_epoch:
+            self._note_fenced(
+                f"successor at {payload.get('address')} announced epoch "
+                f"{payload.get('epoch')}")
+            return True
+        return False
+
+    def _reject_fenced_write(self, site: str) -> None:
+        self._fencing_rejections += 1
+        try:
+            _head_metrics()["fencing"].inc(tags={"site": site})
+        except Exception:
+            pass
+        self._note_fenced(f"write rejected at {site}")
+
+    def drain_lease(self) -> None:
+        """Rolling head upgrade, step 1: stop renewing and expire the lease
+        NOW so a standby promotes immediately (no TTL wait). This head keeps
+        serving reads until the standby's epoch bump fences it; call
+        `retire()` once the standby is active."""
+        if self._lease is None:
+            raise RuntimeError("no snapshot store — no lease to drain")
+        self._lease_draining = True
+        self._lease.relinquish(self._lease_owner, self.fence_epoch)
+        logger.info("GCS %s relinquished head lease (epoch %d) for rolling "
+                    "upgrade", self._server.address, self.fence_epoch)
+
+    def retire(self) -> None:
+        """Rolling head upgrade, step 3: the standby is active; stop without
+        fighting it for the store (no final snapshot flush)."""
+        self._fenced.set()
+        self._shutdown.set()
+        for c in self._raylet_clients.values():
+            c.close()
+        self._server.stop()
+
+    def _note_first_schedule(self) -> None:
+        """Stamp a promoted head's first dispatched work: the far edge of
+        the tracked promotion latency (lease-expiry -> first-scheduled-task,
+        HEADFAIL artifact + ray_tpu_head_promotion_seconds)."""
+        p = self.promotion
+        if p is None or p.get("first_schedule_at") is not None:
+            return
+        p["first_schedule_at"] = time.time()
+        expired = p.get("lease_expired_at")
+        if expired is not None:
+            p["latency_s"] = p["first_schedule_at"] - expired
+            try:
+                _head_metrics()["promotion_s"].set(p["latency_s"])
+            except Exception:
+                pass
 
     # ------------------------------------------------------- persistence
     def _load_snapshot(self) -> None:
@@ -216,11 +432,22 @@ class GcsServer:
         import pickle
 
         try:
-            payload = self._snapshots.load_latest()
+            if self._preloaded_snapshot is not None:
+                # a promoting StandbyHead hands over its tailed payload:
+                # restore is a deserialize, not a store walk (warm takeover)
+                payload = self._preloaded_snapshot
+            else:
+                payload = self._snapshots.load_latest()
             if payload is None:
                 return
             data = pickle.loads(payload)
             with self._lock:
+                # the cluster session survives head changes: raylets use it
+                # as the fingerprint for one-RPC re-adoption; the persisted
+                # fence_epoch floors any later lease acquire (a torn lease
+                # record must not reset the epoch under the fleet)
+                self.session_id = data.get("session_id", self.session_id)
+                self._restored_fence_epoch = int(data.get("fence_epoch", 0))
                 self._kv = data.get("kv", {})
                 self._functions = data.get("functions", {})
                 self._function_bytes = sum(
@@ -286,8 +513,22 @@ class GcsServer:
         import pickle
 
         with self._snapshot_write_lock:  # stop() vs loop: one writer at a time
+            if self._lease is not None:
+                # fencing gate: a stale head's snapshot write is REJECTED,
+                # not raced — the standby that bumped the epoch owns the
+                # store now (split-brain prevention, proven by
+                # test_head_failover.py's revived-head test)
+                from ray_tpu.core.head_lease import LeaseLostError
+
+                try:
+                    self._lease.check(self.fence_epoch)
+                except LeaseLostError:
+                    self._reject_fenced_write("snapshot_save")
+                    raise
             with self._lock:
-                data = {"kv": {ns: dict(t) for ns, t in self._kv.items()},
+                data = {"session_id": self.session_id,
+                        "fence_epoch": self.fence_epoch,
+                        "kv": {ns: dict(t) for ns, t in self._kv.items()},
                         # function table: actor restart after a GCS restart
                         # resolves class blobs from here
                         "functions": dict(self._functions),
@@ -323,13 +564,17 @@ class GcsServer:
                                 for pid, p in self._pgs.items()}}
                 self._dirty = False
             try:
-                self._snapshots.save(pickle.dumps(data, protocol=5))
+                self._snapshot_last_version = self._snapshots.save(
+                    pickle.dumps(data, protocol=5))
+                self._snapshots_written += 1
             except Exception:
                 self._dirty = True  # failed write must be retried
                 raise
 
     def _snapshot_loop(self) -> None:
         while not self._shutdown.wait(self._snapshot_interval_s):
+            if self._fenced.is_set():
+                return  # a newer head owns the store; stop retrying writes
             if self._dirty:
                 try:
                     self._write_snapshot()
@@ -338,43 +583,23 @@ class GcsServer:
         # stop() performs the final flush (single writer, serialized above)
 
     def _readopt_loop(self) -> None:
-        """Replacement-head re-adoption: dial every snapshot-known raylet,
-        announce the new head address (the in-band 'callback' flavor of
-        re-resolution — works with no address file), and reconnect the
-        GCS->raylet dispatch clients. Then resume any placement-group
-        creation the old head died inside: with idempotent prepare_bundle
-        on the raylets, re-running the 2-phase protocol either completes
-        the PG or marks it INFEASIBLE — clients polling it never hang."""
+        """Replacement/promoted-head re-adoption: dial every snapshot-known
+        raylet with a fencing-epoch'd `promote_announce` (the in-band
+        'callback' flavor of re-resolution — works with no address file). A
+        raylet of the SAME cluster session replies with its full
+        registration payload in that ONE round trip, so it is adopted as a
+        live node immediately — no full re-registration on the failover
+        critical path (its reconnect loop still re-subscribes in the
+        background, idempotently). Then resume any placement-group creation
+        the old head died inside: with idempotent prepare_bundle on the
+        raylets, re-running the 2-phase protocol either completes the PG or
+        marks it INFEASIBLE — clients polling it never hang."""
         with self._lock:
             targets = dict(self._restored_nodes)
         for address, node_id in targets.items():
             if self._shutdown.is_set():
                 return
-            try:
-                client = rpc.connect_with_retry(address, timeout=5)
-            except Exception:
-                # raylet gone with the old head; the heartbeat timeout
-                # will reap its restored entry
-                logger.info("restored node %s at %s unreachable",
-                            node_id.hex()[:8], address)
-                continue
-            try:
-                client.notify("new_gcs_address",
-                              {"address": self._server.address})
-            except OSError:
-                client.close()
-                continue
-            with self._lock:
-                n = self._nodes.get(node_id)
-                if n is not None and n.get("restored"):
-                    old = self._raylet_clients.get(node_id)
-                    self._raylet_clients[node_id] = client
-                    self._last_heartbeat[node_id] = time.monotonic()
-                else:
-                    # re-registration beat us: keep its client, drop ours
-                    old = client
-            if old is not None:
-                old.close()
+            self._announce_to(address, node_id)
         # interrupted 2-phase creations: finish or fail them
         with self._lock:
             preparing = [pid for pid, p in self._pgs.items()
@@ -406,15 +631,120 @@ class GcsServer:
                                "replacement could not be completed: %s",
                                pid, result.get("error"))
 
+    def _announce_to(self, address: str, node_id: bytes) -> bool:
+        """Dial one snapshot-known raylet and announce this head, carrying
+        the fencing epoch + session id. Same-session raylets reply with
+        their registration payload (one-RPC re-adoption); a raylet that
+        already adopted a NEWER head rejects us — we are stale, fence.
+        Returns True when the node left the provisional set."""
+        try:
+            client = rpc.connect_with_retry(address, timeout=5)
+        except Exception:
+            # raylet gone with the old head; the heartbeat timeout will
+            # reap its restored entry
+            logger.info("restored node %s at %s unreachable",
+                        node_id.hex()[:8], address)
+            return False
+        reply = None
+        try:
+            reply = client.call("promote_announce", {
+                "address": self._server.address,
+                "epoch": self.fence_epoch,
+                "session_id": self.session_id,
+            }, timeout=5)
+        except rpc.RpcCallError:
+            # raylet predates promote_announce: legacy one-way announce
+            # (now also epoch-stamped so a stale head still gets dropped)
+            try:
+                client.notify("new_gcs_address",
+                              {"address": self._server.address,
+                               "epoch": self.fence_epoch})
+            except OSError:
+                client.close()
+                return False
+        except (OSError, TimeoutError, rpc.RpcDisconnected):
+            client.close()
+            return False
+        if isinstance(reply, dict) and reply.get("adopted"):
+            # one-RPC re-adoption: the reply IS the registration payload
+            self._adopt_node(reply, client)
+            return True
+        if isinstance(reply, dict) and reply.get("reason") == "stale_epoch":
+            client.close()
+            self._reject_fenced_write("announce")
+            return False
+        # announced (legacy or session mismatch): the raylet's kicked
+        # reconnect loop re-registers the normal way
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n is not None and n.get("restored"):
+                old = self._raylet_clients.get(node_id)
+                self._raylet_clients[node_id] = client
+                self._last_heartbeat[node_id] = time.monotonic()
+            else:
+                # re-registration beat us: keep its client, drop ours
+                old = client
+        if old is not None:
+            old.close()
+        return False
+
+    def _adopt_node(self, payload: dict, client: rpc.RpcClient) -> None:
+        """Install a node from a promote_announce reply exactly as
+        register_node would, reusing the announce connection as the
+        dispatch client — the raylet is live without a second RPC."""
+        node_id = payload["node_id"]
+        self._install_node(payload, client)
+        logger.info("re-adopted raylet %s in one RPC (session match)",
+                    node_id.hex()[:8])
+
+    _REANNOUNCE_PERIOD_S = 2.0
+
+    def _maybe_reannounce_restored(self) -> None:
+        """Health-loop backstop for the one-shot readopt pass: keep dialing
+        nodes still provisional ('restored') — a raylet unreachable during
+        promotion deserves more than one chance before the heartbeat reaper
+        takes it. Paced, off-thread, one pass at a time; every dial carries
+        the fencing epoch (satellite: no epoch-less announces anywhere)."""
+        now = time.monotonic()
+        with self._lock:
+            if getattr(self, "_reannounce_active", False):
+                return
+            last = getattr(self, "_last_reannounce", 0.0)
+            if not self._restored_nodes \
+                    or now - last < self._REANNOUNCE_PERIOD_S:
+                return
+            self._reannounce_active = True
+            self._last_reannounce = now
+            targets = dict(self._restored_nodes)
+
+        def run():
+            try:
+                for address, node_id in targets.items():
+                    if self._shutdown.is_set():
+                        return
+                    self._announce_to(address, node_id)
+            finally:
+                with self._lock:
+                    self._reannounce_active = False
+
+        threading.Thread(target=run, name="gcs-reannounce",
+                         daemon=True).start()
+
     @property
     def address(self) -> str:
         return self._server.address
 
     def stop(self) -> None:
         self._shutdown.set()
-        if self._snapshots is not None and self._dirty:
+        if self._snapshots is not None and self._dirty \
+                and not self._fenced.is_set():
+            from ray_tpu.core.head_lease import LeaseLostError
+
             try:
                 self._write_snapshot()
+            except LeaseLostError:
+                logger.warning("final snapshot flush fenced: a newer head "
+                               "owns the store")
             except OSError:
                 logger.exception("final snapshot flush failed")
         for c in self._raylet_clients.values():
@@ -444,6 +774,10 @@ class GcsServer:
             if conn not in subs:
                 subs.append(conn)
                 conn.on_close.append(lambda c, ch=ch: self._unsub(ch, c))
+        if CH_RESOURCES in channels:
+            # a fresh subscriber has no base view to apply deltas onto
+            with self._lock:
+                self._bcast_full_needed = True
         return True
 
     def rpc_publish(self, conn, req_id, payload):
@@ -496,6 +830,20 @@ class GcsServer:
 
     # ----------------------------------------------------------------- nodes
     def rpc_register_node(self, conn, req_id, payload):
+        self._install_node(payload)
+        with self._lock:
+            nodes = [self._public_node(n) for n in self._nodes]
+        # epoch + session ride the reply: the raylet uses the epoch to fence
+        # stale-head announces and the session id as its re-adoption
+        # fingerprint across head promotions
+        return {"nodes": nodes, "epoch": self.fence_epoch,
+                "session_id": self.session_id}
+
+    def _install_node(self, payload: dict,
+                      client: Optional[rpc.RpcClient] = None) -> None:
+        """Shared node-installation path for register_node and the
+        promote_announce one-RPC re-adoption (which passes the announce
+        connection as the dispatch `client`)."""
         node_id: bytes = payload["node_id"]
         with self._lock:
             stale = self._raylet_clients.pop(node_id, None)
@@ -514,11 +862,17 @@ class GcsServer:
             self._restored_nodes.pop(payload["address"], None)
             self._last_heartbeat[node_id] = time.monotonic()
             self._dirty = True  # membership is snapshot state
-            try:
-                self._raylet_clients[node_id] = rpc.connect_with_retry(payload["address"], timeout=10)
-            except Exception:
-                logger.exception("GCS could not connect back to raylet %s", payload["address"])
-        if stale is not None:
+            self._bcast_dirty.add(node_id.hex())
+            self._bcast_removed.discard(node_id.hex())
+            self._bcast_full_needed = True  # topology: next publish is full
+            if client is not None:
+                self._raylet_clients[node_id] = client
+            else:
+                try:
+                    self._raylet_clients[node_id] = rpc.connect_with_retry(payload["address"], timeout=10)
+                except Exception:
+                    logger.exception("GCS could not connect back to raylet %s", payload["address"])
+        if stale is not None and stale is not client:
             stale.close()
         # Bundle re-pinning: the raylet reports the PG bundle reservations
         # it still holds. A head replacement may have restored a snapshot
@@ -539,7 +893,6 @@ class GcsServer:
                     self._dirty = True
         self._publish(CH_NODES, {"event": "added", "node": self._public_node(node_id)})
         self._broadcast_resources(force=True)
-        return {"nodes": [self._public_node(n) for n in self._nodes]}
 
     def _public_node(self, node_id: bytes) -> dict:
         n = self._nodes[node_id]
@@ -556,6 +909,8 @@ class GcsServer:
             self._last_heartbeat[node_id] = time.monotonic()
             n = self._nodes.get(node_id)
             if n is not None and "resources_available" in payload:
+                if n["resources_available"] != payload["resources_available"]:
+                    self._bcast_dirty.add(node_id.hex())
                 n["resources_available"] = payload["resources_available"]
             if n is not None:
                 n["pending_demands"] = payload.get("pending_demands", [])
@@ -588,6 +943,7 @@ class GcsServer:
             n = self._nodes.get(node_id)
             if n is not None:
                 n["resources_available"] = payload["available"]
+                self._bcast_dirty.add(node_id.hex())
         self._broadcast_resources()
         return True
 
@@ -600,19 +956,79 @@ class GcsServer:
         pass force=True — membership must never wait out a debounce."""
         self._bcast_debounce(force=force)
 
+    def _publish_resources(self) -> None:
+        """One CH_RESOURCES publish: a per-node DELTA of the views that
+        changed since the last publish (so steady-state gossip is O(changed
+        nodes), not O(nodes) payload x O(nodes) subscribers — the former
+        full-snapshot fan-out was O(nodes²) bytes at fleet scale), or a
+        FULL snapshot on topology change / new subscriber / first publish.
+        Every message carries a sequence number (raylets detect gaps and
+        catch up via get_resources_full) and the fencing epoch (a stale
+        head's publishes are ignored)."""
+        import pickle as _pickle
+
+        with self._lock:
+            subs = len(self._subs.get(CH_RESOURCES, ()))
+            self._bcast_seq += 1
+            seq = self._bcast_seq
+            full = (self._bcast_full_needed
+                    or not get_config().resource_broadcast_delta_enabled)
+            if full:
+                msg = {"kind": "full", "seq": seq, "epoch": self.fence_epoch,
+                       "nodes": self._cluster_view_locked()}
+                self._bcast_fulls += 1
+                self._bcast_full_needed = False
+            else:
+                changed = {}
+                for hexid in self._bcast_dirty:
+                    try:
+                        n = self._nodes.get(bytes.fromhex(hexid))
+                    except ValueError:
+                        continue
+                    if n is not None and n["alive"]:
+                        changed[hexid] = self._node_view(n)
+                msg = {"kind": "delta", "seq": seq, "prev": seq - 1,
+                       "epoch": self.fence_epoch, "changed": changed,
+                       "removed": sorted(self._bcast_removed)}
+                self._bcast_deltas += 1
+            self._bcast_dirty.clear()
+            self._bcast_removed.clear()
+        # accounting (bytes that hit subscriber sockets) rides the same
+        # pickle the rpc layer would produce; one dumps per debounce period
+        try:
+            self._bcast_bytes += len(_pickle.dumps(msg, protocol=5)) \
+                * max(1, subs)
+        except Exception:
+            pass
+        self._publish(CH_RESOURCES, msg)
+
+    def rpc_get_resources_full(self, conn, req_id, payload):
+        """Subscriber catch-up: a raylet that missed a delta (gap in the
+        sequence) pulls one consistent full view + the seq it is current
+        as of, then resumes applying deltas from there."""
+        with self._lock:
+            return {"kind": "full", "seq": self._bcast_seq,
+                    "epoch": self.fence_epoch,
+                    "nodes": self._cluster_view_locked()}
+
+    @staticmethod
+    def _node_view(n: dict) -> dict:
+        return {
+            "address": n["address"],
+            "object_store_address": n["object_store_address"],
+            "total": dict(n["resources_total"]),
+            "available": dict(n["resources_available"]),
+            "labels": dict(n["labels"]),
+            "alive": n["alive"],
+        }
+
+    def _cluster_view_locked(self) -> dict:
+        return {nid.hex(): self._node_view(n)
+                for nid, n in self._nodes.items()}
+
     def cluster_view(self) -> dict:
         with self._lock:
-            return {
-                nid.hex(): {
-                    "address": n["address"],
-                    "object_store_address": n["object_store_address"],
-                    "total": dict(n["resources_total"]),
-                    "available": dict(n["resources_available"]),
-                    "labels": dict(n["labels"]),
-                    "alive": n["alive"],
-                }
-                for nid, n in self._nodes.items()
-            }
+            return self._cluster_view_locked()
 
     def rpc_get_cluster_view(self, conn, req_id, payload):
         return self.cluster_view()
@@ -663,6 +1079,9 @@ class GcsServer:
             # failure, capacity that has since arrived): re-run their 2PC
             # off-thread, paced, so a blip never strands a group forever.
             self._maybe_retry_pending_pgs()
+            # still-provisional snapshot-restored nodes get re-dialed (with
+            # the fencing epoch) until they adopt us or the reaper wins
+            self._maybe_reannounce_restored()
 
     _PG_RETRY_INTERVAL_S = 5.0
 
@@ -747,6 +1166,9 @@ class GcsServer:
             n["alive"] = False
             self._restored_nodes.pop(n.get("address"), None)
             self._dirty = True  # membership is snapshot state
+            self._bcast_removed.add(node_id.hex())
+            self._bcast_dirty.discard(node_id.hex())
+            self._bcast_full_needed = True  # topology: next publish is full
             client = self._raylet_clients.pop(node_id, None)
         if client:
             client.close()
@@ -834,6 +1256,38 @@ class GcsServer:
                     "bytes": self._function_bytes,
                     "puts": self._function_puts,
                     "evictions": self._function_evictions}
+
+    # ------------------------------------------------------------ head stats
+    def rpc_gcs_stats(self, conn, req_id, payload):
+        """Control-plane observability in one call: lease/fencing state,
+        snapshot counters, broadcast (full vs delta) accounting, and the
+        last promotion record — the numbers the HA metrics export
+        (`ray_tpu_head_failovers_total`, `ray_tpu_head_promotion_seconds`,
+        `ray_tpu_fencing_rejections_total`) are derived from."""
+        with self._lock:
+            alive = sum(1 for n in self._nodes.values() if n["alive"])
+            provisional = sum(1 for n in self._nodes.values()
+                              if n["alive"] and n.get("restored"))
+            bcast = {"seq": self._bcast_seq, "fulls": self._bcast_fulls,
+                     "deltas": self._bcast_deltas,
+                     "bytes_sent": self._bcast_bytes,
+                     "delta_enabled":
+                         get_config().resource_broadcast_delta_enabled}
+        return {
+            "address": self._server.address,
+            "session_id": self.session_id,
+            "fence_epoch": self.fence_epoch,
+            "fenced": self._fenced.is_set(),
+            "lease_ttl_s": self._lease.ttl_s if self._lease else None,
+            "nodes_alive": alive,
+            "nodes_provisional": provisional,
+            "snapshots": {"written": self._snapshots_written,
+                          "last_version": self._snapshot_last_version,
+                          "uri": self._snapshot_uri},
+            "fencing_rejections": self._fencing_rejections,
+            "broadcast": bcast,
+            "promotion": dict(self.promotion) if self.promotion else None,
+        }
 
     # ---------------------------------------------------------------- jobs
     def rpc_register_job(self, conn, req_id, payload):
@@ -1046,6 +1500,7 @@ class GcsServer:
         except Exception:
             logger.exception("failed to dispatch actor creation to %s", target.hex()[:8])
             return False
+        self._note_first_schedule()
         return True
 
     def rpc_actor_creation_done(self, conn, req_id, payload):
@@ -1356,3 +1811,199 @@ class GcsServer:
                  "placement": p.get("placement")}
                 for pid, p in self._pgs.items()
             ]
+
+
+class StandbyHead:
+    """Warm standby GCS (ROADMAP item 5; Ray 2.x GCS fault-tolerance
+    design): tails the `VersionedSnapshots` stream so its in-memory copy of
+    the control-plane state is always ≤1 snapshot behind, watches the head
+    lease, and when the lease EXPIRES (crash) or is RELINQUISHED (rolling
+    upgrade, `GcsServer.drain_lease`) takes over via the lease-epoch CAS:
+
+        acquire(expect_epoch=<the epoch we saw expire>) -> epoch+1
+
+    Promotion then boots a `GcsServer` pre-seeded with the tailed payload
+    (restore = one deserialize, no store walk) whose readopt pass dials the
+    snapshot-known raylets with `promote_announce` — same-session raylets
+    re-adopt in that one RPC, giving sub-second failover. The OLD head, if
+    it revives, is fenced: its epoch trails the store's, so its snapshot
+    saves raise and its announces are dropped.
+
+    Run standalone with `ray_tpu start --standby --snapshot-uri ...`.
+    """
+
+    def __init__(self, snapshot_uri: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        from ray_tpu.core.head_lease import HeadLease, new_owner_token
+        from ray_tpu.core.snapshot_store import (VersionedSnapshots,
+                                                 store_from_uri)
+
+        self._uri = snapshot_uri
+        self._host = host
+        self._port = port
+        store = store_from_uri(snapshot_uri)
+        self._snaps = VersionedSnapshots(
+            store, prefix="gcs", keep=get_config().gcs_snapshot_keep)
+        self._lease = HeadLease(store)
+        self._owner = new_owner_token()
+        self._tailed: Optional[bytes] = None
+        self._tailed_version = 0
+        self._tailed_epoch = 0  # fence_epoch persisted in the tailed payload
+        self._seen_epoch = 0
+        self._stop_evt = threading.Event()
+        self._promoted_evt = threading.Event()
+        self._promoted: Optional[GcsServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> "StandbyHead":
+        self._thread = threading.Thread(target=self._run, name="gcs-standby",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop tailing. Does NOT stop a promoted GcsServer — once promoted
+        it is the cluster's head and owns its own lifecycle."""
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    @property
+    def promoted(self) -> Optional[GcsServer]:
+        return self._promoted
+
+    def wait_promoted(self, timeout: Optional[float] = None
+                      ) -> Optional[GcsServer]:
+        self._promoted_evt.wait(timeout)
+        return self._promoted
+
+    def stats(self) -> dict:
+        return {"tailed_version": self._tailed_version,
+                "seen_epoch": self._seen_epoch,
+                "promoted": self._promoted is not None,
+                "snapshot_uri": self._uri}
+
+    # ------------------------------------------------------------- tail loop
+    def _run(self) -> None:
+        from ray_tpu.core.head_lease import LeaseHeldError, LeaseLostError
+
+        cfg = get_config()
+        poll = cfg.head_standby_poll_s or max(
+            0.05, cfg.head_lease_ttl_s / 4.0)
+        while not self._stop_evt.wait(poll):
+            try:
+                self._tail_once()
+            except Exception:
+                logger.exception("standby snapshot tail failed")
+            try:
+                rec = self._lease.read()
+            except Exception:
+                logger.exception("standby lease read failed")
+                continue
+            if rec is None:
+                # no head has ever claimed the lease; without a snapshot
+                # there is nothing to take over — stay standby
+                continue
+            self._seen_epoch = max(self._seen_epoch, int(rec.get("epoch", 0)))
+            if rec.get("expires_at", 0.0) > time.time():
+                continue
+            # expired/relinquished: claim it. expect_epoch pins the CAS to
+            # the epoch we SAW expire — a head that renewed (or another
+            # standby that won) in the window refuses us — and the floor
+            # (highest epoch seen on the lease OR in the snapshot stream)
+            # stops a torn lease record from resetting the epoch under the
+            # fleet.
+            try:
+                epoch = self._lease.acquire(
+                    self._owner, expect_epoch=rec["epoch"],
+                    floor=max(self._seen_epoch, self._tailed_epoch) + 1)
+            except (LeaseHeldError, LeaseLostError) as e:
+                logger.info("standby promotion attempt refused: %s", e)
+                continue
+            try:
+                self._promote(epoch, old_lease=rec)
+                return
+            except Exception:
+                # a failed boot (port taken, store error) with the epoch
+                # already claimed would otherwise leave the cluster
+                # HEADLESS: hand the lease back (expire-now at our epoch)
+                # so another standby — or this loop's next pass — can claim
+                # epoch+1, and keep tailing.
+                logger.exception("promotion to epoch %d failed; "
+                                 "relinquishing the lease and retrying",
+                                 epoch)
+                try:
+                    self._lease.relinquish(self._owner, epoch)
+                except Exception:
+                    logger.exception("post-failure lease relinquish failed")
+                self._seen_epoch = max(self._seen_epoch, epoch)
+
+    def _tail_once(self) -> None:
+        newest = self._snaps.latest_version()
+        if newest <= self._tailed_version:
+            return
+        payload, version = self._snaps.load_latest_with_version()
+        if payload is not None:
+            self._tailed = payload
+            self._tailed_version = version
+            try:
+                import pickle
+
+                self._tailed_epoch = int(
+                    pickle.loads(payload).get("fence_epoch", 0))
+            except Exception:
+                logger.debug("tailed snapshot carries no readable "
+                             "fence_epoch", exc_info=True)
+
+    def _promote(self, epoch: int, old_lease: dict) -> None:
+        lease_expired_at = old_lease.get("expires_at")
+        logger.warning("standby promoting to active head: epoch %d "
+                       "(tailed snapshot v%d)", epoch, self._tailed_version)
+        # one last tail: the dead head's final flush may have landed after
+        # our previous poll
+        try:
+            self._tail_once()
+        except Exception:
+            logger.exception("pre-promotion tail failed; promoting from v%d",
+                             self._tailed_version)
+        gcs = GcsServer(
+            host=self._host, port=self._port, snapshot_uri=self._uri,
+            preloaded_snapshot=self._tailed,
+            lease_grant={"owner": self._owner, "epoch": epoch,
+                         "lease_expired_at": lease_expired_at,
+                         "tailed_version": self._tailed_version})
+        gcs.start()
+        try:
+            _head_metrics()["failovers"].inc()
+        except Exception:
+            pass
+        self._fence_predecessor(old_lease, gcs)
+        self._promoted = gcs
+        self._promoted_evt.set()
+
+    def _fence_predecessor(self, old_lease: dict, gcs: GcsServer) -> None:
+        """Best-effort direct fence of a still-RUNNING predecessor (lease
+        starved, process alive): dial the address its lease record carried
+        and tell it the epoch moved on. Without this it self-fences on its
+        next lease read anyway — this just collapses the stale-serving
+        window to one RPC."""
+        address = old_lease.get("address")
+        if not address or address == gcs.address:
+            return
+
+        def run():
+            try:
+                client = rpc.connect_with_retry(address, timeout=2)
+                try:
+                    client.call("head_fenced",
+                                {"epoch": gcs.fence_epoch,
+                                 "address": gcs.address}, timeout=3)
+                finally:
+                    client.close()
+            except Exception:
+                logger.info("predecessor head at %s unreachable for direct "
+                            "fence (already dead?)", address)
+
+        threading.Thread(target=run, name="gcs-fence-predecessor",
+                         daemon=True).start()

@@ -34,6 +34,13 @@ def main(argv=None) -> None:
                              "(file:///dir or memory://name, head only): a "
                              "replacement head restores node/actor/PG/KV "
                              "state from it, even on a new address")
+    parser.add_argument("--standby", action="store_true",
+                        help="run a warm STANDBY head: tail the snapshot "
+                             "store (--snapshot-uri required), and take "
+                             "over via the lease/fencing-epoch CAS when "
+                             "the active head's lease expires or is "
+                             "relinquished (sub-second promotion; "
+                             "RAY_TPU_HEAD_LEASE_TTL_S tunes the TTL)")
     parser.add_argument("--gcs-port", type=int, default=0,
                         help="fixed GCS port (head only; cluster-launcher "
                              "startup scripts need a known join address)")
@@ -51,9 +58,41 @@ def main(argv=None) -> None:
         resources["TPU"] = args.num_tpus
     labels = json.loads(args.labels)
 
-    from ray_tpu.core.gcs import GcsServer
+    from ray_tpu.core.gcs import GcsServer, StandbyHead
     from ray_tpu.core.node import default_node_resources, detect_tpu_labels
     from ray_tpu.core.raylet import Raylet
+
+    stop = {"flag": False}
+
+    def handle(sig, frame):
+        stop["flag"] = True
+
+    if args.standby:
+        # Standby head process: no raylet, no registrations — just the
+        # snapshot tail + lease watch. On promotion it IS the head (its
+        # promote_announce re-adopts the fleet); it serves until signaled.
+        if not args.snapshot_uri:
+            parser.error("--standby requires --snapshot-uri")
+        standby = StandbyHead(args.snapshot_uri, host=args.gcs_host,
+                              port=args.gcs_port)
+        standby.start()
+        print(f"ray_tpu STANDBY head tailing {args.snapshot_uri} "
+              f"(promotes when the active head's lease lapses)")
+        signal.signal(signal.SIGINT, handle)
+        signal.signal(signal.SIGTERM, handle)
+        announced = False
+        while not stop["flag"]:
+            time.sleep(0.2)
+            promoted = standby.promoted
+            if promoted is not None and not announced:
+                announced = True
+                print(f"standby PROMOTED to active head. "
+                      f"GCS address: {promoted.address} "
+                      f"(epoch {promoted.fence_epoch})")
+        standby.stop()
+        if standby.promoted is not None:
+            standby.promoted.stop()
+        return
 
     labels = {**detect_tpu_labels(), **labels}
     gcs_address = args.address
@@ -62,6 +101,9 @@ def main(argv=None) -> None:
         gcs = GcsServer(snapshot_path=args.snapshot_path,
                         snapshot_uri=args.snapshot_uri,
                         port=args.gcs_port, host=args.gcs_host)
+        # rolling upgrade: when a promoted standby fences this head, exit
+        # cleanly instead of serving a dead epoch
+        gcs.on_fenced = lambda: stop.__setitem__("flag", True)
         gcs_address = gcs.start()
         print(f"ray_tpu head started. GCS address: {gcs_address}")
         print(f"Connect with: ray_tpu.init(address=\"{gcs_address}\")")
@@ -78,11 +120,6 @@ def main(argv=None) -> None:
     raylet.start()
     print(f"raylet started on node {raylet.node_id.hex()[:12]} "
           f"({raylet.address})")
-
-    stop = {"flag": False}
-
-    def handle(sig, frame):
-        stop["flag"] = True
 
     signal.signal(signal.SIGINT, handle)
     signal.signal(signal.SIGTERM, handle)

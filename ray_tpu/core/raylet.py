@@ -177,6 +177,16 @@ class Raylet:
         # boot-time address; the address file (config gcs_address_file)
         # overrides both. Read on every reconnect attempt.
         self._gcs_address_override: Optional[str] = None
+        # fencing: the highest head lease epoch this raylet has adopted.
+        # Announces/publishes from a STALE head (epoch below this) are
+        # logged and dropped — a fenced head cannot flap our GCS link.
+        self._gcs_epoch: int = 0
+        self._session_id: Optional[str] = None  # cluster session fingerprint
+        self._fencing_drops = 0
+        # delta-encoded resource broadcasts: last applied publish seq (None
+        # until the first full lands) + one catch-up fetch at a time
+        self._bcast_seen_seq: Optional[int] = None
+        self._catchup_inflight = False
 
         # object pulls in flight: object_id -> list[(conn, req_id)] waiting
         self._pending_pulls: Dict[ObjectID, List[Tuple]] = {}
@@ -211,6 +221,7 @@ class Raylet:
             on_reconnect=self._replay_gcs_registration,
             resolve=self._resolve_gcs_address)
         reply = self._gcs.call("register_node", self._registration_payload())
+        self._note_head_identity(reply)
         for n in reply["nodes"]:
             self._note_node(n)
         self._gcs.call("subscribe", {"channels": ["resources", "nodes", "control"]})
@@ -258,8 +269,21 @@ class Raylet:
     def _resolve_gcs_address(self) -> Optional[str]:
         """Current-best GCS address for a reconnect attempt: the address
         file (authoritative — operators/replacement heads publish there)
-        beats the in-band announce, which beats the boot-time address."""
+        beats the in-band announce, which beats the boot-time address.
+        An empty/unreadable address file reads as "no answer" (keep the
+        last-known address and retry), never as an address."""
         return rpc.read_gcs_address_file() or self._gcs_address_override
+
+    def _note_head_identity(self, reply: dict) -> None:
+        """Record the head's fencing epoch + cluster session id from a
+        registration reply (the fingerprint promote_announce checks)."""
+        epoch = reply.get("epoch")
+        if epoch is not None:
+            with self._lock:
+                self._gcs_epoch = max(self._gcs_epoch, int(epoch))
+        sid = reply.get("session_id")
+        if sid:
+            self._session_id = sid
 
     def _replay_gcs_registration(self, raw: rpc.RpcClient) -> None:
         """Re-register on a fresh GCS connection (uses the RAW client — the
@@ -268,39 +292,101 @@ class Raylet:
         # the link may have followed a head replacement: workers spawned
         # from now on (and rpc_get_gcs_address callers) get the live head
         self.gcs_address = raw.address
+        self._note_head_identity(reply)
         for n in reply.get("nodes", []):
             self._note_node(n)
+        with self._lock:
+            self._bcast_seen_seq = None  # new head: wait for its first full
         raw.call("subscribe", {"channels": ["resources", "nodes", "control"]},
                  timeout=30)
-        logger.info("raylet %s re-registered with GCS at %s",
-                    self.node_id.hex()[:8], raw.address)
+        logger.info("raylet %s re-registered with GCS at %s (epoch %s)",
+                    self.node_id.hex()[:8], raw.address,
+                    reply.get("epoch"))
+
+    def _stale_announce(self, payload: dict, rpc_name: str) -> bool:
+        """Fencing gate for head announces: an epoch below the one this
+        raylet already adopted means a FENCED head is calling — log and
+        drop (no GCS-client flap), count the rejection."""
+        epoch = payload.get("epoch")
+        if epoch is None:
+            return False  # legacy announce: can't judge, accept
+        with self._lock:
+            if int(epoch) >= self._gcs_epoch:
+                return False
+            self._fencing_drops += 1
+            known = self._gcs_epoch
+        logger.warning(
+            "raylet %s: dropped %s from STALE head %s (epoch %s < adopted "
+            "%d)", self.node_id.hex()[:8], rpc_name,
+            payload.get("address"), epoch, known)
+        try:
+            from ray_tpu.core.gcs import _head_metrics  # shared definition
+
+            _head_metrics()["fencing"].inc(tags={"site": "raylet_announce"})
+        except Exception:
+            pass
+        return True
+
+    def _adopt_announce(self, payload: dict) -> None:
+        """Record the announced head (address + epoch) and kick the
+        reconnect loop off-thread (announce handlers run on the RPC loop;
+        closing the client there would self-deadlock). A re-announce of the
+        head we already have a live link to is a no-op — the paced
+        re-announce backstop must not flap a healthy link."""
+        address = payload["address"]
+        with self._lock:
+            self._gcs_epoch = max(self._gcs_epoch,
+                                  int(payload.get("epoch", 0)))
+        if address == self.gcs_address and self._gcs is not None \
+                and not self._gcs.closed:
+            cli = getattr(self._gcs, "_client", None)
+            if cli is not None and not cli.closed:
+                return  # already on this head over a live link
+        with self._lock:
+            self._bcast_seen_seq = None  # new head numbers its own stream
+        self._gcs_address_override = address
+        threading.Thread(target=self._kick_gcs_reconnect,
+                         name="gcs-address-kick", daemon=True).start()
+
+    def _kick_gcs_reconnect(self) -> None:
+        gcs = self._gcs
+        if gcs is None or gcs.closed:
+            return
+        cli = getattr(gcs, "_client", None)
+        if cli is not None and not cli.closed:
+            cli.close()  # on_disconnect schedules the reconnect
 
     def rpc_new_gcs_address(self, conn, req_id, payload):
         """In-band head-replacement announce: a replacement GCS restored
         this node from its snapshot and is telling us where it lives now.
         Records the override and kicks the reconnect loop by dropping the
-        stale link (off-thread — this runs on the RPC loop)."""
-        address = payload["address"]
-        if address == self.gcs_address and self._gcs is not None \
-                and not self._gcs.closed:
-            cli = getattr(self._gcs, "_client", None)
-            if cli is not None and not cli.closed:
-                return True  # same head, live link: nothing to do
-        self._gcs_address_override = address
+        stale link. Epoch-fenced: a revived stale head's announce is
+        dropped instead of flapping our link to the real head."""
+        if self._stale_announce(payload, "new_gcs_address"):
+            return False
         logger.info("raylet %s: GCS announced new address %s",
-                    self.node_id.hex()[:8], address)
-
-        def kick():
-            gcs = self._gcs
-            if gcs is None or gcs.closed:
-                return
-            cli = getattr(gcs, "_client", None)
-            if cli is not None and not cli.closed:
-                cli.close()  # on_disconnect schedules the reconnect
-
-        threading.Thread(target=kick, name="gcs-address-kick",
-                         daemon=True).start()
+                    self.node_id.hex()[:8], payload["address"])
+        self._adopt_announce(payload)
         return True
+
+    def rpc_promote_announce(self, conn, req_id, payload):
+        """Promoted-head announce with one-RPC re-adoption: epoch-fenced
+        like new_gcs_address, and when the caller presents OUR cluster
+        session id the reply carries this node's full registration payload
+        — the new head adopts us from its snapshot-known provisional entry
+        to a live node in this single round trip (no re-registration on
+        the failover critical path). The background reconnect still runs
+        (idempotently) to re-establish subscriptions/pushes."""
+        if self._stale_announce(payload, "promote_announce"):
+            return {"adopted": False, "reason": "stale_epoch"}
+        logger.info("raylet %s: head promotion announced from %s (epoch %s)",
+                    self.node_id.hex()[:8], payload.get("address"),
+                    payload.get("epoch"))
+        self._adopt_announce(payload)
+        sid = payload.get("session_id")
+        if not sid or sid != self._session_id:
+            return {"adopted": False, "reason": "session_mismatch"}
+        return {"adopted": True, **self._registration_payload()}
 
     def rpc_get_gcs_address(self, conn, req_id, payload):
         """Workers/drivers re-resolve the head through their raylet: the
@@ -356,11 +442,7 @@ class Raylet:
             return
         ch, msg = payload["channel"], payload["message"]
         if ch == "resources":
-            with self._lock:
-                for hexid, v in msg.items():
-                    if hexid == self.node_id.hex():
-                        continue
-                    self._cluster_view[hexid] = v
+            self._apply_resource_broadcast(msg)
             self._schedule()
         elif ch == "nodes":
             if msg.get("event") == "removed":
@@ -377,6 +459,73 @@ class Raylet:
                 for w in workers:
                     if w.conn.alive:
                         w.conn.push("global_gc", {})
+
+    def _apply_resource_broadcast(self, msg) -> None:
+        """Apply one CH_RESOURCES publish. Three wire shapes: the legacy
+        full-view dict, {"kind": "full"} (replace wholesale, reset the
+        sequence), and {"kind": "delta"} (apply changed/removed on top of
+        the view IF our last-applied seq is the delta's base — otherwise a
+        gap: ignore it and pull one consistent full via get_resources_full).
+        Epoch-stamped publishes from a head staler than the one we adopted
+        are dropped."""
+        if not isinstance(msg, dict) or "kind" not in msg:
+            # legacy full-view dict (pre-delta heads)
+            with self._lock:
+                for hexid, v in msg.items():
+                    if hexid == self.node_id.hex():
+                        continue
+                    self._cluster_view[hexid] = v
+            return
+        me = self.node_id.hex()
+        need_catchup = False
+        with self._lock:
+            epoch = int(msg.get("epoch", 0))
+            if epoch and epoch < self._gcs_epoch:
+                self._fencing_drops += 1
+                return  # stale head still publishing into a dead channel
+            if msg["kind"] == "full":
+                self._cluster_view = {h: v for h, v in msg["nodes"].items()
+                                      if h != me}
+                self._bcast_seen_seq = msg["seq"]
+            elif self._bcast_seen_seq is not None \
+                    and msg.get("prev") == self._bcast_seen_seq:
+                for h, v in msg.get("changed", {}).items():
+                    if h != me:
+                        self._cluster_view[h] = v
+                for h in msg.get("removed", ()):
+                    self._cluster_view.pop(h, None)
+                self._bcast_seen_seq = msg["seq"]
+            else:
+                # gap (missed publish / fresh subscription): one catch-up
+                # fetch at a time; deltas keep arriving and are ignored
+                # until the full view re-anchors the sequence
+                if not self._catchup_inflight:
+                    self._catchup_inflight = True
+                    need_catchup = True
+        if need_catchup:
+            threading.Thread(target=self._broadcast_catchup,
+                             name="bcast-catchup", daemon=True).start()
+
+    def _broadcast_catchup(self) -> None:
+        """Pull one consistent full resource view (we run OFF the push
+        reader thread: a blocking call there would deadlock the reply)."""
+        try:
+            full = self._gcs.call("get_resources_full", {}, timeout=10)
+        except Exception:
+            logger.debug("broadcast catch-up fetch failed; next delta gap "
+                         "will retry", exc_info=True)
+            full = None
+        me = self.node_id.hex()
+        with self._lock:
+            self._catchup_inflight = False
+            if not isinstance(full, dict):
+                return
+            self._cluster_view = {h: v for h, v in full["nodes"].items()
+                                  if h != me}
+            self._bcast_seen_seq = full["seq"]
+            self._gcs_epoch = max(self._gcs_epoch,
+                                  int(full.get("epoch", 0)))
+        self._schedule()
 
     def _note_node(self, n: dict) -> None:
         hexid = n["node_id"].hex()

@@ -51,6 +51,15 @@ REQ, REP, ERR, PUSH = 0, 1, 2, 3
 # single-threaded call sequence replays exactly under the same seed.
 # Prob-1.0 rules (drop/sever_once/delay without prob) are deterministic
 # regardless of threading.
+#
+# Named socket-less points (fault_point below) for boundaries that are not
+# a single RPC send:
+#   serve_replica_call   router -> replica submission (serve failover)
+#   lease_renew          active head's lease-renewal WRITE (head_lease.py):
+#                        drop it and the lease expires under a healthy head
+#                        — the deterministic trigger for standby promotion
+# promote_announce needs no fault_point: it is a real client RPC, so
+# drop/sever rules hit its send boundary by method name.
 
 
 class _FaultRule:
@@ -142,7 +151,11 @@ class FaultInjector:
 def read_gcs_address_file() -> Optional[str]:
     """The published GCS address from config `gcs_address_file`, or None
     when unset/unreadable/empty — the shared first hop of every
-    control-plane re-resolution chain (raylet, worker, driver)."""
+    control-plane re-resolution chain (raylet, worker, driver). The writer
+    (GcsServer._write_address_file) swaps atomically through an fsynced
+    tmp file, and an empty/whitespace read here means "no answer yet —
+    retry with the last-known address", never "connect to ''": together
+    they make a reader racing a mid-failover writer safe."""
     from ray_tpu.core.config import get_config
 
     path = get_config().gcs_address_file

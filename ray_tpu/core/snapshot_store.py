@@ -208,14 +208,27 @@ class VersionedSnapshots:
         return seq
 
     def load_latest(self) -> Optional[bytes]:
+        payload, _ = self.load_latest_with_version()
+        return payload
+
+    def load_latest_with_version(self) -> tuple[Optional[bytes], int]:
+        """Newest decodable payload AND its version number — the standby
+        head's tail loop keys its freshness ("≤1 snapshot behind") on the
+        version. (None, 0) when no usable snapshot exists."""
         for seq in reversed(self._versions()):
             key = f"{self.prefix}-{seq:016d}"
             blob = self.store.get(key)
             if blob is None:
                 continue
             try:
-                return decode_blob(blob)
+                return decode_blob(blob), seq
             except SnapshotCorruptError as e:
                 logger.warning("snapshot %s unusable (%s); trying the "
                                "previous version", key, e)
-        return None
+        return None, 0
+
+    def latest_version(self) -> int:
+        """Newest version number present (0 when empty) — a cheap list, no
+        blob fetch; the standby polls this before pulling the payload."""
+        versions = self._versions()
+        return versions[-1] if versions else 0

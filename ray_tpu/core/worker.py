@@ -2086,15 +2086,18 @@ class CoreWorker:
     def _resolve_gcs_address(self) -> Optional[str]:
         """Current-best GCS address for a reconnect attempt (control-plane
         HA): the address file when configured, else ask our raylet — its
-        own reconnect loop follows a replacement head, so its answer is
-        the freshest in-band source. None = keep the last-known address."""
+        own reconnect loop follows a promoted/replacement head, so its
+        answer is the freshest in-band source. None = keep the last-known
+        address and retry; an EMPTY answer (torn address file mid-failover,
+        a raylet with nothing better than our own guess) is never treated
+        as an address to dial."""
         addr = rpc.read_gcs_address_file()
         if addr:
             return addr
         raylet = getattr(self, "raylet", None)
         if raylet is not None and not raylet.closed:
             try:
-                return raylet.call("get_gcs_address", {}, timeout=2)
+                return raylet.call("get_gcs_address", {}, timeout=2) or None
             except Exception:
                 pass
         return None

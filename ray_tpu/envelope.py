@@ -122,6 +122,64 @@ def bench_placement_groups(n_pgs: int = 30) -> Dict:
     return {"n_pgs": n_pgs, "create_per_s": round(n_pgs / t_up, 1)}
 
 
+def bench_broadcast_1k(n_nodes: int = 1000, n_changed: int = 1) -> Dict:
+    """Control-plane gossip + scheduler cost at fleet scale (simulated 1k
+    raylets — ROADMAP item 5's "measured, not assumed"). Every raylet
+    subscribes to CH_RESOURCES, so a FULL-view publish costs
+    O(nodes) payload x O(nodes) subscribers = O(nodes²) bytes per tick;
+    the delta encoding ships only the changed entries. Both wire shapes
+    are sized with the exact pickle the rpc layer sends, and one
+    SchedulingPolicy pass over the full fleet view is timed — the per-
+    broadcast work each raylet's _schedule() pays."""
+    import pickle
+
+    from ray_tpu.core.scheduler import NodeView, SchedulingPolicy
+
+    nodes = {}
+    for i in range(n_nodes):
+        nid = i.to_bytes(16, "big")
+        nodes[nid.hex()] = {
+            "address": f"10.{i >> 16 & 255}.{i >> 8 & 255}.{i & 255}:6379",
+            "object_store_address":
+                f"10.{i >> 16 & 255}.{i >> 8 & 255}.{i & 255}:6380",
+            "total": {"CPU": 96.0, "TPU": 4.0, "memory": 4.0 * 1024**3},
+            "available": {"CPU": 42.0, "TPU": 2.0, "memory": 2.0 * 1024**3},
+            "labels": {"tpu_slice": f"s{i % 64}"},
+            "alive": True,
+        }
+    hexids = list(nodes)
+    full_msg = {"kind": "full", "seq": 1, "epoch": 1, "nodes": nodes}
+    delta_msg = {"kind": "delta", "seq": 2, "prev": 1, "epoch": 1,
+                 "changed": {h: nodes[h] for h in hexids[:n_changed]},
+                 "removed": []}
+    full_bytes = len(pickle.dumps(full_msg, protocol=5))
+    delta_bytes = len(pickle.dumps(delta_msg, protocol=5))
+
+    views = [NodeView(bytes.fromhex(h), v["total"], v["available"],
+                      v["labels"]) for h, v in nodes.items()]
+    policy = SchedulingPolicy()
+    policy.select_node(views, {"CPU": 1.0})  # warm native sync/caches
+    iters = 50
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        policy.select_node(views, {"CPU": 1.0})
+    select_us = (time.perf_counter() - t0) / iters * 1e6
+
+    rate_hz = 10.0  # the debounce ceiling (resource_broadcast_period_ms)
+    return {
+        "n_nodes": n_nodes,
+        "n_changed": n_changed,
+        "full_publish_bytes": full_bytes,
+        "delta_publish_bytes": delta_bytes,
+        "delta_to_full_ratio": round(delta_bytes / full_bytes, 5),
+        "full_gossip_bytes_per_s_at_10hz": int(
+            full_bytes * n_nodes * rate_hz),
+        "delta_gossip_bytes_per_s_at_10hz": int(
+            delta_bytes * n_nodes * rate_hz),
+        "select_node_us_at_scale": round(select_us, 1),
+    }
+
+
 def bench_broadcast(size_mib: int = 1024, n_receivers: int = 3) -> Dict:
     """1 GiB object broadcast over an in-process multi-raylet Cluster
     (reference: 1 GiB to 50+ nodes). The object is PUSHed from the owning
@@ -192,6 +250,9 @@ def run_envelope(scale: float = 1.0, elastic: bool = False) -> Dict:
             max(1, int(30 * scale)))
         log("microbenchmark...")
         results["microbenchmark"] = run_microbenchmark()
+        log("broadcast_1k...")
+        results["broadcast_1k_nodes"] = bench_broadcast_1k(
+            max(8, int(1000 * scale)))
         if elastic:
             from ray_tpu.core.burst import BurstProfile, run_burst
 

@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional
 logger = logging.getLogger(__name__)
 
 DEFAULT_ARTIFACT = "SERVESTORM_r09.json"
+HEADFAIL_ARTIFACT = "HEADFAIL_r11.json"
 DEFAULT_FAULT_SPEC = "drop:serve_replica_call:0.02"
 
 
@@ -73,6 +74,9 @@ class StormProfile:
 
 
 QUICK_PROFILE = dict(duration_s=6.0, kill_period_s=2.0)
+# --kill-head needs a window on BOTH sides of the promotion; the lease TTL
+# is squeezed so expiry->promotion fits the CI budget
+KILLHEAD_QUICK_PROFILE = dict(duration_s=10.0, kill_period_s=3.0)
 
 
 @dataclass
@@ -309,6 +313,77 @@ def _run_storm_inner(p: StormProfile, rng: random.Random, injector,
     return result
 
 
+class HeadKiller:
+    """Mid-storm head kill-and-promote (`--kill-head`): snapshots the
+    active head, starts a warm StandbyHead, crash-stops the head (no lease
+    relinquish — the HARD failure: promotion waits out the TTL), adopts the
+    promoted head and drives a probe actor through it so the tracked
+    promotion latency (lease-expiry -> first-scheduled-task) has a far
+    edge even on an otherwise idle control plane."""
+
+    def __init__(self, cluster, kill_after_s: float, lease_ttl_s: float):
+        self.cluster = cluster
+        self.kill_after_s = kill_after_s
+        self.lease_ttl_s = lease_ttl_s
+        self.record: Dict[str, Any] = {}
+        self._cancel = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="storm-head-killer", daemon=True)
+
+    def start(self) -> "HeadKiller":
+        self._thread.start()
+        return self
+
+    def join(self, timeout: float) -> None:
+        self._cancel.set()
+        self._thread.join(timeout)
+
+    def _run(self) -> None:
+        import ray_tpu
+
+        if self._cancel.wait(self.kill_after_s):
+            return
+        rec = self.record
+        try:
+            self.cluster.gcs._write_snapshot()
+        except Exception:
+            logger.exception("pre-kill snapshot failed; standby promotes "
+                             "from the periodic loop's last write")
+        standby = self.cluster.start_standby()
+        time.sleep(max(0.3, self.lease_ttl_s / 2))  # one standby tail poll
+        rec["epoch_before"] = self.cluster.gcs.fence_epoch
+        rec["killed_at"] = time.time()
+        logger.warning("storm killing the ACTIVE HEAD (epoch %d)",
+                       rec["epoch_before"])
+        self.cluster.gcs.kill()
+        try:
+            rec["new_address"] = self.cluster.adopt_promoted(
+                standby, timeout=self.lease_ttl_s * 10 + 30)
+        except Exception as e:
+            rec["error"] = f"promotion failed: {e}"
+            logger.exception("standby promotion failed")
+            return
+        rec["epoch_after"] = self.cluster.gcs.fence_epoch
+
+        @ray_tpu.remote
+        class _PromotionProbe:
+            def ping(self):
+                return 1
+
+        try:
+            probe = _PromotionProbe.options(num_cpus=0).remote()
+            ray_tpu.get(probe.ping.remote(), timeout=60)
+            ray_tpu.kill(probe)
+        except Exception as e:
+            rec["probe_error"] = str(e)
+        rec["promotion"] = dict(self.cluster.gcs.promotion or {})
+        lat = rec["promotion"].get("latency_s")
+        logger.warning("head promoted: epoch %d -> %d at %s, "
+                       "lease-expiry->first-scheduled-task %.3fs",
+                       rec["epoch_before"], rec["epoch_after"],
+                       rec["new_address"], lat if lat is not None else -1.0)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
 
@@ -321,9 +396,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="fault-injection + kill-choice seed (default: "
                          "RAY_TPU_FAULT_INJECTION_SEED or 0)")
     ap.add_argument("--quick", action="store_true",
-                    help="short CI profile (~6 s)")
+                    help="short CI profile (~6 s; ~10 s with --kill-head)")
     ap.add_argument("--json", default=DEFAULT_ARTIFACT,
                     help=f"artifact path (default {DEFAULT_ARTIFACT})")
+    ap.add_argument("--kill-head", action="store_true",
+                    help="kill-and-promote the GCS head mid-storm: a warm "
+                         "standby takes over via the lease/fencing-epoch "
+                         "CAS; asserts zero hung requests, bounded "
+                         "promotion latency and no typed-error spike "
+                         "beyond the shed baseline")
+    ap.add_argument("--headfail-json", default=HEADFAIL_ARTIFACT,
+                    help="promotion-latency artifact for --kill-head "
+                         f"(default {HEADFAIL_ARTIFACT})")
+    ap.add_argument("--promotion-budget", type=float, default=1.0,
+                    help="max allowed lease-expiry -> first-scheduled-task "
+                         "latency in seconds (--kill-head)")
+    ap.add_argument("--lease-ttl", type=float, default=1.0,
+                    help="head lease TTL for the --kill-head run")
     args = ap.parse_args(argv)
 
     import os
@@ -333,13 +422,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     kw: Dict[str, Any] = dict(seed=seed, overload=args.overload,
                               duration_s=args.duration)
     if args.quick:
-        kw.update(QUICK_PROFILE)
+        kw.update(KILLHEAD_QUICK_PROFILE if args.kill_head
+                  else QUICK_PROFILE)
     profile = StormProfile(**kw)
 
-    ray_tpu.init(num_cpus=max(8, profile.max_replicas + 2),
-                 resources={"TPU": 8})
+    cluster = None
+    killer = None
+    if args.kill_head:
+        from ray_tpu.core.cluster import Cluster
+        from ray_tpu.core.config import get_config
+
+        get_config().head_lease_ttl_s = args.lease_ttl
+        cluster = Cluster(
+            snapshot_uri=f"memory://storm-head-{os.getpid()}")
+        cluster.add_node(resources={
+            "CPU": float(max(8, profile.max_replicas + 2)), "TPU": 8.0})
+        cluster.connect()
+        killer = HeadKiller(cluster, kill_after_s=profile.duration_s * 0.4,
+                            lease_ttl_s=args.lease_ttl).start()
+    else:
+        ray_tpu.init(num_cpus=max(8, profile.max_replicas + 2),
+                     resources={"TPU": 8})
     try:
         result = run_storm(profile, out_path=args.json)
+        if killer is not None:
+            killer.join(timeout=args.lease_ttl * 10 + 90)
     finally:
         try:
             from ray_tpu import serve
@@ -347,6 +454,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             serve.shutdown()
         finally:
             ray_tpu.shutdown()
+            if cluster is not None:
+                cluster.shutdown()
 
     req = result["requests"]
     print(f"serve storm: seed={result['seed']} "
@@ -364,12 +473,90 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"p99_accepted={result['latency_ms']['p99_accepted']}ms")
     if args.json:
         print(f"  artifact: {args.json}")
+    failed = False
     if req["hung"] or not result["zero_hung"]:
         print(f"STORM FAILED: {req['hung']} hung request(s) "
               f"(seed {result['seed']})")
+        failed = True
+    if args.kill_head:
+        failed |= _report_head_kill(killer.record, result, args)
+    if failed:
         return 1
     print("storm clean: every request resolved within its deadline")
     return 0
+
+
+# Typed errors that are NOT overload responses (shed/timeout are the serve
+# plane doing its job at 4x load): a head failover must not spike these
+# beyond a small fraction of traffic. SERVESTORM_r09 baseline without head
+# kills: replica_death+other = 1.8% of submitted.
+ERROR_SPIKE_MAX_FRACTION = 0.10
+
+
+def _report_head_kill(rec: Dict[str, Any], result: Dict[str, Any],
+                      args) -> bool:
+    """Print + persist the kill-head verdict (HEADFAIL artifact). Returns
+    True when the run FAILED (no promotion, promotion over budget, or a
+    typed-error spike beyond the shed baseline)."""
+    from ray_tpu.envelope import bench_broadcast_1k
+
+    failed = False
+    promotion = rec.get("promotion") or {}
+    latency = promotion.get("latency_s")
+    req = result["requests"]
+    errs = req["replica_death"] + req["other_error"]
+    err_frac = errs / max(1, req["submitted"])
+    print(f"  head kill: epochs {rec.get('epoch_before')} -> "
+          f"{rec.get('epoch_after')} new_head={rec.get('new_address')} "
+          f"lease_ttl={args.lease_ttl}s")
+    if rec.get("error") or latency is None:
+        print(f"HEADFAIL: standby never promoted / never scheduled "
+              f"({rec.get('error') or rec.get('probe_error')})")
+        failed = True
+    else:
+        print(f"  promotion latency (lease-expiry -> first-scheduled-task): "
+              f"{latency:.3f}s (budget {args.promotion_budget}s, tailed "
+              f"snapshot v{promotion.get('tailed_version')})")
+        if latency > args.promotion_budget:
+            print(f"HEADFAIL: promotion latency {latency:.3f}s over the "
+                  f"{args.promotion_budget}s budget")
+            failed = True
+    print(f"  typed-error spike check: replica_death+other = {errs} "
+          f"({err_frac:.1%} of submitted, max "
+          f"{ERROR_SPIKE_MAX_FRACTION:.0%}; shed baseline {req['shed']} "
+          f"+ timeout {req['timeout']})")
+    if err_frac > ERROR_SPIKE_MAX_FRACTION:
+        print("HEADFAIL: typed-error spike beyond the shed baseline")
+        failed = True
+
+    artifact = {
+        "bench": "head_failover_storm",
+        "round": 11,
+        "seed": result["seed"],
+        "lease_ttl_s": args.lease_ttl,
+        "promotion_budget_s": args.promotion_budget,
+        "epochs": {"before": rec.get("epoch_before"),
+                   "after": rec.get("epoch_after")},
+        "promotion": promotion,
+        "promotion_latency_s": latency,
+        "new_head_address": rec.get("new_address"),
+        "storm": {
+            "duration_s": result["duration_s"],
+            "offered_rps": result["offered_rps"],
+            "requests": dict(req),
+            "zero_hung": result["zero_hung"],
+            "error_spike_fraction": round(err_frac, 4),
+            "error_spike_max_fraction": ERROR_SPIKE_MAX_FRACTION,
+            "replica_kills": result["replicas"]["kills"],
+        },
+        "broadcast_1k_nodes": bench_broadcast_1k(),
+        "passed": not failed,
+    }
+    if args.headfail_json:
+        with open(args.headfail_json, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+        print(f"  headfail artifact: {args.headfail_json}")
+    return failed
 
 
 if __name__ == "__main__":

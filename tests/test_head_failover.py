@@ -1,0 +1,346 @@
+"""Standby head with fenced failover (ROADMAP item 5): a warm standby
+tails the snapshot store and takes over via the lease/fencing-epoch CAS —
+promotion under seeded `lease_renew` drops, split-brain fencing (a revived
+stale head's snapshot saves and announces are REJECTED, not raced), and a
+rolling head upgrade with an in-flight workload and named-actor calls
+riding across the promotion. Seeded fault injection keeps the recovery
+paths deterministic; the seed is printed so a failure reproduces exactly."""
+
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import rpc
+from ray_tpu.core.cluster import Cluster
+from ray_tpu.core.config import get_config
+from ray_tpu.core.head_lease import (HeadLease, LeaseHeldError,
+                                     LeaseLostError)
+from ray_tpu.core.snapshot_store import MemorySnapshotStore
+
+FAULT_SEED = int(os.environ.get("RAY_TPU_FAULT_INJECTION_SEED", "20260804"))
+TTL = 1.0
+
+
+def _wait(pred, timeout=60, period=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(period)
+    return pred()
+
+
+@pytest.fixture
+def ha_cluster():
+    cfg = get_config()
+    saved_ttl = cfg.head_lease_ttl_s
+    cfg.head_lease_ttl_s = TTL
+    name = f"headfail-{os.getpid()}-{time.monotonic_ns()}"
+    cluster = Cluster(snapshot_uri=f"memory://{name}")
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    yield cluster
+    rpc.clear_fault_injector()
+    cluster.shutdown()
+    cfg.head_lease_ttl_s = saved_ttl
+    MemorySnapshotStore.wipe(name)
+
+
+# ----------------------------------------------------------- lease protocol
+def test_head_lease_protocol():
+    """Acquire/renew/relinquish/check semantics on a dumb blob store: the
+    epoch bumps on every ownership CHANGE (never on renewal), a live lease
+    refuses other claimants, and a stale epoch is fenced everywhere."""
+    store = MemorySnapshotStore(f"lease-unit-{time.monotonic_ns()}")
+    lease = HeadLease(store, ttl_s=0.4)
+    epoch = lease.acquire("owner-a", settle_s=0)
+    assert epoch == 1
+    lease.renew("owner-a", 1)
+    assert lease.read()["epoch"] == 1  # renewal never bumps the epoch
+
+    # a live lease refuses another claimant
+    with pytest.raises(LeaseHeldError):
+        lease.acquire("owner-b", settle_s=0)
+
+    # expiry: the epoch we SAW expire is the CAS expectation
+    time.sleep(0.5)
+    rec = lease.read()
+    assert rec["expires_at"] <= time.time()
+    assert lease.acquire("owner-b", expect_epoch=rec["epoch"],
+                         settle_s=0) == 2
+
+    # the old owner is fenced: renew, check and a stale-epoch CAS all raise
+    with pytest.raises(LeaseLostError):
+        lease.renew("owner-a", 1)
+    with pytest.raises(LeaseLostError):
+        lease.check(1)
+    lease.check(2)  # current holder passes
+    time.sleep(0.5)
+    with pytest.raises(LeaseLostError):
+        lease.acquire("owner-c", expect_epoch=1, settle_s=0)
+
+    # relinquish: expiry NOW, epoch unchanged -> instant takeover; a
+    # renewal racing the drain must NOT resurrect the lease for a TTL
+    lease.relinquish("owner-b", 2)
+    lease.renew("owner-b", 2)  # no-op: relinquished stays relinquished
+    assert lease.read()["relinquished"] is True
+    assert lease.read()["expires_at"] <= time.time()
+    assert lease.acquire("owner-c", expect_epoch=2, settle_s=0) == 3
+
+    # a torn/lost lease record must not reset the epoch under the fleet:
+    # the snapshot-carried floor keeps the new epoch ahead of any adopted
+    store.delete("gcs-lease")
+    assert lease.acquire("owner-d", settle_s=0, floor=4) == 4
+
+
+# ----------------------------------------------- promotion under renew drops
+def test_standby_promotes_under_lease_renew_drops(ha_cluster):
+    """Seeded `lease_renew` drops starve a perfectly healthy head's lease:
+    the standby must promote via the epoch CAS, re-adopt both raylets in
+    one RPC each, and serve old state (named actor, KV) and new work."""
+    cluster = ha_cluster
+
+    @ray_tpu.remote(max_restarts=2)
+    class Counter:
+        def __init__(self):
+            self.v = 0
+
+        def incr(self):
+            self.v += 1
+            return self.v
+
+    counter = Counter.options(name="survivor", namespace="hf").remote()
+    assert ray_tpu.get(counter.incr.remote(), timeout=60) == 1
+    w = ray_tpu.core.worker.current_worker()
+    w.gcs.call("kv_put", {"namespace": "hf", "key": b"k", "value": b"v"})
+    cluster.gcs._write_snapshot()
+
+    print(f"fault injection seed: {FAULT_SEED}")
+    inj = rpc.install_fault_injector("drop:lease_renew", seed=FAULT_SEED)
+    standby = cluster.start_standby()
+    old = cluster.gcs
+    new_address = cluster.adopt_promoted(standby, timeout=TTL * 20 + 30)
+    rpc.clear_fault_injector()
+    assert inj.stats["drop"] >= 1, "no renewal was ever dropped"
+    assert new_address != old.address
+    assert cluster.gcs.fence_epoch == old.fence_epoch + 1
+
+    # the still-running old head fences itself (next lease read, or the
+    # successor's direct head_fenced dial) and RETIRES from serving —
+    # clients re-resolve to the promoted head before the next assertions
+    assert _wait(lambda: old._fenced.is_set(), 30), \
+        "stale head never fenced itself"
+    assert _wait(lambda: old._shutdown.is_set(), 30), \
+        "fenced head never retired from serving"
+
+    # the one-RPC re-adoption left no provisional entries behind
+    assert _wait(lambda: cluster.gcs.rpc_gcs_stats(None, 0, {})
+                 ["nodes_alive"] >= 2, 30)
+    assert _wait(lambda: cluster.gcs.rpc_gcs_stats(None, 0, {})
+                 ["nodes_provisional"] == 0, 30)
+
+    # tracked promotion record: lease-expiry -> first-scheduled-task
+    fresh = Counter.remote()
+    assert ray_tpu.get(fresh.incr.remote(), timeout=60) == 1
+    promo = cluster.gcs.promotion
+    assert promo is not None and promo["first_schedule_at"] is not None
+    assert promo["latency_s"] < 10.0, f"promotion latency {promo}"
+
+    # old state survived the takeover: named actor + KV
+    assert ray_tpu.get(counter.incr.remote(), timeout=60) == 2
+    assert _wait(lambda: w.gcs.call(
+        "get_actor_info", {"name": "survivor", "namespace": "hf"})
+        is not None, 30)
+    assert w.gcs.call("kv_get", {"namespace": "hf", "key": b"k"}) == b"v"
+    old.retire()
+
+
+# --------------------------------------------------------------- split brain
+def test_split_brain_stale_head_writes_bounce(ha_cluster):
+    """The acceptance scenario: the OLD head stays alive across the
+    promotion (lease starved by injection, process never killed). Its
+    snapshot save raises LeaseLostError, its announces are logged-and-
+    dropped by raylets (no GCS-client flap), and the fleet stays on the
+    new head."""
+    cluster = ha_cluster
+    node = cluster._raylets[0]
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1), timeout=60) == 2
+    cluster.gcs._write_snapshot()
+
+    print(f"fault injection seed: {FAULT_SEED}")
+    rpc.install_fault_injector("drop:lease_renew", seed=FAULT_SEED)
+    standby = cluster.start_standby()
+    old = cluster.gcs
+    new_address = cluster.adopt_promoted(standby, timeout=TTL * 20 + 30)
+    rpc.clear_fault_injector()
+
+    # the revived/stale head's durable write is REJECTED, not raced
+    old._dirty = True
+    with pytest.raises(LeaseLostError):
+        old._write_snapshot()
+    assert old._fencing_rejections >= 1
+    assert old._fenced.is_set()
+
+    # raylets drop its announces (both flavors) without flapping their link
+    assert _wait(lambda: node.gcs_address == new_address, 30), \
+        "raylet never re-registered with the promoted head"
+    drops0 = node._fencing_drops
+    cli = rpc.connect_with_retry(node.address, timeout=5)
+    try:
+        reply = cli.call("promote_announce", {
+            "address": old.address, "epoch": old.fence_epoch,
+            "session_id": old.session_id}, timeout=5)
+        assert reply == {"adopted": False, "reason": "stale_epoch"}
+        assert cli.call("new_gcs_address", {
+            "address": old.address, "epoch": old.fence_epoch},
+            timeout=5) is False
+    finally:
+        cli.close()
+    assert node._fencing_drops >= drops0 + 2
+    assert node.gcs_address == new_address, "stale announce flapped the link"
+
+    # the snapshot store belongs to the new epoch: its writes land
+    cluster.gcs._dirty = True
+    cluster.gcs._write_snapshot()
+    assert ray_tpu.get(f.remote(41), timeout=60) == 42
+    old.retire()
+
+
+# ----------------------------------------------------------- rolling upgrade
+def test_rolling_head_upgrade_zero_dropped_calls(ha_cluster):
+    """drain lease -> promote standby -> old head retires, with an
+    in-flight task workload and a named-actor call loop running across the
+    promotion: ZERO dropped/errored calls (the old head serves until the
+    new one is active; control-plane calls retry across the switchover)."""
+    cluster = ha_cluster
+
+    @ray_tpu.remote
+    class Echo:
+        def hit(self, i):
+            return i
+
+    Echo.options(name="echo", namespace="roll").remote()
+    handle = ray_tpu.get_actor("echo", namespace="roll")
+    assert ray_tpu.get(handle.hit.remote(0), timeout=60) == 0
+
+    @ray_tpu.remote(max_retries=3)
+    def slow(i):
+        time.sleep(0.5)
+        return i * 10
+
+    inflight = [slow.remote(i) for i in range(8)]
+
+    errors = []
+    calls = {"n": 0}
+    stop = threading.Event()
+
+    def caller():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                # get_actor exercises the control plane every iteration; the
+                # call itself rides worker links
+                h = ray_tpu.get_actor("echo", namespace="roll")
+                assert ray_tpu.get(h.hit.remote(i), timeout=30) == i
+                calls["n"] += 1
+            except Exception as e:  # any error breaks the zero-drop claim
+                errors.append(repr(e))
+        stop.set()
+
+    t = threading.Thread(target=caller, daemon=True)
+    t.start()
+    time.sleep(0.5)
+
+    old = cluster.gcs
+    old_epoch = old.fence_epoch
+    new_address = cluster.rolling_head_upgrade(timeout=TTL * 20 + 30)
+    assert new_address != old.address
+    assert cluster.gcs.fence_epoch == old_epoch + 1
+    # old head fenced itself (lease-loop read of the bumped epoch) or was
+    # retired; either way it is out of the write path
+    assert _wait(lambda: old._fenced.is_set() or old._shutdown.is_set(), 30)
+
+    time.sleep(1.0)  # keep calling a beat past the switchover
+    stop.set()
+    t.join(timeout=30)
+    assert not errors, f"calls dropped across rolling upgrade: {errors[:3]}"
+    assert calls["n"] > 0, "caller loop never completed a call"
+
+    # the in-flight workload completed; new work schedules on the new head
+    assert ray_tpu.get(inflight, timeout=120) == [i * 10 for i in range(8)]
+    fresh = Echo.remote()
+    assert ray_tpu.get(fresh.hit.remote(7), timeout=60) == 7
+
+
+# ------------------------------------------------------- delta broadcast
+def test_delta_broadcast_and_catchup(ha_cluster):
+    """Steady-state CH_RESOURCES publishes are deltas; a raylet that
+    misses one (sequence gap) pulls a consistent full view and re-anchors
+    instead of applying onto a stale base."""
+    cluster = ha_cluster
+    node = cluster._raylets[0]
+
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    # churn: completions drive resource reports -> debounced publishes
+    assert ray_tpu.get([f.remote(i) for i in range(40)], timeout=120) == \
+        list(range(40))
+    stats = cluster.gcs.rpc_gcs_stats(None, 0, {})["broadcast"]
+    assert stats["delta_enabled"]
+    assert _wait(lambda: cluster.gcs.rpc_gcs_stats(
+        None, 0, {})["broadcast"]["deltas"] > 0, 30), \
+        f"no delta publish observed: {stats}"
+
+    # force a gap: pretend we are far behind, then let one delta arrive
+    with node._lock:
+        node._bcast_seen_seq = -1000
+    assert ray_tpu.get(f.remote(1), timeout=60) == 1
+    assert _wait(lambda: (node._bcast_seen_seq or 0) > 0, 30), \
+        "catch-up never re-anchored the sequence"
+    other = cluster._raylets[1]
+    assert _wait(lambda: other.node_id.hex() in node._cluster_view, 30)
+
+
+# ------------------------------------------------- address-file atomicity
+def test_address_file_atomic_and_empty_read_retries(tmp_path):
+    """Satellite: the GCS address file swaps in atomically (fsync + rename,
+    writer-unique tmp) and an empty/whitespace read means 'retry', never
+    'connect to empty string'."""
+    path = tmp_path / "gcs_address"
+    cfg = get_config()
+    saved = cfg.gcs_address_file
+    cfg.gcs_address_file = str(path)
+    try:
+        from ray_tpu.core.gcs import GcsServer
+
+        gcs = GcsServer()
+        address = gcs.start()
+        try:
+            assert path.read_text() == address
+            assert rpc.read_gcs_address_file() == address
+            # no stale tmp litter from the atomic swap
+            assert not list(tmp_path.glob("gcs_address.tmp*"))
+            # a torn/empty read is "no answer" at every resolution layer
+            path.write_text("")
+            assert rpc.read_gcs_address_file() is None
+            path.write_text("  \n")
+            assert rpc.read_gcs_address_file() is None
+            # rewrite goes through the same swap and is whole again
+            gcs._write_address_file()
+            assert rpc.read_gcs_address_file() == address
+        finally:
+            gcs.stop()
+    finally:
+        cfg.gcs_address_file = saved
