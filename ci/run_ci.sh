@@ -10,6 +10,7 @@
 #   ci/run_ci.sh --storm    # serve traffic-storm chaos only
 #   ci/run_ci.sh --burst    # warm-pool elasticity burst only
 #   ci/run_ci.sh --failover # standby-head kill-and-promote storm only
+#   ci/run_ci.sh --node-chaos # multi-node kill storm only
 #
 # Stages:
 #   1. native      : arena + scheduler + token-loader compiled whole-program
@@ -39,13 +40,21 @@
 #                    task); fails if promotion exceeds the budget, any
 #                    request hangs, or typed errors spike past the shed
 #                    baseline.
+#   8. node-chaos  : multi-node kill storm (--nodes --quick): whole nodes
+#                    (raylet + workers + fork templates) SIGKILLed under
+#                    closed-loop load; the autoscaler reaps + relaunches,
+#                    replacements onboard warm (hot-env template prewarm).
+#                    Prints the seed, detection latencies vs the health
+#                    bound, relaunch counts and join->first-warm-lease;
+#                    fails on any undetected kill, unreplaced node, lost
+#                    actor or hung call.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGE="${1:-all}"
 
 run_native() {
-  echo "=== [1/7] native modules under ASan/UBSan ==="
+  echo "=== [1/8] native modules under ASan/UBSan ==="
   mkdir -p build
   g++ -std=c++17 -O1 -g -fsanitize=address,undefined \
       -fno-omit-frame-pointer -o build/sanitize_native \
@@ -57,7 +66,7 @@ run_native() {
 }
 
 run_fast() {
-  echo "=== [2/7] fast test tier ==="
+  echo "=== [2/8] fast test tier ==="
   python -m pytest tests/ -q
   # core-primitives smoke: the submission AND completion hot paths
   # (function table, event batching, batched result delivery, put/get)
@@ -79,7 +88,7 @@ EOF
 }
 
 run_stress() {
-  echo "=== [3/7] actor ordering stress x20 ==="
+  echo "=== [3/8] actor ordering stress x20 ==="
   for i in $(seq 1 20); do
     python -m pytest tests/test_actor_ordering_stress.py -q -x \
       || { echo "ordering stress failed on iteration $i"; exit 1; }
@@ -87,7 +96,7 @@ run_stress() {
 }
 
 run_chaos() {
-  echo "=== [4/7] control-plane HA chaos suite ==="
+  echo "=== [4/8] control-plane HA chaos suite ==="
   # Deterministic fault injection: pin + print the seed so a red run
   # reproduces bit-for-bit (override by exporting the variable).
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
@@ -103,7 +112,7 @@ run_chaos() {
 }
 
 run_serve_storm() {
-  echo "=== [5/7] serve traffic-storm chaos ==="
+  echo "=== [5/8] serve traffic-storm chaos ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -119,7 +128,7 @@ run_serve_storm() {
 }
 
 run_burst() {
-  echo "=== [6/7] warm-pool elasticity burst ==="
+  echo "=== [6/8] warm-pool elasticity burst ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "burst seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -136,7 +145,7 @@ run_burst() {
 }
 
 run_head_failover() {
-  echo "=== [7/7] standby-head kill-and-promote storm ==="
+  echo "=== [7/8] standby-head kill-and-promote storm ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -154,18 +163,39 @@ run_head_failover() {
          exit 1; }
 }
 
+run_node_chaos() {
+  echo "=== [8/8] multi-node kill storm (node failure domain) ==="
+  : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
+  export RAY_TPU_FAULT_INJECTION_SEED
+  echo "node storm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
+  # --nodes --quick: a 3-node fleet (FakeNodeProvider raylets, autoscaler
+  # as the recovery control loop) under closed-loop actor load takes
+  # seeded WHOLE-NODE SIGKILLs — raylet + workers + fork templates die
+  # together, no drain notify. The harness prints kills/detections (with
+  # the health-bound detection latency), autoscaler relaunches and the
+  # node-join-to-first-warm-lease of each replacement; it exits nonzero
+  # if any kill goes undetected, any node stays unreplaced, any actor
+  # never recovers, or any load call hangs.
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m ray_tpu.core.burst \
+    --nodes --quick --seed "${RAY_TPU_FAULT_INJECTION_SEED}" \
+    --json /tmp/ray_tpu_nodestorm_ci.json \
+    || { echo "node kill storm failed (seed ${RAY_TPU_FAULT_INJECTION_SEED})"
+         exit 1; }
+}
+
 case "$STAGE" in
-  --native)   run_native ;;
-  --fast)     run_fast ;;
-  --stress)   run_stress ;;
-  --chaos)    run_chaos ;;
-  --storm)    run_serve_storm ;;
-  --burst)    run_burst ;;
-  --failover) run_head_failover ;;
+  --native)     run_native ;;
+  --fast)       run_fast ;;
+  --stress)     run_stress ;;
+  --chaos)      run_chaos ;;
+  --storm)      run_serve_storm ;;
+  --burst)      run_burst ;;
+  --failover)   run_head_failover ;;
+  --node-chaos) run_node_chaos ;;
   all)        run_native; run_fast; run_stress; run_chaos; run_serve_storm
-              run_burst; run_head_failover ;;
+              run_burst; run_head_failover; run_node_chaos ;;
   *) echo "unknown stage: $STAGE" \
-     "(use --native|--fast|--stress|--chaos|--storm|--burst|--failover)" >&2
+     "(use --native|--fast|--stress|--chaos|--storm|--burst|--failover|--node-chaos)" >&2
      exit 2 ;;
 esac
 echo "CI green"

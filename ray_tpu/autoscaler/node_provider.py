@@ -19,6 +19,12 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 
+def _is_not_found(e: Exception) -> bool:
+    """True for an HTTP 404 from any transport shape (urllib's HTTPError
+    carries `.code`; injected test transports may use `.status`)."""
+    return getattr(e, "code", None) == 404 or getattr(e, "status", None) == 404
+
+
 class NodeProvider:
     def create_node(self, node_type: str, resources: Dict[str, float],
                     labels: Dict[str, str]) -> str:
@@ -45,17 +51,36 @@ class FakeNodeProvider(NodeProvider):
 
         raylet = Raylet(gcs_address=self.gcs_address,
                         resources=dict(resources), labels=dict(labels))
-        raylet.start()
+        # the raylet registers with the GCS inside start(), so the GCS
+        # view leads the provider listing by a beat: a node is listed here
+        # only once fully booted (observers picking kill victims off
+        # non_terminated_nodes() must never get a mid-boot raylet)
+        try:
+            raylet.start()
+        except Exception:
+            # a boot that failed after registering must not linger as a
+            # heartbeating ghost the provider denies owning
+            try:
+                raylet.stop()
+            except Exception:
+                pass
+            raise
         pid = f"fake-{node_type}-{uuid.uuid4().hex[:8]}"
         with self._lock:
             self._nodes[pid] = raylet
         return pid
 
     def terminate_node(self, provider_node_id: str) -> None:
+        """Idempotent: terminating an already-gone id (double reap after a
+        node self-died) is a no-op, and a crashed raylet's teardown errors
+        are swallowed — the node is dead either way."""
         with self._lock:
             raylet = self._nodes.pop(provider_node_id, None)
         if raylet is not None:
-            raylet.stop()
+            try:
+                raylet.stop()
+            except Exception:
+                pass  # already crashed (kill_node); nothing left to stop
 
     def non_terminated_nodes(self) -> List[str]:
         with self._lock:
@@ -63,6 +88,18 @@ class FakeNodeProvider(NodeProvider):
 
     def raylet_for(self, provider_node_id: str):
         return self._nodes.get(provider_node_id)
+
+    def kill_node(self, provider_node_id: str, vanish: bool = False) -> None:
+        """Chaos: whole-node SIGKILL — the raylet, its workers and its fork
+        templates die together, with NO drain notify. With `vanish=False`
+        the corpse stays listed (a crashed VM the cloud API still shows —
+        the autoscaler must terminate-and-replace it); with `vanish=True`
+        it also leaves the provider view (a preempted slice)."""
+        with self._lock:
+            raylet = (self._nodes.pop(provider_node_id, None) if vanish
+                      else self._nodes.get(provider_node_id))
+        if raylet is not None:
+            raylet.crash()
 
 
 class GceTpuNodeProvider(NodeProvider):
@@ -168,10 +205,18 @@ class GceTpuNodeProvider(NodeProvider):
         return node_id
 
     def terminate_node(self, provider_node_id: str) -> None:
-        self._request(
-            "DELETE",
-            f"{self._API}/{self._parent()}/nodes/{provider_node_id}",
-            None, self._auth_headers())
+        try:
+            self._request(
+                "DELETE",
+                f"{self._API}/{self._parent()}/nodes/{provider_node_id}",
+                None, self._auth_headers())
+        except Exception as e:
+            if _is_not_found(e):
+                # idempotent termination: the slice already self-died (or a
+                # previous reap won the race) — a 404 double reap is a
+                # no-op, not a crash in the autoscaler's reconcile loop
+                return
+            raise
 
     def non_terminated_nodes(self) -> List[str]:
         out: List[str] = []
@@ -294,7 +339,12 @@ class KubernetesTpuNodeProvider(NodeProvider):
         return manifest["metadata"]["name"]
 
     def terminate_node(self, provider_node_id: str) -> None:
-        self._request("DELETE", self._pods_url(f"/{provider_node_id}"))
+        try:
+            self._request("DELETE", self._pods_url(f"/{provider_node_id}"))
+        except Exception as e:
+            if _is_not_found(e):
+                return  # pod already deleted: double reap is a no-op
+            raise
 
     def non_terminated_nodes(self) -> List[str]:
         resp = self._request(

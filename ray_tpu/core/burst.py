@@ -1,5 +1,9 @@
 """Burst-elasticity chaos harness: scale a synthetic fleet 10 -> 1000
-workers under queued load with seeded worker kills.
+workers under queued load with seeded worker kills — and, in `--nodes`
+mode, a multi-raylet NODE kill storm: whole nodes (raylet + its workers +
+its fork templates) SIGKILLed together under closed-loop load, with the
+autoscaler as the recovery control loop (dead-node reap-and-replace) and
+warm node onboarding (hot-env template prewarm) measured end to end.
 
 This is the elasticity story behind "millions of users" made into a
 repeatable scenario: a small serving/RL-style fleet of actors is already
@@ -28,6 +32,21 @@ actors-to-first-ping for the scale-up wave. Run directly:
 
     python -m ray_tpu.core.burst                # full 10 -> 1000 profile
     python -m ray_tpu.core.burst --quick        # 4 -> 40 CI profile
+    python -m ray_tpu.core.burst --nodes        # multi-node kill storm
+    python -m ray_tpu.core.burst --nodes --quick  # CI node-storm profile
+
+The node storm asserts the NODE failure-domain contract:
+
+  * every seeded node kill is DETECTED — the GCS declares the node dead
+    through missed heartbeats alone (no drain notify), within the
+    `health_check_period_ms + health_check_timeout_ms` bound;
+  * every kill is REPLACED — the autoscaler reaps the corpse at the
+    provider and relaunches capacity back to `min_workers`;
+  * replacement nodes onboard WARM — the register_node reply's hot env
+    keys pre-spawn fork templates, and node-join-to-first-warm-lease is
+    tracked as a first-class number (ENVELOPE_r12.json);
+  * actors with `max_restarts` land on surviving/replacement nodes and
+    every closed-loop call resolves (zero hung).
 """
 
 from __future__ import annotations
@@ -308,12 +327,407 @@ def run_burst(profile: Optional[BurstProfile] = None,
     return result
 
 
+# --------------------------------------------------------------------------
+# node kill storm (multi-raylet, autoscaler-driven recovery)
+
+
+@dataclass
+class NodeStormProfile:
+    n_nodes: int = 4             # fleet nodes the autoscaler maintains
+    node_cpus: float = 2.0
+    actors_per_node: int = 4     # fleet capacity == actors: survivors stay
+    #                              FULL, so restarts MUST land on replacements
+    n_node_kills: int = 3        # seeded whole-node SIGKILLs
+    kill_period_s: float = 5.0
+    load_inflight: int = 16
+    load_warmup_s: float = 2.0
+    seed: int = 0
+    call_timeout_s: float = 60.0
+    settle_timeout_s: float = 120.0
+    detect_timeout_s: float = 30.0
+    # fast-detection knobs patched into the shared config for the run
+    health_check_period_ms: int = 500
+    health_check_timeout_ms: int = 3000
+
+
+NODE_QUICK_PROFILE = dict(n_nodes=3, actors_per_node=3, n_node_kills=2,
+                          kill_period_s=4.0, load_inflight=8,
+                          load_warmup_s=1.0, settle_timeout_s=90.0)
+
+
+def run_node_storm(profile: Optional[NodeStormProfile] = None,
+                   out_path: Optional[str] = None) -> Dict[str, Any]:
+    """One node kill storm on a fresh in-process multi-raylet cluster.
+    Boots its own Cluster + FakeNodeProvider + StandardAutoscaler; the
+    caller must NOT have ray_tpu initialized."""
+    import ray_tpu
+    from ray_tpu.autoscaler import FakeNodeProvider, NodeType, \
+        StandardAutoscaler
+    from ray_tpu.core.cluster import Cluster
+    from ray_tpu.core.config import get_config
+
+    p = profile or NodeStormProfile()
+    rng = random.Random(p.seed)
+    cfg = get_config()
+    saved = (cfg.health_check_period_ms, cfg.health_check_timeout_ms)
+    cfg.health_check_period_ms = p.health_check_period_ms
+    cfg.health_check_timeout_ms = p.health_check_timeout_ms
+    detection_bound_s = (p.health_check_period_ms
+                         + p.health_check_timeout_ms) / 1000.0
+
+    violations: List[str] = []
+    removed_events: Dict[str, float] = {}   # node hexid -> t_removed
+    events_lock = threading.Lock()
+
+    def on_nodes_event(msg):
+        if msg.get("event") == "removed":
+            with events_lock:
+                removed_events.setdefault(msg["node_id"].hex(),
+                                          time.monotonic())
+
+    # boot INSIDE the try: a failed boot must still restore the patched
+    # health-check config and tear down whatever came up
+    cluster = None
+    provider = None
+    autoscaler = None
+    load: Optional[_LoadGen] = None
+    try:
+        cluster = Cluster()
+        cluster.add_node(num_cpus=4, resources={"head": 1})
+        cluster.connect()
+        provider = FakeNodeProvider(cluster.gcs_address)
+        fleet_cap = float(p.actors_per_node)
+        autoscaler = StandardAutoscaler(
+            cluster.gcs_address, provider,
+            [NodeType("storm", {"CPU": p.node_cpus, "fleet": fleet_cap},
+                      min_workers=p.n_nodes,
+                      max_workers=p.n_nodes + p.n_node_kills + 2)],
+            update_interval_s=0.25, idle_timeout_s=10_000.0)
+        from ray_tpu.core.worker import current_worker
+
+        driver = current_worker()
+        driver.subscribe_channel("nodes", on_nodes_event)
+        autoscaler.start()
+
+        # ---- phase 1: the fleet forms -----------------------------------
+        deadline = time.monotonic() + p.settle_timeout_s
+
+        def alive_fleet_nodes() -> List[dict]:
+            nodes = driver.gcs.call("get_all_nodes", {}, timeout=10)
+            return [n for n in nodes if n.get("alive")
+                    and "fleet" in n.get("resources_total", {})]
+
+        while len(alive_fleet_nodes()) < p.n_nodes:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"fleet never formed: {len(alive_fleet_nodes())}"
+                    f"/{p.n_nodes} nodes")
+            time.sleep(0.2)
+        initial_pids = set(provider.non_terminated_nodes())
+
+        @ray_tpu.remote
+        class FleetWorker:
+            def __init__(self):
+                self._n = 0
+
+            def work(self, x):
+                self._n += 1
+                return (os.getpid(), self._n)
+
+            def ping(self):
+                return os.getpid()
+
+        n_actors = p.n_nodes * p.actors_per_node
+        fleet = [FleetWorker.options(num_cpus=0, max_restarts=8,
+                                     resources={"fleet": 1.0}).remote()
+                 for _ in range(n_actors)]
+        ray_tpu.get([a.ping.remote() for a in fleet],
+                    timeout=p.settle_timeout_s)
+        load = _LoadGen(list(fleet), p.load_inflight, p.call_timeout_s)
+        load.start()
+        time.sleep(p.load_warmup_s)
+
+        # ---- phase 2: seeded whole-node kills under load ----------------
+        kills: List[Dict[str, Any]] = []
+        killed_pids: set = set()
+        for _ in range(p.n_node_kills):
+            # a LIVE victim drawn from the provider view (replacements are
+            # fair game once they joined), mapped to its cluster node id
+            # BEFORE the kill so detection can be attributed. Excludes
+            # nodes WE killed, not just detected ones: a corpse stays
+            # provider-listed until the autoscaler reaps it, and drawing
+            # it twice would record two kills for one node.
+            candidates = []
+            with events_lock:
+                seen_dead = set(removed_events)
+            for pid in provider.non_terminated_nodes():
+                raylet = provider.raylet_for(pid)
+                if raylet is not None and pid not in killed_pids \
+                        and raylet.node_id.hex() not in seen_dead:
+                    candidates.append((pid, raylet.node_id.hex()))
+            if not candidates:
+                violations.append("no live node left to kill")
+                break
+            pid, hexid = rng.choice(candidates)
+            logger.warning("node storm: SIGKILLing node %s (%s)",
+                           pid, hexid[:8])
+            t_kill = time.monotonic()
+            provider.kill_node(pid)
+            killed_pids.add(pid)
+            kills.append({"pid": pid, "node": hexid, "t_kill": t_kill})
+            time.sleep(p.kill_period_s)
+
+        # ---- phase 3: every kill detected, every node replaced ----------
+        detect_deadline = time.monotonic() + p.detect_timeout_s
+        for k in kills:
+            while True:
+                with events_lock:
+                    t_removed = removed_events.get(k["node"])
+                if t_removed is not None:
+                    k["detect_s"] = round(t_removed - k["t_kill"], 3)
+                    break
+                if time.monotonic() > detect_deadline:
+                    violations.append(
+                        f"node kill {k['node'][:8]} never detected")
+                    break
+                time.sleep(0.1)
+        detect_lat = sorted(k["detect_s"] for k in kills
+                            if "detect_s" in k)
+        for k in kills:
+            if "detect_s" in k and k["detect_s"] > detection_bound_s * 1.5:
+                violations.append(
+                    f"detection of {k['node'][:8]} took {k['detect_s']}s "
+                    f"(> 1.5x the {detection_bound_s}s health bound)")
+
+        replace_deadline = time.monotonic() + p.settle_timeout_s
+        while len(alive_fleet_nodes()) < p.n_nodes:
+            if time.monotonic() > replace_deadline:
+                violations.append(
+                    f"fleet never healed: {len(alive_fleet_nodes())}"
+                    f"/{p.n_nodes} alive nodes after the storm")
+                break
+            time.sleep(0.2)
+
+        # ---- phase 4: settle — every actor answers, placement is live ---
+        recovered = 0
+        settle_deadline = time.monotonic() + p.settle_timeout_s
+        last_err: Dict[int, str] = {}
+        if os.environ.get("RAY_TPU_NODE_STORM_DUMP_STACKS"):
+            # watchdog: if the settle phase wedges (a ping .remote() or
+            # get() blocking past its budget), dump every thread so the
+            # stuck frame is named instead of inferred
+            import faulthandler
+
+            faulthandler.dump_traceback_later(
+                p.settle_timeout_s * 0.8, exit=False, file=sys.stderr)
+        pending = [(a, a.ping.remote()) for a in fleet]
+        while pending and time.monotonic() < settle_deadline:
+            retry = []
+            for a, r in pending:
+                # per-get budget bounded: one wedged ref must not burn the
+                # whole settle budget serially and mask the others
+                per_get = min(10.0, max(
+                    0.5, settle_deadline - time.monotonic()))
+                try:
+                    ray_tpu.get(r, timeout=per_get)
+                    recovered += 1
+                except Exception as e:
+                    last_err[id(a)] = f"{type(e).__name__}: {e}"[:160]
+                    retry.append((a, a.ping.remote()))
+            pending = retry
+            if pending:
+                time.sleep(0.3)
+        if pending:
+            # "?" = no get() error was ever recorded, i.e. the ping
+            # .remote() itself blocked out the settle budget (an actor
+            # stuck RESTARTING blocks submission in _wait_actor_address) —
+            # pull the GCS state so the failure names the stuck actor
+            errs: Dict[str, int] = {}
+            for a, _ in pending:
+                key = last_err.get(id(a), "?")
+                if key == "?":
+                    try:
+                        info = driver.get_actor_info(actor_id=a._actor_id)
+                        key = (f"no get error; GCS state="
+                               f"{info.get('state') if info else None}")
+                    except Exception:
+                        pass
+                errs[key] = errs.get(key, 0) + 1
+            violations.append(
+                f"{len(pending)} actors never recovered from node kills "
+                f"(last errors: {errs})")
+            if os.environ.get("RAY_TPU_NODE_STORM_DUMP_STACKS"):
+                import faulthandler
+
+                faulthandler.dump_traceback(file=sys.stderr)
+        if os.environ.get("RAY_TPU_NODE_STORM_DUMP_STACKS"):
+            import faulthandler
+
+            faulthandler.cancel_dump_traceback_later()
+        load_counts = load.stop()
+        load = None  # stopped; the finally must not re-join it
+        if load_counts["hung"]:
+            violations.append(
+                f"{load_counts['hung']} load calls never resolved")
+
+        # placement: every actor sits on an ALIVE node; count how many
+        # landed on replacement (post-storm) nodes
+        alive_ids = {n["node_id"] for n in
+                     driver.gcs.call("get_all_nodes", {}, timeout=10)
+                     if n.get("alive")}
+        on_replacements = 0
+        replacement_pids = [pid for pid in provider.non_terminated_nodes()
+                            if pid not in initial_pids]
+        replacement_ids = {provider.raylet_for(pid).node_id.binary()
+                           for pid in replacement_pids
+                           if provider.raylet_for(pid) is not None}
+        for a in fleet:
+            info = driver.get_actor_info(actor_id=a._actor_id)
+            if not info or info.get("state") != "ALIVE":
+                continue
+            nid = info.get("node_id")
+            if nid is not None and nid not in alive_ids:
+                violations.append(
+                    f"actor {info['actor_id']} reports a DEAD node")
+            if nid in replacement_ids:
+                on_replacements += 1
+        if kills and not on_replacements:
+            violations.append("no restarted actor landed on a replacement "
+                              "node (survivors were full — placement is "
+                              "wrong)")
+
+        # ---- warm onboarding numbers ------------------------------------
+        warm_joins = []
+        for pid in replacement_pids:
+            raylet = provider.raylet_for(pid)
+            if raylet is None:
+                continue
+            s = raylet._worker_pool.stats()
+            if s.get("join_to_first_warm_lease_s") is not None:
+                warm_joins.append(s["join_to_first_warm_lease_s"])
+        if replacement_pids and not warm_joins:
+            violations.append("no replacement node served a warm (forked) "
+                              "lease — onboarding prewarm is not working")
+
+        gcs_node_stats = driver.gcs.call("gcs_stats", {}, timeout=10) \
+            .get("node_failure", {})
+        auto_stats = autoscaler.stats()
+        if auto_stats["relaunches"] < len(kills):
+            violations.append(
+                f"autoscaler relaunched {auto_stats['relaunches']} "
+                f"< {len(kills)} kills")
+
+        result = {
+            "suite": "node-kill-storm (autoscaler node failure domain)",
+            "profile": {
+                "n_nodes": p.n_nodes, "actors_per_node": p.actors_per_node,
+                "n_node_kills": p.n_node_kills, "seed": p.seed,
+                "load_inflight": p.load_inflight,
+                "health_check_period_ms": p.health_check_period_ms,
+                "health_check_timeout_ms": p.health_check_timeout_ms,
+            },
+            "chaos": {
+                "node_kills": len(kills),
+                "detected": len(detect_lat),
+                "detection_bound_s": detection_bound_s,
+                "node_death_detection_s": {
+                    "p50": detect_lat[len(detect_lat) // 2]
+                    if detect_lat else None,
+                    "max": detect_lat[-1] if detect_lat else None,
+                },
+                "kills": [{"node": k["node"][:8],
+                           "detect_s": k.get("detect_s")} for k in kills],
+            },
+            "onboarding": {
+                "node_join_to_first_warm_lease_s":
+                    sorted(warm_joins)[len(warm_joins) // 2]
+                    if warm_joins else None,
+                "per_replacement": warm_joins,
+                "replacements": len(replacement_pids),
+            },
+            "actors": {
+                "total": n_actors,
+                "recovered": recovered,
+                "on_replacement_nodes": on_replacements,
+            },
+            "autoscaler": auto_stats,
+            "gcs_node_failure": gcs_node_stats,
+            "load": load_counts,
+            "violations": violations,
+            "ok": not violations,
+        }
+        for a in fleet:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(result, f, indent=2)
+                f.write("\n")
+        return result
+    finally:
+        if load is not None:
+            # an exception escaped mid-storm: silence the load threads
+            # BEFORE tearing the cluster down under them
+            try:
+                load.stop()
+            except Exception:
+                pass
+        if autoscaler is not None:
+            try:
+                autoscaler.stop()
+            except Exception:
+                pass
+        if provider is not None:
+            for pid in provider.non_terminated_nodes():
+                try:
+                    provider.terminate_node(pid)
+                except Exception:
+                    pass
+        if cluster is not None:
+            try:
+                cluster.shutdown()
+            except Exception:
+                logger.exception("node storm cluster shutdown failed")
+        cfg.health_check_period_ms, cfg.health_check_timeout_ms = saved
+
+
+def _node_storm_main(args) -> int:
+    kw: Dict[str, Any] = dict(NODE_QUICK_PROFILE) if args.quick else {}
+    kw["seed"] = args.seed
+    if args.kills is not None:
+        kw["n_node_kills"] = args.kills
+    p = NodeStormProfile(**kw)
+    result = run_node_storm(p, out_path=args.json)
+    print(json.dumps(result, indent=2))
+    c, o = result["chaos"], result["onboarding"]
+    print(f"[node-storm] seed={p.seed} nodes={p.n_nodes} "
+          f"kills={c['node_kills']} detected={c['detected']} "
+          f"(p50 {c['node_death_detection_s']['p50']}s, bound "
+          f"{c['detection_bound_s']}s) | replacements={o['replacements']} "
+          f"join->first-warm-lease={o['node_join_to_first_warm_lease_s']}s "
+          f"| actors recovered={result['actors']['recovered']}"
+          f"/{result['actors']['total']} "
+          f"(on replacements: {result['actors']['on_replacement_nodes']}) "
+          f"| load={result['load']}", file=sys.stderr)
+    if not result["ok"]:
+        print("[node-storm] VIOLATIONS:", file=sys.stderr)
+        for v in result["violations"]:
+            print(f"  - {v}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
                     help="scaled-down CI profile (4 -> 40 workers)")
+    ap.add_argument("--nodes", action="store_true",
+                    help="multi-raylet NODE kill storm (autoscaler-driven "
+                         "replacement + warm onboarding)")
     ap.add_argument("--seed", type=int,
                     default=int(os.environ.get(
                         "RAY_TPU_FAULT_INJECTION_SEED", "0")))
@@ -322,6 +736,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--kills", type=int, default=None)
     ap.add_argument("--json", default=None, help="write the result here")
     args = ap.parse_args(argv)
+
+    if args.nodes:
+        return _node_storm_main(args)
 
     kw: Dict[str, Any] = dict(QUICK_PROFILE) if args.quick else {}
     kw["seed"] = args.seed

@@ -178,6 +178,11 @@ class Config:
     # a 2-phase PG bundle prepared but never committed (the head died
     # between phases) is returned to the node pool after this timeout
     bundle_prepare_timeout_s: float = 30.0
+    # an actor whose restart found no capacity waits (paced retries) for a
+    # surviving/replacement node at most this long before going DEAD —
+    # unbounded waiting would hang every ref of a permanently-infeasible
+    # restart (node type no longer launchable, breaker stuck open)
+    actor_restart_pending_timeout_s: float = 120.0
     # --- standby head / lease fencing (core/head_lease.py) ---
     # TTL of the active head's lease (stored beside the snapshots); the
     # head renews every ttl/3, a standby promotes once it expires. Lower =

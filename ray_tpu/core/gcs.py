@@ -56,6 +56,26 @@ def _head_metrics() -> dict:
     }
 
 
+def _node_metrics() -> dict:
+    """Node-failure-domain metric handles: shared names between the GCS
+    (which declares deaths and ingests warm-lease joins) and the autoscaler
+    (which counts relaunches)."""
+    from ray_tpu.util.metrics import get_or_create
+
+    return {
+        "deaths": get_or_create(
+            "counter", "ray_tpu_node_deaths_total",
+            "nodes declared dead", tag_keys=("reason",)),
+        "relaunches": get_or_create(
+            "counter", "ray_tpu_node_relaunches_total",
+            "autoscaler replacements launched for dead nodes"),
+        "join_warm": get_or_create(
+            "gauge", "ray_tpu_node_join_warm_lease_seconds",
+            "node join -> first warm (forked) lease latency of the most "
+            "recent joiner"),
+    }
+
+
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1",
                  snapshot_path: Optional[str] = None,
@@ -161,6 +181,35 @@ class GcsServer:
         # (address -> node_id); the readopt loop dials them to announce the
         # new head address, and the health loop reaps silent ones
         self._restored_nodes: Dict[str, bytes] = {}
+
+        # --- node failure domain (autoscaler-driven replacement + warm
+        # onboarding) ---
+        # hot runtime-env keys: env keys with recent lease traffic, fed by
+        # raylet heartbeats and shipped in the register_node reply so a
+        # JOINING raylet pre-spawns fork templates for them (warm node
+        # onboarding). key -> {"runtime_env": ..., "last_seen": monotonic}.
+        self._hot_envs: Dict[Optional[str], dict] = {}
+        # death accounting (ray_tpu_node_deaths_total{reason=}); graceful
+        # drains are tallied apart — scale-down is not failure
+        self._node_deaths: Dict[str, int] = {}
+        self._node_drains = 0
+        # the autoscaler's own reconcile counters, reported each tick via
+        # rpc_autoscaler_report so gcs_stats is the one observability stop
+        self._autoscaler_stats: dict = {}
+        # node-join -> first-warm-lease samples reported by joining raylets
+        from collections import deque as _deque
+
+        self._warm_lease_joins: "_deque" = _deque(maxlen=100)
+        # actors whose restart found no capacity RIGHT NOW (their node died
+        # and the replacement has not joined yet): actor_id -> next retry
+        # monotonic. The health loop re-runs scheduling paced; a node
+        # registration makes every entry immediately due.
+        self._pending_restarts: Dict[ActorID, float] = {}
+        # first time each actor was parked (bounds the total wait: past
+        # actor_restart_pending_timeout_s the restart is declared DEAD)
+        self._pending_restart_since: Dict[ActorID, float] = {}
+        self._restart_retry_active = False
+        self._bundle_resched_active = False
         # debounced resource fan-out (completion-path fast lane): at most
         # one CH_RESOURCES publish per resource_broadcast_period_ms
         from ray_tpu.util.debounce import Debouncer
@@ -500,6 +549,14 @@ class GcsServer:
                 # or failed by the readopt loop; it must not hang forever.
                 for pid, p in data.get("pgs", {}).items():
                     self._pgs[pid] = dict(p)
+                # hot runtime-env keys survive head changes (stored as
+                # AGES — monotonic stamps don't cross processes): a node
+                # joining right after a failover still gets its
+                # warm-onboarding hints
+                for key, rec in data.get("hot_envs", {}).items():
+                    self._hot_envs[key] = {
+                        "last_seen": now - float(rec.get("age_s", 0.0)),
+                        "runtime_env": rec.get("runtime_env")}
             logger.info("GCS restored %d KV namespaces, %d jobs, %d actor "
                         "records, %d nodes, %d placement groups from %s",
                         len(self._kv), len(data.get("jobs", {})),
@@ -561,7 +618,17 @@ class GcsServer:
                         # head keeps the map (satellite: a restored head
                         # must not forget PGs whose bundles still run)
                         "pgs": {pid: dict(p)
-                                for pid, p in self._pgs.items()}}
+                                for pid, p in self._pgs.items()},
+                        # hot env keys as AGES (monotonic stamps don't
+                        # cross processes): warm onboarding survives a
+                        # head replacement
+                        "hot_envs": {
+                            k: {"age_s": max(0.0, time.monotonic()
+                                             - rec.get("last_seen", 0.0)),
+                                "runtime_env": rec.get("runtime_env")}
+                            for k, rec in self._hot_envs.items()
+                            if time.monotonic() - rec.get("last_seen", 0.0)
+                            <= self._HOT_ENV_TTL_S}}
                 self._dirty = False
             try:
                 self._snapshot_last_version = self._snapshots.save(
@@ -833,11 +900,14 @@ class GcsServer:
         self._install_node(payload)
         with self._lock:
             nodes = [self._public_node(n) for n in self._nodes]
+            hot = self._hot_envs_payload_locked()
         # epoch + session ride the reply: the raylet uses the epoch to fence
         # stale-head announces and the session id as its re-adoption
-        # fingerprint across head promotions
+        # fingerprint across head promotions; hot_envs is the warm-onboarding
+        # hint — the joiner pre-spawns fork templates for these keys so a
+        # replacement node serves warm leases immediately
         return {"nodes": nodes, "epoch": self.fence_epoch,
-                "session_id": self.session_id}
+                "session_id": self.session_id, "hot_envs": hot}
 
     def _install_node(self, payload: dict,
                       client: Optional[rpc.RpcClient] = None) -> None:
@@ -872,6 +942,9 @@ class GcsServer:
                     self._raylet_clients[node_id] = rpc.connect_with_retry(payload["address"], timeout=10)
                 except Exception:
                     logger.exception("GCS could not connect back to raylet %s", payload["address"])
+            # fresh capacity: every capacity-starved restart is due NOW
+            for aid in self._pending_restarts:
+                self._pending_restarts[aid] = 0.0
         if stale is not None and stale is not client:
             stale.close()
         # Bundle re-pinning: the raylet reports the PG bundle reservations
@@ -880,6 +953,7 @@ class GcsServer:
         # the known PG table so placement reflects what the fleet actually
         # holds (the raylet, not the snapshot, is the source of truth for
         # reservations it charged).
+        stale_bundles = []
         with self._lock:
             for b in payload.get("bundles", ()):
                 pg = self._pgs.get(b["pg_id"])
@@ -887,10 +961,34 @@ class GcsServer:
                     continue
                 placement = pg.get("placement")
                 idx = b["bundle_index"]
-                if placement is not None and idx < len(placement) \
-                        and placement[idx] != node_id:
+                if placement is None or idx >= len(placement) \
+                        or placement[idx] == node_id:
+                    continue
+                holder = self._nodes.get(placement[idx])
+                if holder is not None and holder.get("alive"):
+                    # the bundle was rescheduled onto a LIVE node while
+                    # this raylet was away (falsely-dead node, heartbeat
+                    # starvation, re-registering after the bundle resched
+                    # moved its bundles): this raylet's reservation is the
+                    # stale one — return it instead of stealing the
+                    # placement back and leaking the live holder's charge
+                    stale_bundles.append((b["pg_id"], idx))
+                else:
                     placement[idx] = node_id
                     self._dirty = True
+        for pg_id, idx in stale_bundles:
+            c = self._raylet_client(node_id)
+            if c is None:
+                break
+            try:
+                c.notify("return_bundle",
+                         {"pg_id": pg_id, "bundle_index": idx})
+                logger.warning("raylet %s re-registered holding bundle "
+                               "(%s, %d) that was rescheduled; returning "
+                               "its stale reservation",
+                               node_id.hex()[:8], pg_id, idx)
+            except OSError:
+                pass
         self._publish(CH_NODES, {"event": "added", "node": self._public_node(node_id)})
         self._broadcast_resources(force=True)
 
@@ -901,6 +999,10 @@ class GcsServer:
             "resources_available", "labels", "alive")}
         if n.get("stats"):
             out["stats"] = n["stats"]
+        if n.get("join_to_first_warm_lease_s") is not None:
+            # warm-onboarding observability: how long this node took from
+            # join to its first forked lease (set once, by report_warm_lease)
+            out["join_to_first_warm_lease_s"] = n["join_to_first_warm_lease_s"]
         return out
 
     def rpc_heartbeat(self, conn, req_id, payload):
@@ -924,6 +1026,68 @@ class GcsServer:
                     n["stats"] = stats
                 else:
                     n.pop("stats", None)
+            # hot runtime-env tracking (warm node onboarding): raylets
+            # report env keys with recent lease traffic; joiners get the
+            # fleet-wide view in their register_node reply
+            now_mono = time.monotonic()
+            for ent in payload.get("hot_envs", ()):
+                key = ent.get("env_key")
+                rec = self._hot_envs.setdefault(key, {})
+                rec["last_seen"] = now_mono
+                if ent.get("runtime_env") is not None:
+                    rec["runtime_env"] = ent["runtime_env"]
+            # opportunistic prune: keys cold past the TTL leave the table
+            # (and the snapshot) instead of accumulating across env churn
+            for key in [k for k, rec in self._hot_envs.items()
+                        if now_mono - rec.get("last_seen", 0.0)
+                        > self._HOT_ENV_TTL_S]:
+                del self._hot_envs[key]
+        return True
+
+    _HOT_ENV_TTL_S = 600.0
+
+    def _hot_envs_payload_locked(self) -> list:
+        """Caller holds self._lock. Recently-hot env keys (most recent
+        first, capped) for a joining raylet's template prewarm."""
+        now = time.monotonic()
+        out = []
+        for key, rec in sorted(self._hot_envs.items(),
+                               key=lambda kv: -kv[1].get("last_seen", 0.0)):
+            if now - rec.get("last_seen", 0.0) > self._HOT_ENV_TTL_S:
+                continue
+            out.append({"env_key": key,
+                        "runtime_env": rec.get("runtime_env")})
+            if len(out) >= 8:
+                break
+        return out
+
+    def rpc_autoscaler_report(self, conn, req_id, payload):
+        """The autoscaler's reconcile counters (launches, relaunches,
+        deaths seen, breaker state), refreshed every tick; surfaced via
+        gcs_stats so node-level recovery is observable in one place."""
+        with self._lock:
+            self._autoscaler_stats = dict(payload or {})
+        return True
+
+    def rpc_report_warm_lease(self, conn, req_id, payload):
+        """A joined raylet served its first WARM (forked) lease: the far
+        edge of node-join-to-first-warm-lease — the number warm onboarding
+        exists to shrink."""
+        sample = {"node_id": payload["node_id"].hex(),
+                  "join_to_first_warm_lease_s":
+                      float(payload["join_to_first_warm_lease_s"]),
+                  "at": time.time()}
+        with self._lock:
+            self._warm_lease_joins.append(sample)
+            n = self._nodes.get(payload["node_id"])
+            if n is not None:
+                n["join_to_first_warm_lease_s"] = \
+                    sample["join_to_first_warm_lease_s"]
+        try:
+            _node_metrics()["join_warm"].set(
+                sample["join_to_first_warm_lease_s"])
+        except Exception:
+            pass
         return True
 
     def rpc_get_pending_demands(self, conn, req_id, payload):
@@ -1079,9 +1243,189 @@ class GcsServer:
             # failure, capacity that has since arrived): re-run their 2PC
             # off-thread, paced, so a blip never strands a group forever.
             self._maybe_retry_pending_pgs()
+            # actors whose restart found no capacity (node death ahead of
+            # the replacement) retry here until a node can hold them
+            self._maybe_retry_actor_restarts()
+            # bundles stranded on dead nodes move to live capacity
+            self._maybe_reschedule_lost_bundles()
             # still-provisional snapshot-restored nodes get re-dialed (with
             # the fencing epoch) until they adopt us or the reaper wins
             self._maybe_reannounce_restored()
+
+    _RESTART_RETRY_INTERVAL_S = 1.0
+
+    def _maybe_retry_actor_restarts(self) -> None:
+        """Paced, off-thread re-scheduling of RESTARTING actors that had no
+        capacity at failure time (reference GcsActorManager keeps such
+        actors PENDING until a node can hold them). A node registration
+        makes every entry immediately due (_install_node)."""
+        now = time.monotonic()
+        with self._lock:
+            if self._restart_retry_active or self._shutdown.is_set():
+                return
+            due = [aid for aid, t in self._pending_restarts.items()
+                   if now >= t]
+            if not due:
+                return
+            self._restart_retry_active = True
+
+        def run():
+            try:
+                pending_timeout = get_config().actor_restart_pending_timeout_s
+                for aid in due:
+                    if self._shutdown.is_set():
+                        return
+                    expired = None
+                    with self._lock:
+                        info = self._actors.get(aid)
+                        if info is None \
+                                or info.state != ActorState.RESTARTING:
+                            self._pending_restarts.pop(aid, None)
+                            self._pending_restart_since.pop(aid, None)
+                            continue
+                        since = self._pending_restart_since.get(aid)
+                        if since is not None and pending_timeout > 0 and \
+                                time.monotonic() - since > pending_timeout:
+                            # the wait is bounded: a restart nothing can
+                            # ever place (node type unlaunchable, breaker
+                            # stuck open) must fail typed, not hang refs
+                            info.state = ActorState.DEAD
+                            info.death_cause = (
+                                "restart failed: no feasible capacity "
+                                f"within {pending_timeout:.0f}s")
+                            self._pending_restarts.pop(aid, None)
+                            self._pending_restart_since.pop(aid, None)
+                            self._dirty = True
+                            expired = info
+                    if expired is not None:
+                        logger.warning("actor %s restart expired after "
+                                       "%.0fs with no capacity; marking "
+                                       "DEAD", aid, pending_timeout)
+                        self._publish(CH_ACTORS, {
+                            "actor_id": aid, "state": expired.state.value,
+                            "address": "",
+                            "death_cause": expired.death_cause})
+                        continue
+                    if self._schedule_actor(aid, require_available=True):
+                        with self._lock:
+                            self._pending_restarts.pop(aid, None)
+                            self._pending_restart_since.pop(aid, None)
+                    else:
+                        with self._lock:
+                            self._pending_restarts[aid] = time.monotonic() \
+                                + self._RESTART_RETRY_INTERVAL_S
+            finally:
+                with self._lock:
+                    self._restart_retry_active = False
+
+        threading.Thread(target=run, name="gcs-actor-restart-retry",
+                         daemon=True).start()
+
+    _BUNDLE_RESCHED_INTERVAL_S = 2.0
+
+    def _maybe_reschedule_lost_bundles(self) -> None:
+        """CREATED placement groups with bundles on dead nodes get those
+        bundles re-placed on surviving/replacement capacity (reference
+        GcsPlacementGroupManager bundle rescheduling on node death). Only
+        the LOST bundles move — surviving reservations are never touched,
+        so no double-charge and no full re-placement churn."""
+        now = time.monotonic()
+        with self._lock:
+            if self._bundle_resched_active or self._shutdown.is_set():
+                return
+            alive = {nid for nid, n in self._nodes.items() if n["alive"]}
+            work = []
+            for pid, p in self._pgs.items():
+                if p.get("state") != "CREATED" or not p.get("placement"):
+                    continue
+                lost = [i for i, nid in enumerate(p["placement"])
+                        if nid not in alive]
+                if lost and now - p.get("_last_resched", 0.0) \
+                        > self._BUNDLE_RESCHED_INTERVAL_S:
+                    work.append((pid, lost))
+            if not work:
+                return
+            self._bundle_resched_active = True
+
+        def run():
+            try:
+                for pid, lost in work:
+                    if self._shutdown.is_set():
+                        return
+                    try:
+                        self._reschedule_bundles(pid, lost)
+                    except Exception:
+                        logger.exception("bundle reschedule of %s failed",
+                                         pid)
+            finally:
+                with self._lock:
+                    self._bundle_resched_active = False
+
+        threading.Thread(target=run, name="gcs-bundle-resched",
+                         daemon=True).start()
+
+    def _reschedule_bundles(self, pg_id: PlacementGroupID,
+                            lost_indices: List[int]) -> None:
+        with self._lock:
+            p = self._pgs.get(pg_id)
+            if p is None or p.get("state") != "CREATED":
+                return
+            p["_last_resched"] = time.monotonic()
+            bundles = p["bundles"]
+            placement = list(p["placement"])
+            strategy = p["strategy"]
+            views = [
+                NodeView(nid, n["resources_total"],
+                         n["resources_available"], n["labels"])
+                for nid, n in self._nodes.items() if n["alive"]]
+        held = {placement[i] for i in range(len(placement))
+                if i not in lost_indices}
+        for idx in lost_indices:
+            bundle = bundles[idx]
+            candidates = views
+            if strategy == "STRICT_SPREAD":
+                candidates = [v for v in views if v.node_id not in held]
+            elif strategy == "STRICT_PACK":
+                # co-locate with surviving bundles when possible; a strict
+                # pack broken by node death prefers partial locality over
+                # staying broken forever
+                candidates = [v for v in views if v.node_id in held] or views
+            avail = [v for v in candidates if v.is_available(bundle)]
+            if not avail:
+                continue  # paced retry finds replacement capacity later
+            target = min(avail,
+                         key=lambda v: (v.utilization(), v.node_id)).node_id
+            client = self._raylet_client(target)
+            if client is None:
+                continue
+            try:
+                if not client.call("prepare_bundle", {
+                        "pg_id": pg_id, "bundle_index": idx,
+                        "resources": bundle}, timeout=10):
+                    continue
+                client.notify("commit_bundle",
+                              {"pg_id": pg_id, "bundle_index": idx})
+            except (OSError, TimeoutError, rpc.RpcCallError,
+                    rpc.RpcDisconnected) as e:
+                logger.info("bundle reschedule prepare on %s failed: %s",
+                            target.hex()[:8], e)
+                continue
+            with self._lock:
+                p = self._pgs.get(pg_id)
+                if p is None or not p.get("placement") \
+                        or idx >= len(p["placement"]):
+                    # group removed while we re-placed: return the bundle
+                    try:
+                        client.notify("return_bundle", {
+                            "pg_id": pg_id, "bundle_index": idx})
+                    except OSError:
+                        pass
+                    continue
+                p["placement"][idx] = target
+                self._dirty = True
+            held.add(target)
+            logger.warning("rescheduled bundle (%s, %d) onto %s after node "
+                           "death", pg_id, idx, target.hex()[:8])
 
     _PG_RETRY_INTERVAL_S = 5.0
 
@@ -1170,6 +1514,20 @@ class GcsServer:
             self._bcast_dirty.discard(node_id.hex())
             self._bcast_full_needed = True  # topology: next publish is full
             client = self._raylet_clients.pop(node_id, None)
+            tag = reason.replace(" ", "_")
+            if tag == "drained":
+                # graceful removal (autoscaler downscale, operator drain)
+                # is not a DEATH: counting it would make the headline
+                # failure metric fire on routine scale-down
+                self._node_drains += 1
+                tag = None
+            else:
+                self._node_deaths[tag] = self._node_deaths.get(tag, 0) + 1
+        if tag is not None:
+            try:
+                _node_metrics()["deaths"].inc(tags={"reason": tag})
+            except Exception:
+                pass
         if client:
             client.close()
         self._publish(CH_NODES, {"event": "removed", "node_id": node_id, "reason": reason})
@@ -1179,6 +1537,33 @@ class GcsServer:
             affected = [a for a in self._actors.values() if a.node_id == node_id and a.state == ActorState.ALIVE]
         for info in affected:
             self._handle_actor_failure(info.actor_id, f"node {node_id.hex()[:8]} died: {reason}")
+        # A creation/restart DISPATCHED to this node before it died will
+        # never report actor_creation_done, and a successful dispatch left
+        # _pending_restarts — nothing retries it. Re-park such actors
+        # due-now for the paced retry (no budget charge: that incarnation
+        # never ran). This is the kill-storm race — a second node kill
+        # landing inside another restart's dispatch->done window.
+        with self._lock:
+            now = time.monotonic()
+            stranded = []
+            for a in self._actors.values():
+                if a.node_id == node_id and a.state in (
+                        ActorState.PENDING, ActorState.RESTARTING):
+                    a.state = ActorState.RESTARTING
+                    a.address = ""
+                    self._pending_restarts[a.actor_id] = 0.0
+                    self._pending_restart_since.setdefault(a.actor_id, now)
+                    stranded.append(a.actor_id)
+            if stranded:
+                self._dirty = True
+        for aid in stranded:
+            logger.warning("actor %s creation was in flight on dead node "
+                           "%s; re-parking for retry", aid,
+                           node_id.hex()[:8])
+            self._publish(CH_ACTORS, {"actor_id": aid, "state": "RESTARTING",
+                                      "address": "", "death_cause": ""})
+        # bundles the dead node held move to surviving/replacement nodes
+        self._maybe_reschedule_lost_bundles()
 
     # ---------------------------------------------------------------- kv
     def rpc_kv_put(self, conn, req_id, payload):
@@ -1273,6 +1658,20 @@ class GcsServer:
                      "bytes_sent": self._bcast_bytes,
                      "delta_enabled":
                          get_config().resource_broadcast_delta_enabled}
+            joins = list(self._warm_lease_joins)
+            node_failure = {
+                "deaths_by_reason": dict(self._node_deaths),
+                "deaths_total": sum(self._node_deaths.values()),
+                "drains_total": self._node_drains,
+                "autoscaler": dict(self._autoscaler_stats),
+                "pending_actor_restarts": len(self._pending_restarts),
+                "hot_env_keys": [e["env_key"]
+                                 for e in self._hot_envs_payload_locked()],
+                "warm_lease_joins": joins[-10:],
+                "node_join_to_first_warm_lease_s":
+                    joins[-1]["join_to_first_warm_lease_s"] if joins
+                    else None,
+            }
         return {
             "address": self._server.address,
             "session_id": self.session_id,
@@ -1286,6 +1685,7 @@ class GcsServer:
                           "uri": self._snapshot_uri},
             "fencing_rejections": self._fencing_rejections,
             "broadcast": bcast,
+            "node_failure": node_failure,
             "promotion": dict(self.promotion) if self.promotion else None,
         }
 
@@ -1471,9 +1871,16 @@ class GcsServer:
             return {"error": err}
         return {"ok": True}
 
-    def _schedule_actor(self, actor_id: ActorID) -> bool:
+    def _schedule_actor(self, actor_id: ActorID,
+                        require_available: bool = False) -> bool:
         """Pick a node for the actor and ask its raylet to create it
-        (cf. GcsActorScheduler::Schedule, gcs_actor_scheduler.cc:49)."""
+        (cf. GcsActorScheduler::Schedule, gcs_actor_scheduler.cc:49).
+
+        `require_available=True` (the RESTART path) only accepts nodes that
+        can hold the actor's demand NOW: a restart after node death must
+        land on a surviving node with capacity or WAIT for the autoscaler's
+        replacement (pending-restart retry) — queuing it on a full survivor
+        would strand it behind capacity that may never free."""
         with self._lock:
             spec = self._actor_specs.get(actor_id)
             if spec is None:
@@ -1485,13 +1892,35 @@ class GcsServer:
                 for nid, n in self._nodes.items()
                 if n["alive"]
             ]
+        if require_available and spec.scheduling.placement_group_id is None:
+            views = [v for v in views if v.is_available(spec.resources)]
         target = self._policy.select_node(views, spec.resources, spec.scheduling, prefer_node=None,
                                           pg_table=self._pgs)
         if target is None:
             return False
+        if require_available:
+            # PG-routed restarts come back as the bundle's node: reject a
+            # dead one (its bundle is awaiting reschedule) instead of
+            # dispatching into the void
+            with self._lock:
+                n = self._nodes.get(target)
+                if n is None or not n.get("alive"):
+                    return False
         with self._lock:
             info = self._actors[actor_id]
             info.node_id = target
+            # optimistic charge of the head's resource view: without it a
+            # burst of creations all reads the same stale availability and
+            # piles onto one node (the raylet's charge only flows back on
+            # its next debounced report). The raylet's reports overwrite
+            # the view wholesale, so this converges to truth either way.
+            if spec.scheduling.placement_group_id is None:
+                n = self._nodes.get(target)
+                if n is not None:
+                    avail = n["resources_available"]
+                    for r, q in spec.resources.items():
+                        avail[r] = avail.get(r, 0.0) - q
+                    self._bcast_dirty.add(target.hex())
         client = self._raylet_client(target)
         if client is None:
             return False
@@ -1523,9 +1952,31 @@ class GcsServer:
                 if spec.name:
                     self._named_actors[(spec.namespace, spec.name)] = actor_id
             if payload.get("success", True):
-                info.state = ActorState.ALIVE
-                info.address = payload["address"]
-                info.node_id = payload["node_id"]
+                n = self._nodes.get(payload["node_id"])
+                if n is not None and not n.get("alive", True):
+                    # success racing the node's death (the creation landed,
+                    # then the node was killed): the address is a corpse —
+                    # keep the actor RESTARTING and let the paced retry
+                    # place it on live capacity instead. An UNKNOWN node
+                    # stays on the ALIVE path: after a GCS restart the
+                    # done can beat the node's re-registration, and
+                    # re-parking then would double-create the actor.
+                    info.state = ActorState.RESTARTING
+                    info.address = ""
+                    info.node_id = payload["node_id"]
+                    self._pending_restarts[actor_id] = 0.0
+                    self._pending_restart_since.setdefault(
+                        actor_id, time.monotonic())
+                    self._dirty = True
+                    logger.warning("actor %s creation reported from dead "
+                                   "node %s; re-parking for retry",
+                                   actor_id, payload["node_id"].hex()[:8])
+                else:
+                    info.state = ActorState.ALIVE
+                    info.address = payload["address"]
+                    info.node_id = payload["node_id"]
+                    self._pending_restarts.pop(actor_id, None)
+                    self._pending_restart_since.pop(actor_id, None)
             else:
                 info.state = ActorState.DEAD
                 info.death_cause = payload.get("error", "creation failed")
@@ -1560,6 +2011,8 @@ class GcsServer:
             info.address = payload["address"]
             info.node_id = payload.get("node_id")
             self._awaiting_rereg.pop(actor_id, None)
+            self._pending_restarts.pop(actor_id, None)
+            self._pending_restart_since.pop(actor_id, None)
             if spec is not None:
                 self._actor_specs[actor_id] = spec
                 if spec.name:
@@ -1592,12 +2045,20 @@ class GcsServer:
         if info.state == ActorState.RESTARTING:
             self._publish(CH_ACTORS, {"actor_id": actor_id, "state": info.state.value,
                                       "address": "", "death_cause": ""})
-            if not self._schedule_actor(actor_id):
+            if not self._schedule_actor(actor_id, require_available=True):
+                # No capacity RIGHT NOW (the actor's node just died and its
+                # replacement hasn't joined): keep it RESTARTING and let the
+                # paced health-loop retry land it on a surviving or
+                # replacement node — killing it here would turn every
+                # transient capacity dip into a permanent actor loss.
                 with self._lock:
-                    info.state = ActorState.DEAD
-                    info.death_cause = f"restart failed: {reason}"
-                self._publish(CH_ACTORS, {"actor_id": actor_id, "state": info.state.value,
-                                          "address": "", "death_cause": info.death_cause})
+                    if info.state == ActorState.RESTARTING:
+                        self._pending_restarts[actor_id] = time.monotonic() \
+                            + self._RESTART_RETRY_INTERVAL_S
+                        self._pending_restart_since.setdefault(
+                            actor_id, time.monotonic())
+                logger.info("actor %s restart has no feasible capacity yet; "
+                            "queued for paced retry", actor_id)
         else:
             self._publish(CH_ACTORS, {"actor_id": actor_id, "state": info.state.value,
                                       "address": "", "death_cause": info.death_cause})

@@ -220,10 +220,15 @@ class Raylet:
             self.gcs_address, push_handler=self._on_gcs_push,
             on_reconnect=self._replay_gcs_registration,
             resolve=self._resolve_gcs_address)
+        self._joined_at = time.monotonic()
         reply = self._gcs.call("register_node", self._registration_payload())
         self._note_head_identity(reply)
         for n in reply["nodes"]:
             self._note_node(n)
+        # warm node onboarding: pre-spawn fork templates for the fleet's
+        # hot runtime-env keys so this node serves warm leases immediately
+        # (node-join-to-first-warm-lease is the tracked number)
+        self._worker_pool.prewarm(reply.get("hot_envs"))
         self._gcs.call("subscribe", {"channels": ["resources", "nodes", "control"]})
         t = threading.Thread(target=self._heartbeat_loop, name="raylet-heartbeat", daemon=True)
         t.start()
@@ -299,6 +304,7 @@ class Raylet:
             self._bcast_seen_seq = None  # new head: wait for its first full
         raw.call("subscribe", {"channels": ["resources", "nodes", "control"]},
                  timeout=30)
+        self._worker_pool.prewarm(reply.get("hot_envs"))
         logger.info("raylet %s re-registered with GCS at %s (epoch %s)",
                     self.node_id.hex()[:8], raw.address,
                     reply.get("epoch"))
@@ -394,6 +400,58 @@ class Raylet:
         current gcs_address is the freshest in-band answer."""
         return self._gcs_address_override or self.gcs_address
 
+    def note_first_warm_lease(self, seconds: float) -> None:
+        """Pool callback: this node served its FIRST warm (forked) lease
+        `seconds` after joining. One-shot, best-effort report to the GCS
+        (ray_tpu_node_join_warm_lease_seconds + gcs_stats)."""
+        try:
+            self._gcs.notify("report_warm_lease", {
+                "node_id": self.node_id.binary(),
+                "join_to_first_warm_lease_s": seconds})
+        except (OSError, RuntimeError) as e:
+            logger.debug("warm-lease report lost (GCS down?): %s", e)
+
+    def crash(self) -> None:
+        """Whole-node crash for the chaos harness: the raylet, its workers
+        and its fork templates die together — SIGKILL, no graceful
+        teardown, no drain notify. The GCS must detect this through missed
+        heartbeats alone, exactly like a real node loss."""
+        self._shutdown.set()
+        try:
+            self._worker_pool.kill_all()
+        except Exception:
+            logger.exception("worker pool kill_all failed")
+        with self._lock:
+            workers = list(self._workers.values())
+            starting = list(self._starting)
+        for p in starting:
+            try:
+                p.kill()
+            except OSError:
+                pass
+        for w in workers:
+            if w.is_driver:
+                continue  # the driver is not OUR process tree
+            try:
+                if w.proc is not None:
+                    w.proc.kill()
+                else:
+                    os.kill(w.pid, 9)
+            except OSError:
+                pass
+        if self._gcs:
+            self._gcs.close()
+        # snapshot under the lock: concurrent _peer() dials install into
+        # this dict, and an unlocked iteration can raise mid-teardown
+        with self._lock:
+            clients = list(self._raylet_clients.values())
+        for c in clients:
+            c.close()
+        self._data_pool.close()
+        self._data_plane.stop()
+        self._server.stop()
+        self.store.shutdown()
+
     def stop(self) -> None:
         self._shutdown.set()
         self._worker_pool.stop()
@@ -429,7 +487,11 @@ class Raylet:
                         pass  # exited between wait and kill
         if self._gcs:
             self._gcs.close()
-        for c in self._raylet_clients.values():
+        # snapshot under the lock: concurrent _peer() dials install into
+        # this dict, and an unlocked iteration can raise mid-teardown
+        with self._lock:
+            clients = list(self._raylet_clients.values())
+        for c in clients:
             c.close()
         self._data_pool.close()
         self._data_plane.stop()
@@ -541,11 +603,20 @@ class Raylet:
             }
 
     def _peer(self, address: str) -> rpc.RpcClient:
+        # Dial OUTSIDE self._lock: this is the raylet's main state lock,
+        # and connect_with_retry spins its full timeout when the target is
+        # dead (an owner whose node was killed). Holding the lock through
+        # that stalls heartbeats and task dispatch for seconds per corpse.
         with self._lock:
             c = self._raylet_clients.get(address)
             if c is not None and not c.closed:
                 return c
-            c = rpc.connect_with_retry(address, timeout=3)
+        c = rpc.connect_with_retry(address, timeout=3)
+        with self._lock:
+            existing = self._raylet_clients.get(address)
+            if existing is not None and not existing.closed:
+                c.close()
+                return existing
             self._raylet_clients[address] = c
             return c
 
@@ -580,6 +651,9 @@ class Raylet:
                     "resources_available": dict(self.resources_available),
                     "pending_demands": demands,
                     "node_stats": self._node_stats(),
+                    # recent lease traffic per env key: feeds the GCS
+                    # hot-env table that joining nodes prewarm from
+                    "hot_envs": self._worker_pool.hot_envs(),
                 }, timeout=5)
             except Exception:
                 if not self._shutdown.is_set():
@@ -1365,6 +1439,17 @@ class Raylet:
         try:
             peer = self._peer(v["address"])
             peer.notify("submit_task", {"spec": qt.spec, "spillback_count": qt.spillback_count + 1})
+            # Tell the owner where its task went (best-effort): a spilled
+            # task can only reach one hop, so this is its node of record —
+            # if that whole node later dies (raylet included), the owner's
+            # node-death failover is the only surviving signal.
+            try:
+                self._peer(qt.spec.owner_address).notify("task_spilled", {
+                    "task_id": qt.spec.task_id,
+                    "node_id": bytes.fromhex(target_hex)})
+            except Exception:
+                logger.debug("task_spilled notify to owner lost",
+                             exc_info=True)
             return True
         except Exception:
             # Mark the target suspect so we do not deterministically re-pick

@@ -279,6 +279,25 @@ class CoreWorker:
         # compound read-modify-write goes through _pending_lock.
         self._pending_tasks: Dict[TaskID, list] = {}
         self._pending_lock = threading.Lock()
+        # node-level failure domain: last known node (binary id) a pending
+        # task was spilled to. A raylet that spills a task notifies the
+        # owner (rpc_task_spilled); when the GCS announces that node's death
+        # on the nodes channel — or a post-reconnect reconciliation finds it
+        # gone — the owner fails the task over exactly as if the raylet had
+        # pushed task_worker_died (the raylet is dead and never will).
+        # Guarded by _pending_lock; entries die with their pending entry.
+        self._task_locations: Dict[TaskID, bytes] = {}
+        # workers subscribe to the nodes channel LAZILY, on their first
+        # spill notification — most (and every warm-forked) worker never
+        # owns a spilled task, and an eager subscribe would put a blocking
+        # GCS RPC + a permanent fan-out target on the ~1 ms fork hot path.
+        # Drivers subscribe eagerly at registration. Guarded by
+        # _pending_lock.
+        self._nodes_subscribed = False
+        # two-strike absence tracking for the post-reconnect reconciliation:
+        # a node missing from get_all_nodes may simply not have re-registered
+        # yet, so only a node absent across two spaced checks fails over.
+        self._absent_nodes: set = set()
 
         # actor state (when this worker hosts an actor)
         self.actor_id: Optional[ActorID] = None
@@ -366,22 +385,48 @@ class CoreWorker:
                 "job_id": self.job_id.binary(),
                 "driver_address": self._server.address,
             })
-            channels = ["actors"]
+            # "nodes" rides along: node death is an OWNER-side failure
+            # signal — a task spilled to a raylet that dies whole-node has
+            # nobody left to push task_worker_died, so the owner reacts to
+            # the GCS membership event instead.
+            channels = ["actors", "nodes"]
             if self.log_to_driver:
                 channels.append("logs")
             self.gcs.call("subscribe", {"channels": channels})
+            with self._pending_lock:
+                self._nodes_subscribed = True
+        # workers own the subtasks they submit and get the same node-death
+        # signal, but subscribe lazily on their first spill notification
+        # (_ensure_nodes_subscribed) — see _nodes_subscribed.
 
     # ------------------------------------------------------------------ util
     @property
     def address(self) -> str:
         return self._server.address
 
-    def peer(self, address: str) -> rpc.RpcClient:
+    def peer(self, address: str,
+             connect_timeout_s: Optional[float] = None) -> rpc.RpcClient:
+        """Cached connection to another worker/raylet. The dial happens
+        OUTSIDE the cache lock: connect_with_retry spins for the full
+        connect timeout when the target is dead (SIGKILLed worker whose
+        address we still hold), and holding the lock through that would
+        serialize every other peer() caller in the process behind one
+        corpse — under a node kill storm that stalls submissions to
+        perfectly healthy actors for 30 s at a time."""
         with self._peers_lock:
             c = self._peers.get(address)
             if c is not None and not c.closed:
                 return c
-            c = rpc.connect_with_retry(address, timeout=get_config().rpc_connect_timeout_s)
+        c = rpc.connect_with_retry(
+            address,
+            timeout=connect_timeout_s or get_config().rpc_connect_timeout_s)
+        with self._peers_lock:
+            existing = self._peers.get(address)
+            if existing is not None and not existing.closed:
+                # a concurrent dial won the install race: use the shared
+                # client, drop ours
+                c.close()
+                return existing
             self._peers[address] = c
             return c
 
@@ -1275,6 +1320,7 @@ class CoreWorker:
                 retries_left = pend[1]
             else:
                 self._pending_tasks.pop(task_id, None)
+            self._task_locations.pop(task_id, None)
         if retry:
             delay = get_config().task_retry_delay_ms / 1000.0
             spec = pend[0]
@@ -1496,6 +1542,95 @@ class CoreWorker:
                 "queued": self._task_queue.qsize(),
                 "load": self._load_count}
 
+    def rpc_task_spilled(self, conn, req_id, payload):
+        """Raylet push: our task was spilled to another node. Recording the
+        target is what lets node-level failure reach the owner — when that
+        node dies whole (raylet included), no raylet survives to push
+        task_worker_died, so the owner fails over on the GCS membership
+        event instead (see _fail_tasks_on_node)."""
+        task_id: TaskID = payload["task_id"]
+        with self._pending_lock:
+            if task_id in self._pending_tasks:
+                self._task_locations[task_id] = payload["node_id"]
+        self._ensure_nodes_subscribed()
+        return True
+
+    def _ensure_nodes_subscribed(self) -> None:
+        """Lazy nodes-channel subscription: first spill only (workers).
+        After the subscribe lands, one spaced reconciliation covers a node
+        death that slipped into the subscribe race window."""
+        with self._pending_lock:
+            if self._nodes_subscribed:
+                return
+            self._nodes_subscribed = True
+        try:
+            self.gcs.call("subscribe", {"channels": ["nodes"]})
+        except Exception:
+            with self._pending_lock:
+                self._nodes_subscribed = False
+            logger.warning("nodes-channel subscribe failed; relying on "
+                           "reconciliation", exc_info=True)
+            return
+        t = threading.Timer(3.0, self._reconcile_task_locations)
+        t.daemon = True
+        t.start()
+
+    def _fail_tasks_on_node(self, node_id: bytes, reason: str) -> None:
+        """Node-death failover: every pending task last seen on `node_id`
+        is treated exactly like a worker death there (retry budget applies).
+        Popping the location first makes the event + reconciliation paths
+        idempotent — a task only fails over once per (re)submission; its
+        next spill records a fresh location."""
+        with self._pending_lock:
+            doomed = [tid for tid, loc in self._task_locations.items()
+                      if loc == node_id]
+            for tid in doomed:
+                self._task_locations.pop(tid, None)
+        for tid in doomed:
+            logger.warning("task %s was on dead node %s; failing over",
+                           tid, node_id.hex()[:8])
+            self.rpc_task_worker_died(None, 0, {
+                "task_id": tid, "reason": f"node died: {reason}"})
+
+    def _reconcile_task_locations(self) -> None:
+        """Post-reconnect backstop for missed node-removal events: compare
+        recorded spill locations against the rebuilt GCS membership. A node
+        PRESENT but dead fails over immediately; a node ABSENT might just
+        not have re-registered yet (a fresh no-snapshot head starts empty),
+        so absence only counts on the second spaced check."""
+        with self._pending_lock:
+            locs = {tid: loc for tid, loc in self._task_locations.items()}
+        if not locs:
+            return
+        try:
+            nodes = self.gcs.call("get_all_nodes", {}, timeout=10)
+        except Exception:
+            logger.debug("task-location reconcile fetch failed",
+                         exc_info=True)
+            return
+        present = {n["node_id"]: n.get("alive", True) for n in nodes}
+        rearm = False
+        for node_id in set(locs.values()):
+            alive = present.get(node_id)
+            if alive is False:
+                self._fail_tasks_on_node(node_id, "dead after GCS restart")
+            elif alive is None:
+                if node_id in self._absent_nodes:
+                    self._absent_nodes.discard(node_id)
+                    self._fail_tasks_on_node(
+                        node_id, "gone after GCS restart")
+                else:
+                    # first strike: give the raylet one more window to
+                    # re-register before declaring its tasks lost
+                    self._absent_nodes.add(node_id)
+                    rearm = True
+            else:
+                self._absent_nodes.discard(node_id)
+        if rearm:
+            t = threading.Timer(5.0, self._reconcile_task_locations)
+            t.daemon = True
+            t.start()
+
     def rpc_task_worker_died(self, conn, req_id, payload):
         """Raylet push: the worker running our task died. Retry or fail."""
         task_id: TaskID = payload["task_id"]
@@ -1503,6 +1638,7 @@ class CoreWorker:
             pend = self._pending_tasks.get(task_id)
             if pend is None:
                 return True
+            self._task_locations.pop(task_id, None)
             spec = pend[0]
             retry = pend[1] > 0
             if retry:
@@ -1543,6 +1679,7 @@ class CoreWorker:
         task_id: TaskID = payload["task_id"]
         with self._pending_lock:
             pend = self._pending_tasks.pop(task_id, None)
+            self._task_locations.pop(task_id, None)
         if pend is None:
             return True
         spec = pend[0]
@@ -1958,7 +2095,15 @@ class CoreWorker:
             if addr is None:
                 return  # _fail_task already called
         try:
-            self.peer(addr).notify("push_actor_task", {"spec": spec})
+            # short dial budget: this address came from a LIVE registration
+            # (GCS state or a pubsub push), so a refused connect means the
+            # actor's worker died — fail fast into the re-resolve path
+            # below instead of spinning the full 30 s connect retry on a
+            # corpse (a node kill makes every stale-address submit hit
+            # this)
+            self.peer(addr, connect_timeout_s=min(
+                5.0, get_config().rpc_connect_timeout_s)).notify(
+                    "push_actor_task", {"spec": spec})
         except Exception:
             # stale address: refresh once, then give up to GCS state
             self._actor_addresses.pop(actor_id, None)
@@ -2048,6 +2193,7 @@ class CoreWorker:
     def _fail_task(self, spec: TaskSpec, err: Exception) -> None:
         with self._pending_lock:
             self._pending_tasks.pop(spec.task_id, None)
+            self._task_locations.pop(spec.task_id, None)
         blob = serialization.dumps(err)
         for oid in spec.return_object_ids():
             with self._obj_lock:
@@ -2115,10 +2261,30 @@ class CoreWorker:
                 "job_id": self.job_id.binary(),
                 "driver_address": self._server.address,
             }, timeout=30)
-            channels = ["actors"]
+            channels = ["actors", "nodes"]
             if self.log_to_driver:
                 channels.append("logs")
             raw.call("subscribe", {"channels": channels}, timeout=30)
+        else:
+            # workers subscribe to the nodes channel LAZILY (first spill
+            # only — see _nodes_subscribed): re-establish the subscription
+            # across the reconnect only if it existed; an unconditional
+            # subscribe would make every warm-forked worker a permanent
+            # nodes-channel fan-out target after any head failover
+            with self._pending_lock:
+                resub = self._nodes_subscribed
+            if resub:
+                raw.call("subscribe", {"channels": ["nodes"]}, timeout=30)
+        # The reconnect window may have swallowed node-removal events for
+        # nodes holding our spilled tasks (the classic pairing: node death
+        # AND a GCS restart). Reconcile the location table against the
+        # rebuilt membership off-thread, after re-registrations settle.
+        with self._pending_lock:
+            has_locs = bool(self._task_locations)
+        if has_locs:
+            t = threading.Timer(3.0, self._reconcile_task_locations)
+            t.daemon = True
+            t.start()
         with self._channel_cb_lock:
             dynamic = [ch for ch, cbs in self._channel_callbacks.items() if cbs]
         if dynamic:
@@ -2183,6 +2349,12 @@ class CoreWorker:
             if job is not None and job != self.job_id.binary():
                 return
             self._log_print_queue().put(msg)
+            return
+        if payload["channel"] == "nodes":
+            msg = payload["message"]
+            if msg.get("event") == "removed":
+                self._fail_tasks_on_node(msg["node_id"],
+                                         msg.get("reason") or "node removed")
             return
         if payload["channel"] == "actors":
             msg = payload["message"]

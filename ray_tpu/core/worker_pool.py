@@ -395,6 +395,12 @@ class WorkerPool:
         self.template_respawns = 0
         self.fork_failures = 0
         self._fork_latencies_ms: deque = deque(maxlen=4096)
+        # node-join -> first-warm-lease (the warm-onboarding number): set
+        # once, when the FIRST forked worker completes registration
+        self.join_to_first_warm_lease_s: Optional[float] = None
+        # recent lease traffic per env key (env_key -> [monotonic, renv]);
+        # shipped to the GCS in heartbeats so joining nodes prewarm
+        self._hot: Dict[Optional[str], list] = {}
         self._thread = threading.Thread(
             target=self._run, name="worker-pool", daemon=True)
         self._thread.start()
@@ -447,6 +453,11 @@ class WorkerPool:
         if needed <= 0 or self._shutdown.is_set():
             return
         with self._cv:
+            # lease-traffic recency per env (warm-onboarding signal)
+            hot = self._hot.setdefault(env_key, [0.0, None])
+            hot[0] = time.monotonic()
+            if runtime_env is not None:
+                hot[1] = runtime_env
             entry = self._pending.get((env_key, kind))
             if entry is None:
                 self._pending[(env_key, kind)] = [needed, runtime_env]
@@ -455,6 +466,40 @@ class WorkerPool:
                 if runtime_env is not None:
                     entry[1] = runtime_env
             self._cv.notify()
+
+    def prewarm(self, hot_envs) -> None:
+        """Warm node onboarding: boot fork templates for the fleet's hot
+        runtime-env keys (shipped in the register_node reply) so this
+        node's FIRST lease of each hot env is a ~1 ms fork instead of a
+        cold multi-second boot. Queued onto the pool serve thread; never
+        blocks the caller (the raylet's registration path)."""
+        if self._shutdown.is_set():
+            return
+        for ent in hot_envs or ():
+            key = ent.get("env_key")
+            with self._cv:
+                hot = self._hot.setdefault(key, [0.0, None])
+                hot[0] = time.monotonic()
+                if ent.get("runtime_env") is not None:
+                    hot[1] = ent["runtime_env"]
+                if (key, "prewarm") not in self._pending:
+                    self._pending[(key, "prewarm")] = \
+                        [0, ent.get("runtime_env")]
+                self._cv.notify()
+
+    def hot_envs(self, ttl_s: float = 300.0) -> List[Dict]:
+        """Env keys with lease traffic in the last `ttl_s` (heartbeat
+        payload -> GCS hot-env table -> joiners' prewarm)."""
+        now = time.monotonic()
+        with self._lock:
+            # prune long-cold keys so env churn can't grow the table
+            # without bound (heartbeats call this every period)
+            for k in [k for k, rec in self._hot.items()
+                      if now - rec[0] > max(ttl_s, 3600.0)]:
+                del self._hot[k]
+            return [{"env_key": k, "runtime_env": rec[1]}
+                    for k, rec in self._hot.items()
+                    if now - rec[0] <= ttl_s]
 
     def stats(self) -> Dict:
         with self._lock:
@@ -476,6 +521,7 @@ class WorkerPool:
                 "fork_failures": self.fork_failures,
                 "fork_p50_ms": _pct(lat, 0.50),
                 "fork_p99_ms": _pct(lat, 0.99),
+                "join_to_first_warm_lease_s": self.join_to_first_warm_lease_s,
                 "templates": tmpl,
             }
 
@@ -485,11 +531,27 @@ class WorkerPool:
         for the adoption race (child registers before the fork reply is
         processed)."""
         warm = forked or bool(getattr(proc, "forked", False))
+        first_warm = False
         with self._lock:
             if warm:
+                if self.registered_warm == 0 \
+                        and self.join_to_first_warm_lease_s is None:
+                    joined = getattr(self._raylet, "_joined_at", None)
+                    if joined is not None:
+                        self.join_to_first_warm_lease_s = round(
+                            time.monotonic() - joined, 3)
+                        first_warm = True
                 self.registered_warm += 1
             else:
                 self.registered_cold += 1
+        if first_warm:
+            # close the node-join -> first-warm-lease measurement at the GCS
+            # (off the pool lock; best-effort one-shot)
+            try:
+                self._raylet.note_first_warm_lease(
+                    self.join_to_first_warm_lease_s)
+            except Exception:
+                logger.debug("first-warm-lease report failed", exc_info=True)
 
     # ----------------------------------------------------------- lifecycle
     def health_tick(self) -> None:
@@ -555,6 +617,24 @@ class WorkerPool:
                 slot.handle.close()
             self._release_env_ref(slot)
 
+    def kill_all(self) -> None:
+        """Whole-node crash simulation: SIGKILL every template outright —
+        no EXIT handshake, no graceful close — the way templates die when
+        their node dies (chaos harness; see Raylet.crash)."""
+        self._shutdown.set()
+        with self._cv:
+            self._pending.clear()
+            slots = list(self._templates.values())
+            self._templates.clear()
+            self._cv.notify_all()
+        for slot in slots:
+            handle = slot.handle
+            if handle is not None:
+                try:
+                    handle.proc.kill()
+                except OSError:
+                    pass
+
     # ------------------------------------------------------------ internals
     def _release_env_ref(self, slot: _TemplateSlot) -> None:
         # check-and-clear under the pool lock: stop() and a failure-retire
@@ -614,6 +694,29 @@ class WorkerPool:
                target: int, kind: str = "demand") -> None:
         raylet = self._raylet
         if self._shutdown.is_set() or raylet._shutdown.is_set():
+            return
+        cfg0 = get_config()
+        if kind == "prewarm":
+            # onboarding: make the TEMPLATE ready, fork nothing — the first
+            # real lease pays ~1 ms instead of a cold boot
+            if not cfg0.worker_template_enabled or not fork_supported():
+                return
+            if env_key is not None:
+                if raylet._env_manager.creation_error(env_key) is not None \
+                        or not self._env_ready(env_key):
+                    return  # env not built on this node: cold path owns it
+            slot = self._slot(env_key, runtime_env)
+            if slot.state != "absent":
+                return
+            if env_key is None:
+                self._boot_template(slot)
+            else:
+                # non-default zygotes boot off-thread, same as _serve's
+                # demand path: a slow venv boot must not block other envs
+                slot.state = "booting"
+                threading.Thread(
+                    target=self._boot_template, args=(slot,),
+                    name="template-prewarm", daemon=True).start()
             return
         if kind == "demand":
             # clamp the (possibly stale) figure to the LIVE backlog before

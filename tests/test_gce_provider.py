@@ -169,3 +169,56 @@ def test_kube_provider_with_autoscaler():
     for nid in ids[1:]:
         p.terminate_node(nid)
     assert p.non_terminated_nodes() == [ids[0]]
+
+
+def test_gce_terminate_404_is_noop():
+    """Idempotent termination (satellite): a DELETE of an already-gone
+    slice (double reap after the node self-died / was preempted away)
+    returns 404 from the cloud — the provider swallows it; any other
+    error still raises."""
+    import io
+    import urllib.error
+
+    cloud = _FakeCloud()
+    p = GceTpuNodeProvider("proj", "z", "gcs:1", request_fn=cloud.request)
+    nid = p.create_node("tpu_16", {"TPU": 16}, {})
+
+    real_request = cloud.request
+
+    def request_404(method, url, body=None, headers=None):
+        if method == "DELETE":
+            raise urllib.error.HTTPError(url, 404, "Not Found", {},
+                                         io.BytesIO(b""))
+        return real_request(method, url, body, headers)
+
+    p._request = request_404
+    p.terminate_node(nid)  # no raise: the node is gone either way
+    p.terminate_node("never-existed")
+
+    def request_500(method, url, body=None, headers=None):
+        if method == "DELETE":
+            raise urllib.error.HTTPError(url, 500, "Server Error", {},
+                                         io.BytesIO(b""))
+        return real_request(method, url, body, headers)
+
+    p._request = request_500
+    import pytest
+
+    with pytest.raises(urllib.error.HTTPError):
+        p.terminate_node(nid)
+
+
+def test_kube_terminate_404_is_noop():
+    import io
+    import urllib.error
+
+    fake = _FakeKube()
+    p = _kube_provider(fake)
+    nid = p.create_node("tpu_8", {"TPU": 8}, {})
+
+    def request_404(method, url, body=None, headers=None):
+        raise urllib.error.HTTPError(url, 404, "Not Found", {},
+                                     io.BytesIO(b""))
+
+    p._request = request_404
+    p.terminate_node(nid)  # pod already deleted: no raise
