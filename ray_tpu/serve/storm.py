@@ -488,8 +488,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
 # Typed errors that are NOT overload responses (shed/timeout are the serve
 # plane doing its job at 4x load): a head failover must not spike these
-# beyond a small fraction of traffic. SERVESTORM_r09 baseline without head
-# kills: replica_death+other = 1.8% of submitted.
+# beyond a small fraction of traffic. Baseline for the --kill-head quick
+# profile this check runs under (HEADFAIL_r11): replica_death+other ~= 6%
+# of submitted. (The full SERVESTORM_r09 profile runs longer with more
+# replica kills and sits near 35% — it is not the baseline here.)
 ERROR_SPIKE_MAX_FRACTION = 0.10
 
 
