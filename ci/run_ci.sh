@@ -11,6 +11,7 @@
 #   ci/run_ci.sh --burst    # warm-pool elasticity burst only
 #   ci/run_ci.sh --failover # standby-head kill-and-promote storm only
 #   ci/run_ci.sh --node-chaos # multi-node kill storm only
+#   ci/run_ci.sh --partition  # partition-heal storm only
 #
 # Stages:
 #   1. native      : arena + scheduler + token-loader compiled whole-program
@@ -48,13 +49,20 @@
 #                    bound, relaunch counts and join->first-warm-lease;
 #                    fails on any undetected kill, unreplaced node, lost
 #                    actor or hung call.
+#   9. partition   : partition-heal storm (--partition --quick): named node
+#                    groups blackholed mid-load; quarantine precedes death,
+#                    actors restart on the replacement, the healed zombie
+#                    is incarnation-fenced and rejoins fresh, the head-in-
+#                    minority cycle starves the lease and the standby
+#                    promotes. Fails on any hung call, duplicate named-
+#                    actor answer, or autoscaler double replacement.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGE="${1:-all}"
 
 run_native() {
-  echo "=== [1/8] native modules under ASan/UBSan ==="
+  echo "=== [1/9] native modules under ASan/UBSan ==="
   mkdir -p build
   g++ -std=c++17 -O1 -g -fsanitize=address,undefined \
       -fno-omit-frame-pointer -o build/sanitize_native \
@@ -66,7 +74,7 @@ run_native() {
 }
 
 run_fast() {
-  echo "=== [2/8] fast test tier ==="
+  echo "=== [2/9] fast test tier ==="
   python -m pytest tests/ -q
   # core-primitives smoke: the submission AND completion hot paths
   # (function table, event batching, batched result delivery, put/get)
@@ -88,7 +96,7 @@ EOF
 }
 
 run_stress() {
-  echo "=== [3/8] actor ordering stress x20 ==="
+  echo "=== [3/9] actor ordering stress x20 ==="
   for i in $(seq 1 20); do
     python -m pytest tests/test_actor_ordering_stress.py -q -x \
       || { echo "ordering stress failed on iteration $i"; exit 1; }
@@ -96,7 +104,7 @@ run_stress() {
 }
 
 run_chaos() {
-  echo "=== [4/8] control-plane HA chaos suite ==="
+  echo "=== [4/9] control-plane HA chaos suite ==="
   # Deterministic fault injection: pin + print the seed so a red run
   # reproduces bit-for-bit (override by exporting the variable).
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
@@ -112,7 +120,7 @@ run_chaos() {
 }
 
 run_serve_storm() {
-  echo "=== [5/8] serve traffic-storm chaos ==="
+  echo "=== [5/9] serve traffic-storm chaos ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -128,7 +136,7 @@ run_serve_storm() {
 }
 
 run_burst() {
-  echo "=== [6/8] warm-pool elasticity burst ==="
+  echo "=== [6/9] warm-pool elasticity burst ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "burst seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -142,10 +150,18 @@ run_burst() {
     --json /tmp/ray_tpu_burst_ci.json \
     || { echo "elasticity burst failed (seed ${RAY_TPU_FAULT_INJECTION_SEED})"
          exit 1; }
+  # cross-node composition (ROADMAP item 1): the same worker burst ACROSS
+  # an autoscaler-maintained multi-raylet fleet — fails if the wave lands
+  # on one node, any lease is unaccounted for, or any load call hangs.
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m ray_tpu.core.burst \
+    --nodes --target 40 --quick --seed "${RAY_TPU_FAULT_INJECTION_SEED}" \
+    --json /tmp/ray_tpu_crossburst_ci.json \
+    || { echo "cross-node burst failed (seed ${RAY_TPU_FAULT_INJECTION_SEED})"
+         exit 1; }
 }
 
 run_head_failover() {
-  echo "=== [7/8] standby-head kill-and-promote storm ==="
+  echo "=== [7/9] standby-head kill-and-promote storm ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -164,7 +180,7 @@ run_head_failover() {
 }
 
 run_node_chaos() {
-  echo "=== [8/8] multi-node kill storm (node failure domain) ==="
+  echo "=== [8/9] multi-node kill storm (node failure domain) ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "node storm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -183,6 +199,28 @@ run_node_chaos() {
          exit 1; }
 }
 
+run_partition_storm() {
+  echo "=== [9/9] partition-heal storm (partition failure domain) ==="
+  : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
+  export RAY_TPU_FAULT_INJECTION_SEED
+  echo "partition storm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
+  # --partition --quick: peer-scoped partitions under closed-loop load —
+  # death cycles (minority node blackholed past the death bound: must be
+  # QUARANTINED first, declared dead at the bound, actors restarted on the
+  # autoscaler's replacement; at heal the zombie is FENCED, kills its
+  # workers and rejoins fresh; a stale handle is served by the NEW
+  # instance), a quarantine-and-recover cycle (zero deaths/relaunches),
+  # and a head-in-minority cycle (lease starves, PR-11 standby promotes,
+  # old head self-fences). Prints the seed + fence/quarantine counters +
+  # heal-to-convergence latency; exits nonzero on any hung call,
+  # duplicate named-actor answer, or double replacement.
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m ray_tpu.core.burst \
+    --partition --quick --seed "${RAY_TPU_FAULT_INJECTION_SEED}" \
+    --json /tmp/ray_tpu_partition_ci.json \
+    || { echo "partition storm failed (seed ${RAY_TPU_FAULT_INJECTION_SEED})"
+         exit 1; }
+}
+
 case "$STAGE" in
   --native)     run_native ;;
   --fast)       run_fast ;;
@@ -192,10 +230,12 @@ case "$STAGE" in
   --burst)      run_burst ;;
   --failover)   run_head_failover ;;
   --node-chaos) run_node_chaos ;;
+  --partition)  run_partition_storm ;;
   all)        run_native; run_fast; run_stress; run_chaos; run_serve_storm
-              run_burst; run_head_failover; run_node_chaos ;;
+              run_burst; run_head_failover; run_node_chaos
+              run_partition_storm ;;
   *) echo "unknown stage: $STAGE" \
-     "(use --native|--fast|--stress|--chaos|--storm|--burst|--failover|--node-chaos)" >&2
+     "(use --native|--fast|--stress|--chaos|--storm|--burst|--failover|--node-chaos|--partition)" >&2
      exit 2 ;;
 esac
 echo "CI green"

@@ -1,2 +1,5 @@
 from ray_tpu.autoscaler.autoscaler import StandardAutoscaler, NodeType
-from ray_tpu.autoscaler.node_provider import NodeProvider, FakeNodeProvider
+from ray_tpu.autoscaler.node_provider import (FakeNodeProvider,
+                                              GceTpuNodeProvider,
+                                              KubernetesTpuNodeProvider,
+                                              NodeProvider)

@@ -75,8 +75,11 @@ class StandardAutoscaler:
                  idle_timeout_s: float = 60.0,
                  launch_failure_threshold: int = 3):
         # Reconnecting: the autoscaler must survive a GCS restart (its demand
-        # polls would otherwise raise RpcDisconnected forever).
-        self.gcs = rpc.ReconnectingClient(gcs_address)
+        # polls would otherwise raise RpcDisconnected forever) — and follow
+        # a REPLACEMENT/promoted head via the address file when configured
+        # (a head failover must not orphan the node-recovery control loop).
+        self.gcs = rpc.ReconnectingClient(
+            gcs_address, resolve=rpc.read_gcs_address_file)
         self.provider = provider
         self.node_types = {t.name: t for t in node_types}
         self.update_interval_s = update_interval_s

@@ -43,6 +43,13 @@ class FakeNodeProvider(NodeProvider):
     def __init__(self, gcs_address: str):
         self.gcs_address = gcs_address
         self._nodes: Dict[str, Any] = {}
+        # partition chaos: ids whose terminate_node must NOT actually kill
+        # the raylet — it leaves the provider listing (the cloud API
+        # accepted the delete) while the process lives on (the API can't
+        # reach the partitioned host). The zombie is what incarnation
+        # fencing exists for; the harness releases it at heal time.
+        self._hold_termination: set = set()
+        self._zombies: Dict[str, Any] = {}
         self._lock = threading.Lock()
 
     def create_node(self, node_type: str, resources: Dict[str, float],
@@ -76,11 +83,31 @@ class FakeNodeProvider(NodeProvider):
         are swallowed — the node is dead either way."""
         with self._lock:
             raylet = self._nodes.pop(provider_node_id, None)
+            if raylet is not None \
+                    and provider_node_id in self._hold_termination:
+                # partitioned host: the delete "succeeds" at the API but
+                # can't reach the process — a zombie raylet survives
+                self._zombies[provider_node_id] = raylet
+                return
         if raylet is not None:
             try:
                 raylet.stop()
             except Exception:
                 pass  # already crashed (kill_node); nothing left to stop
+
+    def hold_termination(self, provider_node_id: str) -> None:
+        """Arm the partition-zombie behavior for one node (see
+        _hold_termination)."""
+        with self._lock:
+            self._hold_termination.add(provider_node_id)
+
+    def release_zombie(self, provider_node_id: str):
+        """Heal-side cleanup: stop holding the zombie's termination.
+        Returns the still-running raylet (the harness keeps it alive to
+        prove fencing, then stops it) or None."""
+        with self._lock:
+            self._hold_termination.discard(provider_node_id)
+            return self._zombies.pop(provider_node_id, None)
 
     def non_terminated_nodes(self) -> List[str]:
         with self._lock:

@@ -114,8 +114,15 @@ class _LoadGen:
 
     def stop(self) -> Dict[str, int]:
         self._stop.set()
+        # join budget covers the WORST legal iteration — every in-flight
+        # get of the batch timing out serially plus one blocked submission
+        # — so "hung" means a call that truly never resolved, not a thread
+        # that resolved several slow typed timeouts back to back
+        per_thread = max(1, self._inflight // max(1, len(self._threads)))
+        budget = (per_thread + 1) * self._timeout_s + 10
+        deadline = time.monotonic() + budget
         for t in self._threads:
-            t.join(timeout=self._timeout_s + 10)
+            t.join(timeout=max(1.0, deadline - time.monotonic()))
             if t.is_alive():
                 self.hung += 1
         return {"completed": self.completed, "errored": self.errored,
@@ -693,6 +700,641 @@ def run_node_storm(profile: Optional[NodeStormProfile] = None,
         cfg.health_check_period_ms, cfg.health_check_timeout_ms = saved
 
 
+# --------------------------------------------------------------------------
+# partition-heal storm (peer-scoped partitions, incarnation fencing,
+# gray-failure quarantine — the partition failure domain end to end)
+
+
+@dataclass
+class PartitionStormProfile:
+    n_nodes: int = 4             # autoscaler-maintained fleet nodes
+    node_cpus: float = 2.0
+    actors_per_node: int = 3     # capacity == actors: restarts NEED the
+    #                              replacement, survivors stay full
+    n_partitions: int = 3        # death-bound partition+heal cycles
+    partition_hold_s: float = 6.0   # > death bound: node declared dead,
+    #                                 actors restarted, THEN the heal
+    quarantine_cycles: int = 1   # short partitions that must NOT kill
+    quarantine_hold_s: float = 1.6  # inside (quarantine, death) window
+    head_in_minority: bool = True   # final cycle cuts the head from the
+    #                                 store side: PR 11's lease fencing
+    #                                 promotes the standby
+    load_inflight: int = 12
+    load_warmup_s: float = 1.5
+    seed: int = 0
+    call_timeout_s: float = 60.0
+    settle_timeout_s: float = 120.0
+    # fast failure-detection knobs patched into the shared config
+    health_check_period_ms: int = 500
+    health_check_timeout_ms: int = 3000
+    node_quarantine_timeout_ms: int = 1200
+    head_lease_ttl_s: float = 1.5
+
+
+PARTITION_QUICK_PROFILE = dict(n_nodes=3, actors_per_node=2,
+                               n_partitions=2, partition_hold_s=5.0,
+                               quarantine_cycles=1, load_inflight=8,
+                               load_warmup_s=1.0, settle_timeout_s=90.0)
+
+
+def run_partition_storm(profile: Optional[PartitionStormProfile] = None,
+                        out_path: Optional[str] = None) -> Dict[str, Any]:
+    """One partition-heal storm on a fresh multi-raylet cluster.
+
+    Per death cycle: blackhole a minority {one fleet node} from the
+    majority {head + rest + store} mid-load, with the provider's
+    termination of the unreachable host HELD (the cloud API "deletes" a VM
+    it cannot reach — a zombie raylet survives the autoscaler's reap).
+    Assert: the node is QUARANTINED before the death bound, declared dead
+    AT the bound, its named actors restart (incarnation+1) on the
+    replacement the autoscaler launches; then HEAL and assert convergence
+    — the zombie is fenced on its first heartbeat, kills its superseded
+    workers, rejoins as a fresh node; every named actor answers from
+    exactly ONE live incarnation (a deliberately stale handle probe must
+    be served by the NEW instance, never the old one); zero hung calls;
+    relaunches never exceed true deaths (no double replacement).
+
+    Quarantine cycles hold the partition INSIDE the death bound: the node
+    must be quarantined (no new dispatch) and then recover with its actors
+    intact — zero deaths, zero relaunches, same pids.
+
+    The final cycle puts the HEAD in the minority (cut from the store
+    side): its lease renewals starve, the PR-11 standby promotes via the
+    epoch CAS, the old head self-fences through the existing lease path,
+    and the healed fleet re-adopts the new head.
+    """
+    import ray_tpu
+    from ray_tpu.autoscaler import FakeNodeProvider, NodeType, \
+        StandardAutoscaler
+    from ray_tpu.core import rpc
+    from ray_tpu.core.cluster import Cluster
+    from ray_tpu.core.config import get_config
+
+    p = profile or PartitionStormProfile()
+    rng = random.Random(p.seed)
+    cfg = get_config()
+    saved = (cfg.health_check_period_ms, cfg.health_check_timeout_ms,
+             cfg.node_quarantine_timeout_ms, cfg.head_lease_ttl_s,
+             cfg.gcs_address_file)
+    cfg.health_check_period_ms = p.health_check_period_ms
+    cfg.health_check_timeout_ms = p.health_check_timeout_ms
+    cfg.node_quarantine_timeout_ms = p.node_quarantine_timeout_ms
+    cfg.head_lease_ttl_s = p.head_lease_ttl_s
+    import tempfile
+
+    # the address file lets the autoscaler, raylets and workers follow the
+    # promoted head after the head-in-minority cycle
+    addr_file = os.path.join(tempfile.mkdtemp(prefix="rtpu-pstorm-"),
+                             "gcs_address")
+    cfg.gcs_address_file = addr_file
+    death_bound_s = (p.health_check_period_ms
+                     + p.health_check_timeout_ms) / 1000.0
+
+    violations: List[str] = []
+    cycles: List[Dict[str, Any]] = []
+    cluster = provider = autoscaler = standby = None
+    load: Optional[_LoadGen] = None
+    old_head = None
+    zombies: List[Any] = []
+    inj = rpc.install_fault_injector("", seed=p.seed)
+    try:
+        cluster = Cluster(
+            snapshot_uri=f"memory://partition-storm-{os.getpid()}")
+        # tight snapshot cadence: the standby promotes from the tailed
+        # snapshot, and the failure-domain counters it restores should be
+        # near-live, not up to 5 s stale
+        cluster.gcs._snapshot_interval_s = 0.5
+        head_raylet = cluster.add_node(num_cpus=4, resources={"head": 1})
+        cluster.connect()
+        from ray_tpu.core.worker import current_worker
+
+        driver = current_worker()
+        provider = FakeNodeProvider(cluster.gcs_address)
+        fleet_cap = float(p.actors_per_node)
+        autoscaler = StandardAutoscaler(
+            cluster.gcs_address, provider,
+            [NodeType("storm", {"CPU": p.node_cpus, "fleet": fleet_cap},
+                      min_workers=p.n_nodes,
+                      max_workers=p.n_nodes + p.n_partitions + 3)],
+            update_interval_s=0.25, idle_timeout_s=10_000.0)
+        autoscaler.start()
+        if p.head_in_minority:
+            standby = cluster.start_standby()
+
+        def node_failure_stats() -> Dict[str, Any]:
+            return driver.gcs.call("gcs_stats", {},
+                                   timeout=10)["node_failure"]
+
+        def alive_fleet_nodes() -> List[dict]:
+            nodes = driver.gcs.call("get_all_nodes", {}, timeout=10)
+            return [n for n in nodes if n.get("alive")
+                    and "fleet" in n.get("resources_total", {})]
+
+        deadline = time.monotonic() + p.settle_timeout_s
+        while len(alive_fleet_nodes()) < p.n_nodes:
+            if time.monotonic() > deadline:
+                raise RuntimeError("fleet never formed")
+            time.sleep(0.2)
+
+        @ray_tpu.remote
+        class FleetWorker:
+            def __init__(self):
+                self._n = 0
+
+            def work(self, x):
+                self._n += 1
+                return self.ping()
+
+            def ping(self):
+                from ray_tpu.core.worker import current_worker as _cw
+
+                return (os.getpid(), _cw()._actor_incarnation)
+
+        n_actors = p.n_nodes * p.actors_per_node
+        fleet = [FleetWorker.options(num_cpus=0, max_restarts=16,
+                                     name=f"storm-{i}",
+                                     resources={"fleet": 1.0}).remote()
+                 for i in range(n_actors)]
+        ray_tpu.get([a.ping.remote() for a in fleet],
+                    timeout=p.settle_timeout_s)
+        load = _LoadGen(list(fleet), p.load_inflight, p.call_timeout_s)
+        load.start()
+        time.sleep(p.load_warmup_s)
+
+        current_head = cluster.gcs.address
+
+        def majority_for(minority: set) -> set:
+            members = {current_head, head_raylet.address, "store"}
+            for pid in provider.non_terminated_nodes():
+                raylet = provider.raylet_for(pid)
+                if raylet is not None and raylet.address not in minority:
+                    members.add(raylet.address)
+            return members - minority
+
+        def await_counter(read, key, floor, timeout, what) -> Optional[float]:
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < timeout:
+                try:
+                    if read()[key] >= floor:
+                        return time.monotonic() - t0
+                except Exception:
+                    pass
+                time.sleep(0.1)
+            violations.append(f"{what} never observed ({key} < {floor})")
+            return None
+
+        def actor_infos():
+            return {a: driver.get_actor_info(actor_id=a._actor_id)
+                    for a in fleet}
+
+        # ---------------- death-bound partition + heal cycles ------------
+        for ci in range(p.n_partitions):
+            candidates = []
+            for pid in provider.non_terminated_nodes():
+                raylet = provider.raylet_for(pid)
+                if raylet is not None:
+                    candidates.append((pid, raylet))
+            if not candidates:
+                violations.append("no fleet node left to partition")
+                break
+            victim_pid, victim = rng.choice(candidates)
+            victim_node_id = victim.node_id.binary()
+            infos0 = actor_infos()
+            victims = [(a, i) for a, i in infos0.items()
+                       if i and i.get("node_id") == victim_node_id
+                       and i.get("state") == "ALIVE"]
+            probe = None
+            if victims:
+                a, i = victims[0]
+                try:
+                    old_pid, _old_inc = ray_tpu.get(a.ping.remote(),
+                                                    timeout=20)
+                    probe = (a, i["address"], i["incarnation"], old_pid)
+                except Exception:
+                    probe = None
+            nf0 = node_failure_stats()
+            auto0 = autoscaler.stats()
+            minority = {victim.address}
+            inj.define_group("minority", minority)
+            inj.define_group("majority", majority_for(minority))
+            provider.hold_termination(victim_pid)
+            logger.warning("partition storm: cycle %d cuts node %s (%s) "
+                           "from the majority", ci, victim_pid,
+                           victim.node_id.hex()[:8])
+            t_cut = time.monotonic()
+            inj.partition("minority", "majority")
+
+            # one poll loop, both timestamps anchored at the cut: the
+            # quarantine must be OBSERVED strictly before the death (the
+            # gray-failure ramp precedes the crash-stop declaration)
+            t_quarantine = t_death = None
+            poll_deadline = time.monotonic() + death_bound_s * 3
+            while time.monotonic() < poll_deadline:
+                try:
+                    nf = node_failure_stats()
+                except Exception:
+                    time.sleep(0.1)
+                    continue
+                now = time.monotonic() - t_cut
+                if t_quarantine is None and nf["quarantines_total"] \
+                        >= nf0["quarantines_total"] + 1:
+                    t_quarantine = now
+                if nf["deaths_total"] >= nf0["deaths_total"] + 1:
+                    t_death = now
+                    break
+                time.sleep(0.1)
+            if t_death is None:
+                violations.append(
+                    f"cycle {ci}: partitioned node never declared dead")
+            if t_quarantine is None:
+                violations.append(
+                    f"cycle {ci}: node was never quarantined before death")
+            elif t_death is not None and t_quarantine >= t_death:
+                violations.append(
+                    f"cycle {ci}: quarantine ({t_quarantine:.2f}s) did not "
+                    f"precede death ({t_death:.2f}s)")
+            # hold the partition out, then heal
+            remaining = p.partition_hold_s - (time.monotonic() - t_cut)
+            if remaining > 0:
+                time.sleep(remaining)
+            t_heal = time.monotonic()
+            inj.heal()
+            zombie = provider.release_zombie(victim_pid)
+            if zombie is not None:
+                zombies.append(zombie)
+            elif t_death is not None:
+                violations.append(
+                    f"cycle {ci}: no zombie survived the reap (terminate "
+                    f"hold did not engage)")
+
+            # ---- convergence ----
+            await_counter(node_failure_stats, "fences_total",
+                          nf0["fences_total"] + 1, p.settle_timeout_s,
+                          f"cycle {ci}: zombie fence")
+            await_counter(lambda: autoscaler.stats(), "relaunches",
+                          auto0["relaunches"] + 1, p.settle_timeout_s,
+                          f"cycle {ci}: autoscaler relaunch")
+            # zombie rejoined as a FRESH node (same address, new identity)
+            if zombie is not None:
+                t0 = time.monotonic()
+                rejoined = False
+                while time.monotonic() - t0 < p.settle_timeout_s:
+                    for n in alive_fleet_nodes():
+                        if n["address"] == zombie.address \
+                                and n["node_id"] != victim_node_id:
+                            rejoined = True
+                            break
+                    if rejoined:
+                        break
+                    time.sleep(0.2)
+                if not rejoined:
+                    violations.append(
+                        f"cycle {ci}: fenced node never rejoined fresh")
+            # every victim actor ALIVE again with a bumped incarnation and
+            # answering from exactly ONE live instance
+            converge_deadline = time.monotonic() + p.settle_timeout_s
+            for a, i0 in victims:
+                ok = False
+                while time.monotonic() < converge_deadline:
+                    info = driver.get_actor_info(actor_id=a._actor_id)
+                    if info and info["state"] == "ALIVE" \
+                            and info["incarnation"] > i0["incarnation"]:
+                        ok = True
+                        break
+                    time.sleep(0.2)
+                if not ok:
+                    violations.append(
+                        f"cycle {ci}: actor {i0['actor_id']} never came "
+                        f"back with a new incarnation: {info}")
+                    continue
+                pids = set()
+                for _ in range(3):
+                    try:
+                        rpid, rinc = ray_tpu.get(
+                            a.ping.remote(),
+                            timeout=max(1.0, converge_deadline
+                                        - time.monotonic()))
+                        pids.add(rpid)
+                        if rinc != info["incarnation"]:
+                            violations.append(
+                                f"cycle {ci}: answer from incarnation "
+                                f"{rinc} != live {info['incarnation']} — "
+                                f"duplicate instance")
+                    except Exception as e:
+                        violations.append(
+                            f"cycle {ci}: converged actor stopped "
+                            f"answering: {type(e).__name__}")
+                        break
+                if len(pids) > 1:
+                    violations.append(
+                        f"cycle {ci}: named actor answered from "
+                        f"{len(pids)} pids — duplicate live instances")
+            # stale-handle probe: force the pre-partition (address,
+            # incarnation) back into the driver's cache and call — the
+            # fence must route it to the NEW instance (the old one is
+            # dead/fenced and can never answer)
+            probe_ok = None
+            if probe is not None:
+                a, old_addr, old_inc, old_pid = probe
+                with driver._actor_seq_lock:
+                    driver._actor_addresses[a._actor_id] = old_addr
+                    driver._actor_incarnations[a._actor_id] = old_inc
+                try:
+                    rpid, rinc = ray_tpu.get(a.ping.remote(), timeout=30)
+                    probe_ok = rpid != old_pid
+                    if not probe_ok:
+                        violations.append(
+                            f"cycle {ci}: STALE instance answered the "
+                            f"stale-handle probe (pid {rpid})")
+                except Exception as e:
+                    probe_ok = False
+                    violations.append(
+                        f"cycle {ci}: stale-handle probe never converged: "
+                        f"{type(e).__name__}: {e}"[:200])
+            t_converged = time.monotonic()
+            cycles.append({
+                "kind": "death", "node": victim.node_id.hex()[:8],
+                "quarantine_s": round(t_quarantine, 3)
+                if t_quarantine is not None else None,
+                "death_detect_s": round(t_death, 3)
+                if t_death is not None else None,
+                "heal_to_convergence_s": round(t_converged - t_heal, 3),
+                "stale_handle_probe_served_by_new": probe_ok,
+            })
+
+        # ---------------- quarantine-and-recover cycles ------------------
+        for ci in range(p.quarantine_cycles):
+            infos0 = actor_infos()
+            hosting = {i["node_id"] for i in infos0.values()
+                       if i and i.get("state") == "ALIVE"
+                       and i.get("node_id")}
+            candidates = [(pid, provider.raylet_for(pid))
+                          for pid in provider.non_terminated_nodes()
+                          if provider.raylet_for(pid) is not None]
+            if not candidates:
+                violations.append("no fleet node left to quarantine")
+                break
+            # prefer a node that HOSTS actors: the point is proving they
+            # survive quarantine+recovery with zero relaunches
+            hosting_candidates = [(pid, r) for pid, r in candidates
+                                  if r.node_id.binary() in hosting]
+            victim_pid, victim = rng.choice(hosting_candidates
+                                            or candidates)
+            victim_node_id = victim.node_id.binary()
+            held = {i["actor_id"]: i["incarnation"]
+                    for i in infos0.values()
+                    if i and i.get("node_id") == victim_node_id}
+            nf0 = node_failure_stats()
+            auto0 = autoscaler.stats()
+            minority = {victim.address}
+            inj.define_group("minority", minority)
+            inj.define_group("majority", majority_for(minority))
+            logger.warning("partition storm: quarantine cycle grays out "
+                           "node %s", victim.node_id.hex()[:8])
+            t_cut = time.monotonic()
+            inj.partition("minority", "majority")
+            t_q = await_counter(
+                node_failure_stats, "quarantines_total",
+                nf0["quarantines_total"] + 1, death_bound_s * 2,
+                "quarantine cycle: node never quarantined")
+            remaining = p.quarantine_hold_s - (time.monotonic() - t_cut)
+            if remaining > 0:
+                time.sleep(remaining)
+            t_heal = time.monotonic()
+            inj.heal()
+            t_rec = await_counter(
+                node_failure_stats, "quarantine_recoveries_total",
+                nf0["quarantine_recoveries_total"] + 1, death_bound_s * 2,
+                "quarantine cycle: node never recovered")
+            nf1 = node_failure_stats()
+            auto1 = autoscaler.stats()
+            if nf1["deaths_total"] != nf0["deaths_total"]:
+                violations.append("quarantine cycle: node was declared "
+                                  "DEAD inside the quarantine window")
+            if auto1["relaunches"] != auto0["relaunches"]:
+                violations.append("quarantine cycle: autoscaler replaced a "
+                                  "quarantined (recoverable) node")
+            kept = 0
+            for aid, inc in held.items():
+                info = driver.gcs.call("get_actor_info",
+                                       {"actor_id": aid}, timeout=10)
+                if info and info["state"] == "ALIVE" \
+                        and info["incarnation"] == inc:
+                    kept += 1
+                else:
+                    violations.append(
+                        f"quarantine cycle: actor {aid} did not keep its "
+                        f"incarnation across recovery: {info}")
+            if not held:
+                violations.append("quarantine cycle: victim hosted no "
+                                  "actors — nothing proven")
+            cycles.append({
+                "kind": "quarantine", "node": victim.node_id.hex()[:8],
+                "quarantine_s": round(t_q, 3) if t_q is not None else None,
+                # await started at the heal: this IS heal->recovery
+                "heal_to_recovery_s": round(t_rec, 3)
+                if t_rec is not None else None,
+                "actors_kept": kept, "actors_held": len(held),
+            })
+
+        # ---------------- head-in-minority cycle -------------------------
+        if p.head_in_minority and standby is not None:
+            stats0 = driver.gcs.call("gcs_stats", {}, timeout=10)
+            epoch0 = stats0["fence_epoch"]
+            old_head = cluster.gcs
+            minority = {current_head}
+            inj.define_group("minority", minority)
+            inj.define_group("majority", majority_for(minority))
+            logger.warning("partition storm: head-in-minority cycle cuts "
+                           "the head %s from the store side", current_head)
+            t_cut = time.monotonic()
+            inj.partition("minority", "majority")
+            promoted = standby.wait_promoted(p.settle_timeout_s)
+            if promoted is None:
+                violations.append("head-in-minority: standby never "
+                                  "promoted (lease starvation failed)")
+            t_heal = time.monotonic()
+            inj.heal()
+            if promoted is not None:
+                cluster.adopt_promoted(standby)
+                current_head = promoted.address
+                # the old head self-fences via the existing lease path
+                # (reads the bumped epoch) once healed
+                t0 = time.monotonic()
+                while not old_head._fenced.is_set() \
+                        and time.monotonic() - t0 < p.settle_timeout_s:
+                    time.sleep(0.1)
+                if not old_head._fenced.is_set():
+                    violations.append("head-in-minority: old head never "
+                                      "self-fenced after the heal")
+                # the fleet re-adopts the promoted head
+                stats1: Dict[str, Any] = {}
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < p.settle_timeout_s:
+                    try:
+                        stats1 = driver.gcs.call("gcs_stats", {},
+                                                 timeout=5)
+                        if stats1["fence_epoch"] > epoch0 \
+                                and stats1["nodes_alive"] >= p.n_nodes:
+                            break
+                    except Exception:
+                        pass
+                    time.sleep(0.2)
+                else:
+                    violations.append("head-in-minority: fleet never "
+                                      "re-adopted the promoted head")
+                cycles.append({
+                    "kind": "head_in_minority",
+                    "epoch": f"{epoch0}->{stats1.get('fence_epoch')}",
+                    "promotion": stats1.get("promotion"),
+                    "heal_to_convergence_s":
+                        round(time.monotonic() - t_heal, 3),
+                })
+
+        # ---------------- final convergence sweep ------------------------
+        final_deadline = time.monotonic() + p.settle_timeout_s
+        for idx, a in enumerate(fleet):
+            try:
+                named = ray_tpu.get_actor(f"storm-{idx}")
+                rpid, rinc = ray_tpu.get(
+                    named.ping.remote(),
+                    timeout=max(1.0, final_deadline - time.monotonic()))
+                info = driver.get_actor_info(actor_id=a._actor_id)
+                if info is None or rinc != info["incarnation"]:
+                    violations.append(
+                        f"final: storm-{idx} answered from incarnation "
+                        f"{rinc}, GCS records "
+                        f"{info and info['incarnation']}")
+            except Exception as e:
+                violations.append(
+                    f"final: storm-{idx} unresolvable: "
+                    f"{type(e).__name__}: {e}"[:160])
+        load_counts = load.stop()
+        load = None
+        if load_counts["hung"]:
+            violations.append(
+                f"{load_counts['hung']} load calls never resolved")
+        nf_final = node_failure_stats()
+        auto_final = autoscaler.stats()
+        if auto_final["relaunches"] > nf_final["deaths_total"]:
+            violations.append(
+                f"autoscaler double-replaced: {auto_final['relaunches']} "
+                f"relaunches > {nf_final['deaths_total']} true deaths")
+
+        result = {
+            "suite": "partition-heal storm (partition failure domain)",
+            "profile": {
+                "n_nodes": p.n_nodes, "actors_per_node": p.actors_per_node,
+                "n_partitions": p.n_partitions,
+                "quarantine_cycles": p.quarantine_cycles,
+                "head_in_minority": p.head_in_minority, "seed": p.seed,
+                "health_check_period_ms": p.health_check_period_ms,
+                "health_check_timeout_ms": p.health_check_timeout_ms,
+                "node_quarantine_timeout_ms": p.node_quarantine_timeout_ms,
+                "death_bound_s": death_bound_s,
+            },
+            "cycles": cycles,
+            "counters": {
+                "deaths_total": nf_final["deaths_total"],
+                "quarantines_total": nf_final["quarantines_total"],
+                "quarantine_recoveries_total":
+                    nf_final["quarantine_recoveries_total"],
+                "fences_total": nf_final["fences_total"],
+                "stale_incarnation_rejections":
+                    nf_final["stale_incarnation_rejections"],
+                "driver_stale_reply_rejections":
+                    driver.stale_reply_rejections,
+                "relaunches": auto_final["relaunches"],
+                "partition_drops": inj.stats["partition"],
+            },
+            "heal_to_convergence_s": {
+                "max": max((c["heal_to_convergence_s"] for c in cycles
+                            if c.get("heal_to_convergence_s") is not None),
+                           default=None),
+                "per_cycle": [c.get("heal_to_convergence_s")
+                              for c in cycles],
+            },
+            "load": load_counts,
+            "violations": violations,
+            "ok": not violations,
+        }
+        for a in fleet:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(result, f, indent=2)
+                f.write("\n")
+        return result
+    finally:
+        if load is not None:
+            try:
+                load.stop()
+            except Exception:
+                pass
+        try:
+            inj.heal()
+        except Exception:
+            pass
+        rpc.clear_fault_injector()
+        for z in zombies:
+            try:
+                z.stop()
+            except Exception:
+                pass
+        if autoscaler is not None:
+            try:
+                autoscaler.stop()
+            except Exception:
+                pass
+        if provider is not None:
+            for pid in provider.non_terminated_nodes():
+                try:
+                    provider.terminate_node(pid)
+                except Exception:
+                    pass
+        if old_head is not None:
+            try:
+                old_head.kill()
+            except Exception:
+                pass
+        if cluster is not None:
+            try:
+                cluster.shutdown()
+            except Exception:
+                logger.exception("partition storm cluster shutdown failed")
+        (cfg.health_check_period_ms, cfg.health_check_timeout_ms,
+         cfg.node_quarantine_timeout_ms, cfg.head_lease_ttl_s,
+         cfg.gcs_address_file) = saved
+
+
+def _partition_storm_main(args) -> int:
+    kw: Dict[str, Any] = dict(PARTITION_QUICK_PROFILE) if args.quick else {}
+    kw["seed"] = args.seed
+    p = PartitionStormProfile(**kw)
+    result = run_partition_storm(p, out_path=args.json)
+    print(json.dumps(result, indent=2))
+    c = result["counters"]
+    print(f"[partition-storm] seed={p.seed} nodes={p.n_nodes} "
+          f"partitions={p.n_partitions}+{p.quarantine_cycles}q"
+          f"{'+head' if p.head_in_minority else ''} | "
+          f"deaths={c['deaths_total']} quarantines={c['quarantines_total']} "
+          f"(recovered {c['quarantine_recoveries_total']}) "
+          f"fences={c['fences_total']} relaunches={c['relaunches']} "
+          f"stale_rejections={c['stale_incarnation_rejections']} | "
+          f"heal->convergence max "
+          f"{result['heal_to_convergence_s']['max']}s | "
+          f"load={result['load']}", file=sys.stderr)
+    if not result["ok"]:
+        print("[partition-storm] VIOLATIONS:", file=sys.stderr)
+        for v in result["violations"]:
+            print(f"  - {v}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def _node_storm_main(args) -> int:
     kw: Dict[str, Any] = dict(NODE_QUICK_PROFILE) if args.quick else {}
     kw["seed"] = args.seed
@@ -719,6 +1361,247 @@ def _node_storm_main(args) -> int:
     return 0
 
 
+# --------------------------------------------------------------------------
+# cross-node worker burst: the worker-burst axis composed with --nodes
+
+
+@dataclass
+class CrossNodeBurstProfile:
+    n_nodes: int = 4             # autoscaler-maintained fleet nodes
+    node_cpus: float = 4.0
+    n_start: int = 10
+    n_target: int = 1000         # burst ACROSS the node fleet
+    load_inflight: int = 32
+    load_warmup_s: float = 2.0
+    seed: int = 0
+    call_timeout_s: float = 120.0
+    settle_timeout_s: float = 300.0
+
+
+CROSS_QUICK_PROFILE = dict(n_nodes=3, n_start=4, n_target=40,
+                           load_inflight=8, load_warmup_s=1.0,
+                           settle_timeout_s=120.0)
+
+
+def run_cross_node_burst(profile: Optional[CrossNodeBurstProfile] = None,
+                         out_path: Optional[str] = None) -> Dict[str, Any]:
+    """Burst the worker fleet n_start -> n_target ACROSS a multi-raylet
+    cluster (ROADMAP item 1 leftover: compose `--nodes` with the
+    worker-burst axis). SPREAD-scheduled actors under closed-loop load;
+    asserts every actor answers, the wave genuinely lands on multiple
+    nodes, every lease is served by a warm fork or a cold fallback
+    (aggregated across EVERY raylet's pool), and no load call hangs."""
+    import ray_tpu
+    from ray_tpu.autoscaler import FakeNodeProvider, NodeType, \
+        StandardAutoscaler
+    from ray_tpu.core.cluster import Cluster
+    from ray_tpu.core.task_spec import SchedulingStrategy
+
+    p = profile or CrossNodeBurstProfile()
+    violations: List[str] = []
+    cluster = provider = autoscaler = None
+    load: Optional[_LoadGen] = None
+    try:
+        cluster = Cluster()
+        head_raylet = cluster.add_node(num_cpus=4, resources={"head": 1})
+        cluster.connect()
+        from ray_tpu.core.worker import current_worker
+
+        driver = current_worker()
+        provider = FakeNodeProvider(cluster.gcs_address)
+        # per-node "slot" capacity: a REAL consumable resource (zero-cpu
+        # actors leave utilization flat, which degenerates SPREAD onto one
+        # node) sized so the full burst fits with ~25% slack per node
+        slot_cap = float(-(-p.n_target * 5 // (p.n_nodes * 4)))
+        autoscaler = StandardAutoscaler(
+            cluster.gcs_address, provider,
+            [NodeType("burst", {"CPU": p.node_cpus, "slot": slot_cap},
+                      min_workers=p.n_nodes, max_workers=p.n_nodes + 2)],
+            update_interval_s=0.25, idle_timeout_s=10_000.0)
+        autoscaler.start()
+
+        def fleet_raylets():
+            out = [head_raylet]
+            for pid in provider.non_terminated_nodes():
+                r = provider.raylet_for(pid)
+                if r is not None:
+                    out.append(r)
+            return out
+
+        deadline = time.monotonic() + p.settle_timeout_s
+        while len(provider.non_terminated_nodes()) < p.n_nodes:
+            if time.monotonic() > deadline:
+                raise RuntimeError("node fleet never formed")
+            time.sleep(0.2)
+
+        def pool_totals() -> Dict[str, int]:
+            tot = {"registered_warm": 0, "registered_cold": 0}
+            for r in fleet_raylets():
+                s = r._worker_pool.stats()
+                tot["registered_warm"] += s["registered_warm"]
+                tot["registered_cold"] += s["registered_cold"]
+            return tot
+
+        def idle_total() -> int:
+            n = 0
+            for r in fleet_raylets():
+                with r._lock:
+                    n += sum(len(pool) for pool in r._idle_pools.values())
+            return n
+
+        @ray_tpu.remote
+        class FleetWorker:
+            def __init__(self):
+                self._n = 0
+
+            def work(self, x):
+                self._n += 1
+                return (os.getpid(), self._n)
+
+            def ping(self):
+                return os.getpid()
+
+        def make_actors(n: int) -> List:
+            return [FleetWorker.options(
+                num_cpus=0, max_restarts=4, resources={"slot": 1.0},
+                scheduling_strategy=SchedulingStrategy(
+                    name="SPREAD")).remote() for _ in range(n)]
+
+        stats0 = pool_totals()
+        idle0 = idle_total()
+        fleet = make_actors(p.n_start)
+        ray_tpu.get([a.ping.remote() for a in fleet],
+                    timeout=p.settle_timeout_s)
+        load = _LoadGen(list(fleet), p.load_inflight, p.call_timeout_s)
+        load.start()
+        time.sleep(p.load_warmup_s)
+
+        t0 = time.perf_counter()
+        wave = make_actors(p.n_target - p.n_start)
+        load.add_actors(wave)
+        wave_pids = []
+        deadline = t0 + p.settle_timeout_s
+        pending = [(a, a.ping.remote()) for a in wave]
+        while pending and time.perf_counter() < deadline:
+            retry = []
+            for a, r in pending:
+                try:
+                    wave_pids.append(ray_tpu.get(
+                        r, timeout=max(0.5,
+                                       deadline - time.perf_counter())))
+                except Exception:
+                    retry.append((a, a.ping.remote()))
+            pending = retry
+            if pending:
+                time.sleep(0.2)
+        if pending:
+            violations.append(f"{len(pending)} cross-node scale-up actors "
+                              f"never answered first ping")
+        t_wave = time.perf_counter() - t0
+        load_counts = load.stop()
+        load = None
+        if load_counts["hung"]:
+            violations.append(
+                f"{load_counts['hung']} load calls never resolved")
+
+        # distribution: the wave must genuinely land across nodes
+        nodes_used = set()
+        for a in fleet + list(wave):
+            info = driver.get_actor_info(actor_id=a._actor_id)
+            if info and info.get("node_id"):
+                nodes_used.add(info["node_id"])
+        if len(nodes_used) < min(p.n_nodes, 2):
+            violations.append(
+                f"burst landed on only {len(nodes_used)} node(s) of "
+                f"{p.n_nodes + 1} — not a cross-node burst")
+        stats1 = pool_totals()
+        warm = stats1["registered_warm"] - stats0["registered_warm"]
+        cold = stats1["registered_cold"] - stats0["registered_cold"]
+        answered = p.n_target - len(pending)
+        if warm + cold + idle0 < answered:
+            violations.append(
+                f"workers unaccounted for across nodes: {answered} actors "
+                f"but only {warm} warm + {cold} cold starts "
+                f"(+{idle0} pre-burst idle)")
+
+        result = {
+            "suite": "cross-node worker burst (--nodes x worker-burst)",
+            "profile": {"n_nodes": p.n_nodes, "n_start": p.n_start,
+                        "n_target": p.n_target, "seed": p.seed,
+                        "load_inflight": p.load_inflight},
+            "scale_up": {
+                "actors_to_first_ping_s": round(t_wave, 2),
+                "actors_per_s": round((p.n_target - p.n_start)
+                                      / max(t_wave, 1e-9), 1),
+                "distinct_workers": len(set(wave_pids)),
+                "nodes_used": len(nodes_used),
+            },
+            "worker_pool": {"warm_starts": warm, "cold_starts": cold,
+                            "pre_burst_idle_workers": idle0,
+                            "warm_fraction":
+                                round(warm / max(1, warm + cold), 3)},
+            "load": load_counts,
+            "violations": violations,
+            "ok": not violations,
+        }
+        for a in fleet + list(wave):
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(result, f, indent=2)
+                f.write("\n")
+        return result
+    finally:
+        if load is not None:
+            try:
+                load.stop()
+            except Exception:
+                pass
+        if autoscaler is not None:
+            try:
+                autoscaler.stop()
+            except Exception:
+                pass
+        if provider is not None:
+            for pid in provider.non_terminated_nodes():
+                try:
+                    provider.terminate_node(pid)
+                except Exception:
+                    pass
+        if cluster is not None:
+            try:
+                cluster.shutdown()
+            except Exception:
+                logger.exception("cross-node burst cluster shutdown failed")
+
+
+def _cross_node_burst_main(args) -> int:
+    kw: Dict[str, Any] = dict(CROSS_QUICK_PROFILE) if args.quick else {}
+    kw["seed"] = args.seed
+    if args.start is not None:
+        kw["n_start"] = args.start
+    if args.target is not None:
+        kw["n_target"] = args.target
+    p = CrossNodeBurstProfile(**kw)
+    result = run_cross_node_burst(p, out_path=args.json)
+    print(json.dumps(result, indent=2))
+    su, wp = result["scale_up"], result["worker_pool"]
+    print(f"[cross-burst] seed={p.seed} {p.n_start} -> {p.n_target} "
+          f"workers across {su['nodes_used']} nodes in "
+          f"{su['actors_to_first_ping_s']}s | warm={wp['warm_starts']} "
+          f"cold={wp['cold_starts']} (warm fraction {wp['warm_fraction']}) "
+          f"| load={result['load']}", file=sys.stderr)
+    if not result["ok"]:
+        print("[cross-burst] VIOLATIONS:", file=sys.stderr)
+        for v in result["violations"]:
+            print(f"  - {v}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
 
@@ -727,7 +1610,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="scaled-down CI profile (4 -> 40 workers)")
     ap.add_argument("--nodes", action="store_true",
                     help="multi-raylet NODE kill storm (autoscaler-driven "
-                         "replacement + warm onboarding)")
+                         "replacement + warm onboarding); with --target: "
+                         "worker burst ACROSS the node fleet instead")
+    ap.add_argument("--partition", action="store_true",
+                    help="partition-heal storm: peer-scoped partitions, "
+                         "gray-failure quarantine, incarnation fencing, "
+                         "head-in-minority lease fencing")
     ap.add_argument("--seed", type=int,
                     default=int(os.environ.get(
                         "RAY_TPU_FAULT_INJECTION_SEED", "0")))
@@ -737,6 +1625,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--json", default=None, help="write the result here")
     args = ap.parse_args(argv)
 
+    if args.partition:
+        return _partition_storm_main(args)
+    if args.nodes and args.target is not None:
+        return _cross_node_burst_main(args)
     if args.nodes:
         return _node_storm_main(args)
 

@@ -37,6 +37,12 @@ class Config:
     health_check_period_ms: int = 1000
     health_check_timeout_ms: int = 10000
     num_heartbeats_timeout: int = 5
+    # gray-failure quarantine (partition failure domain): a node silent
+    # past this bound — but not yet past the death bound — takes no NEW
+    # dispatch and the autoscaler holds its replacement; it rejoins with
+    # its actors intact if heartbeats resume before the death bound.
+    # 0 = half of health_check_timeout_ms (always clamped inside it).
+    node_quarantine_timeout_ms: int = 0
 
     # --- scheduling (cf. hybrid_scheduling_policy.cc, ray_config_def.h:193) ---
     scheduler_spread_threshold: float = 0.5

@@ -73,6 +73,19 @@ def _node_metrics() -> dict:
             "gauge", "ray_tpu_node_join_warm_lease_seconds",
             "node join -> first warm (forked) lease latency of the most "
             "recent joiner"),
+        # --- partition failure domain (incarnation fencing + quarantine) ---
+        "fenced": get_or_create(
+            "counter", "ray_tpu_node_fenced_total",
+            "nodes told to fence (stale incarnation after a partition "
+            "heal): the zombie kills its workers and rejoins fresh"),
+        "quarantines": get_or_create(
+            "counter", "ray_tpu_node_quarantines_total",
+            "nodes quarantined for degraded heartbeat delivery (no new "
+            "dispatch, autoscaler holds replacement)"),
+        "stale_rejections": get_or_create(
+            "counter", "ray_tpu_stale_incarnation_rejections_total",
+            "messages rejected for carrying a superseded node/actor "
+            "incarnation", tag_keys=("site",)),
     }
 
 
@@ -224,6 +237,32 @@ class GcsServer:
         self._raylet_clients: Dict[bytes, rpc.RpcClient] = {}
         self._last_heartbeat: Dict[bytes, float] = {}
 
+        # --- partition failure domain: incarnation fencing + quarantine ---
+        # per-node-IDENTITY incarnation: monotonically increasing, stamped
+        # at registration, snapshot-persisted. Declaring a node dead
+        # INVALIDATES its identity (added to _dead_node_ids): a zombie that
+        # comes back after a partition heal gets a typed fence reply on its
+        # next heartbeat/register — it must kill its workers (they host
+        # actor incarnations that were restarted elsewhere) and rejoin as a
+        # fresh node. (Reference: Ray's fault model treats asymmetric
+        # reachability as first-class; the incarnation is the fencing token
+        # at node granularity, like the head-lease epoch at head
+        # granularity.)
+        self._node_incarnations: Dict[bytes, int] = {}
+        # invalidated identities, INSERTION-ORDERED so the bound evicts the
+        # oldest and the snapshot persists the newest (a dict used as an
+        # ordered set: values unused)
+        self._dead_node_ids: Dict[bytes, None] = {}
+        self._node_fences = 0
+        # gray-failure quarantine: degraded-heartbeat nodes are quarantined
+        # (no new leases/dispatch; the autoscaler holds its replacement)
+        # BEFORE the death bound and rejoin without replacement on recovery
+        self._node_quarantines = 0
+        self._quarantine_recoveries = 0
+        # stale-incarnation rejections by site (heartbeat/register/
+        # reregister_actor/actor_creation_done/actor_failed)
+        self._stale_rejections: Dict[str, int] = {}
+
         # kv: namespace -> key -> value
         self._kv: Dict[str, Dict[bytes, Any]] = {}
 
@@ -313,6 +352,11 @@ class GcsServer:
                 self._lease_owner, force=True, settle_s=0,
                 floor=self._restored_fence_epoch + 1)
         self._server.start()
+        if self._lease is not None:
+            # partition sidedness for the lease_renew fault point: a net
+            # split that cuts this head from the store's side starves its
+            # renewals (head-in-minority composes PR 11's lease fencing)
+            self._lease.origin = self._server.address
         if self.promotion is not None:
             self.promotion["promoted_at"] = time.time()
         self._write_address_file()
@@ -541,6 +585,25 @@ class GcsServer:
                     self._nodes[nid] = n
                     self._last_heartbeat[nid] = now
                     self._restored_nodes[n["address"]] = nid
+                # fencing state: per-identity incarnation counters and the
+                # invalidated (dead) identities survive a head change, so
+                # a partition-era zombie can't slip past a fresh head
+                for nid, inc in data.get("node_incarnations", {}).items():
+                    self._node_incarnations[nid] = max(
+                        self._node_incarnations.get(nid, 0), int(inc))
+                for nid in data.get("dead_nodes", ()):
+                    self._dead_node_ids[nid] = None
+                nfc = data.get("node_failure_counters")
+                if nfc:
+                    self._node_deaths.update(nfc.get("deaths", {}))
+                    self._node_drains += int(nfc.get("drains", 0))
+                    self._node_fences += int(nfc.get("fences", 0))
+                    self._node_quarantines += int(
+                        nfc.get("quarantines", 0))
+                    self._quarantine_recoveries += int(
+                        nfc.get("quarantine_recoveries", 0))
+                    self._stale_rejections.update(
+                        nfc.get("stale_rejections", {}))
                 # Placement groups: bundle reservations live on in the
                 # raylets (which survived the head), so the restored table
                 # — bundles, strategy, bundle->node placement — makes PG
@@ -608,11 +671,33 @@ class GcsServer:
                         # raylets to dial (per-node live stats stay out —
                         # they are rebuilt from heartbeats)
                         "nodes": {
-                            nid: {k: n[k] for k in (
+                            nid: {k: n.get(k) for k in (
                                 "node_id", "address", "object_store_address",
                                 "resources_total", "resources_available",
-                                "labels", "start_time")}
+                                "labels", "start_time", "incarnation")}
                             for nid, n in self._nodes.items() if n["alive"]},
+                        # incarnation fencing survives head failover: the
+                        # per-identity counters (for live nodes) and the
+                        # invalidated identities — a zombie that heartbeats
+                        # the REPLACEMENT head still gets fenced
+                        "node_incarnations": {
+                            nid: inc for nid, inc
+                            in self._node_incarnations.items()
+                            if nid in self._nodes},
+                        "dead_nodes": list(self._dead_node_ids)[-4096:],
+                        # failure-domain counters: a promoted head keeps
+                        # reporting cumulative cluster history, not a
+                        # counter reset (gcs_stats consistency across
+                        # failover)
+                        "node_failure_counters": {
+                            "deaths": dict(self._node_deaths),
+                            "drains": self._node_drains,
+                            "fences": self._node_fences,
+                            "quarantines": self._node_quarantines,
+                            "quarantine_recoveries":
+                                self._quarantine_recoveries,
+                            "stale_rejections":
+                                dict(self._stale_rejections)},
                         # placement groups with their bundle->node
                         # assignments: raylets keep the reservations, the
                         # head keeps the map (satellite: a restored head
@@ -705,7 +790,8 @@ class GcsServer:
         already adopted a NEWER head rejects us — we are stale, fence.
         Returns True when the node left the provisional set."""
         try:
-            client = rpc.connect_with_retry(address, timeout=5)
+            client = rpc.connect_with_retry(address, timeout=5,
+                                            origin=self._server.address)
         except Exception:
             # raylet gone with the old head; the heartbeat timeout will
             # reap its restored entry
@@ -830,12 +916,27 @@ class GcsServer:
 
     # ---------------------------------------------------------------- pubsub
     def _publish(self, channel: str, message: Any) -> None:
+        # Partition-aware fan-out: pushes ride server->client connections,
+        # which the client-send FaultInjector never sees — consult the
+        # partition rules directly so a blackholed side receives no pubsub
+        # either (a partitioned raylet must not learn cluster events).
+        inj = rpc.get_fault_injector()
+        me = self._server.address if inj is not None else None
         for conn in list(self._subs.get(channel, [])):
-            if conn.alive:
-                conn.push("pubsub", {"channel": channel, "message": message})
+            if not conn.alive:
+                continue
+            if inj is not None and conn.origin is not None \
+                    and inj.partition_drop(me, conn.origin):
+                continue
+            conn.push("pubsub", {"channel": channel, "message": message})
 
     def rpc_subscribe(self, conn, req_id, payload):
         channels = payload["channels"]
+        origin = payload.get("origin")
+        if origin:
+            # the subscriber's NODE identity: lets the partition injector
+            # judge pushes on this connection (see _publish)
+            conn.origin = origin
         for ch in channels:
             subs = self._subs.setdefault(ch, [])
             if conn not in subs:
@@ -896,18 +997,61 @@ class GcsServer:
         return True
 
     # ----------------------------------------------------------------- nodes
+    def _count_stale(self, site: str) -> None:
+        with self._lock:
+            self._stale_rejections[site] = \
+                self._stale_rejections.get(site, 0) + 1
+        try:
+            _node_metrics()["stale_rejections"].inc(tags={"site": site})
+        except Exception:
+            pass
+
+    def _fence_node_reply(self, node_id: bytes, site: str,
+                          reason: str) -> dict:
+        """Typed fence response for a node presenting an invalidated
+        identity: the raylet that receives it kills its workers (their
+        actor incarnations were restarted elsewhere while it was declared
+        dead) and rejoins as a FRESH node."""
+        with self._lock:
+            self._node_fences += 1
+            self._dirty = True  # counters are snapshot state
+        self._count_stale(site)
+        try:
+            _node_metrics()["fenced"].inc()
+        except Exception:
+            pass
+        logger.warning("fencing node %s at %s: %s", node_id.hex()[:8],
+                       site, reason)
+        return {"fenced": True, "reason": reason, "site": site,
+                "epoch": self.fence_epoch}
+
     def rpc_register_node(self, conn, req_id, payload):
+        node_id: bytes = payload["node_id"]
+        with self._lock:
+            n = self._nodes.get(node_id)
+            dead = (node_id in self._dead_node_ids
+                    or (n is not None and not n.get("alive", True)))
+        if dead:
+            # a node identity declared dead can never re-register: the
+            # cluster already acted on its death (actors restarted,
+            # autoscaler replaced it) — the zombie must rejoin fresh
+            return self._fence_node_reply(
+                node_id, "register",
+                "node identity was declared dead; rejoin with a fresh id")
         self._install_node(payload)
         with self._lock:
             nodes = [self._public_node(n) for n in self._nodes]
             hot = self._hot_envs_payload_locked()
+            incarnation = self._node_incarnations.get(node_id, 0)
         # epoch + session ride the reply: the raylet uses the epoch to fence
         # stale-head announces and the session id as its re-adoption
         # fingerprint across head promotions; hot_envs is the warm-onboarding
         # hint — the joiner pre-spawns fork templates for these keys so a
-        # replacement node serves warm leases immediately
+        # replacement node serves warm leases immediately. The incarnation
+        # is the node's fencing token: heartbeats echo it back.
         return {"nodes": nodes, "epoch": self.fence_epoch,
-                "session_id": self.session_id, "hot_envs": hot}
+                "session_id": self.session_id, "hot_envs": hot,
+                "incarnation": incarnation}
 
     def _install_node(self, payload: dict,
                       client: Optional[rpc.RpcClient] = None) -> None:
@@ -917,6 +1061,16 @@ class GcsServer:
         node_id: bytes = payload["node_id"]
         with self._lock:
             stale = self._raylet_clients.pop(node_id, None)
+            # Incarnation stamping: a raylet re-registering with the
+            # incarnation it already holds (link blip, head re-adoption)
+            # KEEPS it — no bump, so an in-flight heartbeat can't race a
+            # re-register into a spurious mismatch. A fresh join (no or
+            # older incarnation) gets the identity's next monotonic value.
+            known = self._node_incarnations.get(node_id, 0)
+            offered = int(payload.get("incarnation") or 0)
+            incarnation = offered if offered >= known and offered > 0 \
+                else known + 1
+            self._node_incarnations[node_id] = incarnation
             self._nodes[node_id] = {
                 "node_id": node_id,
                 "address": payload["address"],
@@ -927,6 +1081,7 @@ class GcsServer:
                     payload.get("resources_available", payload["resources"])),
                 "labels": payload.get("labels", {}),
                 "alive": True,
+                "incarnation": incarnation,
                 "start_time": payload.get("start_time") or time.time(),
             }
             self._restored_nodes.pop(payload["address"], None)
@@ -939,7 +1094,9 @@ class GcsServer:
                 self._raylet_clients[node_id] = client
             else:
                 try:
-                    self._raylet_clients[node_id] = rpc.connect_with_retry(payload["address"], timeout=10)
+                    self._raylet_clients[node_id] = rpc.connect_with_retry(
+                        payload["address"], timeout=10,
+                        origin=self._server.address)
                 except Exception:
                     logger.exception("GCS could not connect back to raylet %s", payload["address"])
             # fresh capacity: every capacity-starved restart is due NOW
@@ -1008,7 +1165,41 @@ class GcsServer:
     def rpc_heartbeat(self, conn, req_id, payload):
         node_id = payload["node_id"]
         with self._lock:
+            n = self._nodes.get(node_id)
+            dead = (node_id in self._dead_node_ids
+                    or (n is not None and not n.get("alive", True)))
+        if dead:
+            # zombie raylet (declared dead during a partition, network
+            # healed): its identity is invalidated — typed fence reply
+            # makes it kill its workers and rejoin as a fresh node
+            return self._fence_node_reply(
+                node_id, "heartbeat",
+                "heartbeat from a node identity declared dead")
+        if n is None:
+            # unknown (not invalidated) identity: a registration this head
+            # never saw (e.g. landed after the snapshot a replacement head
+            # restored). Not a fence — the raylet just re-registers.
+            return {"unknown": True}
+        recovered = False
+        with self._lock:
             self._last_heartbeat[node_id] = time.monotonic()
+            n = self._nodes.get(node_id)
+            if n is not None and n.pop("quarantined", None):
+                # gray-failure recovery: heartbeats resumed before the
+                # death bound — the node rejoins scheduling with its
+                # actors/leases intact, no replacement launched
+                self._quarantine_recoveries += 1
+                self._dirty = True  # counters are snapshot state
+                self._bcast_dirty.add(node_id.hex())
+                self._bcast_full_needed = True
+                recovered = True
+        if recovered:
+            logger.warning("node %s recovered from quarantine (heartbeats "
+                           "resumed)", node_id.hex()[:8])
+            self._publish(CH_NODES, {"event": "recovered",
+                                     "node_id": node_id})
+            self._broadcast_resources(force=True)
+        with self._lock:
             n = self._nodes.get(node_id)
             if n is not None and "resources_available" in payload:
                 if n["resources_available"] != payload["resources_available"]:
@@ -1184,6 +1375,9 @@ class GcsServer:
             "available": dict(n["resources_available"]),
             "labels": dict(n["labels"]),
             "alive": n["alive"],
+            # quarantined nodes stay ALIVE (no replacement, actors kept)
+            # but take no NEW dispatch anywhere in the fleet
+            "quarantined": bool(n.get("quarantined")),
         }
 
     def _cluster_view_locked(self) -> dict:
@@ -1210,13 +1404,46 @@ class GcsServer:
         cfg = get_config()
         period = cfg.health_check_period_ms / 1000.0
         timeout = cfg.health_check_timeout_ms / 1000.0
+        # gray-failure quarantine bound: strictly INSIDE the death bound
+        # (0 = half of it), so a degraded node stops receiving new
+        # dispatch before it is declared dead — and crash-stop detection
+        # latency is untouched (the death check below is independent)
+        q_ms = cfg.node_quarantine_timeout_ms
+        quarantine_s = (q_ms / 1000.0) if q_ms > 0 else timeout / 2.0
+        quarantine_s = min(quarantine_s, timeout * 0.9)
         while not self._shutdown.wait(period):
             now = time.monotonic()
             dead = []
+            suspects = []
             with self._lock:
                 for nid, last in self._last_heartbeat.items():
-                    if self._nodes.get(nid, {}).get("alive") and now - last > timeout:
+                    n = self._nodes.get(nid, {})
+                    if not n.get("alive"):
+                        continue
+                    if now - last > timeout:
                         dead.append(nid)
+                    elif now - last > quarantine_s \
+                            and not n.get("quarantined"):
+                        n["quarantined"] = True
+                        self._node_quarantines += 1
+                        self._dirty = True  # counters are snapshot state
+                        self._bcast_dirty.add(nid.hex())
+                        self._bcast_full_needed = True
+                        suspects.append(nid)
+            for nid in suspects:
+                logger.warning(
+                    "node %s heartbeat delivery degraded (> %.1fs silent); "
+                    "QUARANTINED — no new dispatch, replacement held until "
+                    "the %.1fs death bound", nid.hex()[:8], quarantine_s,
+                    timeout)
+                try:
+                    _node_metrics()["quarantines"].inc()
+                except Exception:
+                    pass
+                self._publish(CH_NODES, {"event": "quarantined",
+                                         "node_id": nid})
+            if suspects:
+                self._broadcast_resources(force=True)
             for nid in dead:
                 logger.warning("node %s missed heartbeats; marking dead", nid.hex()[:8])
                 self._mark_node_dead(nid, "health check failed")
@@ -1377,7 +1604,8 @@ class GcsServer:
             views = [
                 NodeView(nid, n["resources_total"],
                          n["resources_available"], n["labels"])
-                for nid, n in self._nodes.items() if n["alive"]]
+                for nid, n in self._nodes.items()
+                if n["alive"] and not n.get("quarantined")]
         held = {placement[i] for i in range(len(placement))
                 if i not in lost_indices}
         for idx in lost_indices:
@@ -1486,7 +1714,8 @@ class GcsServer:
         if n is None or not n.get("alive"):
             return None
         try:
-            fresh = rpc.connect_with_retry(n["address"], timeout=3)
+            fresh = rpc.connect_with_retry(n["address"], timeout=3,
+                                           origin=self._server.address)
         except Exception:
             logger.info("could not reconnect to raylet %s at %s",
                         node_id.hex()[:8], n["address"])
@@ -1508,6 +1737,14 @@ class GcsServer:
             if n is None or not n["alive"]:
                 return
             n["alive"] = False
+            n.pop("quarantined", None)
+            # invalidate the identity: from here on, any heartbeat/register
+            # presenting this node_id is a zombie and gets fenced. Bounded:
+            # the OLDEST invalidations evict past the cap (zombies return
+            # within heal timescales, not after 4096 later deaths).
+            self._dead_node_ids[node_id] = None
+            while len(self._dead_node_ids) > 4096:
+                self._dead_node_ids.pop(next(iter(self._dead_node_ids)))
             self._restored_nodes.pop(n.get("address"), None)
             self._dirty = True  # membership is snapshot state
             self._bcast_removed.add(node_id.hex())
@@ -1665,6 +1902,20 @@ class GcsServer:
                 "drains_total": self._node_drains,
                 "autoscaler": dict(self._autoscaler_stats),
                 "pending_actor_restarts": len(self._pending_restarts),
+                # partition failure domain: incarnation fences, gray-failure
+                # quarantine state machine, stale-incarnation rejections
+                # (the gcs_stats face of ray_tpu_node_fenced_total /
+                # ray_tpu_node_quarantines_total /
+                # ray_tpu_stale_incarnation_rejections_total)
+                "fences_total": self._node_fences,
+                "quarantines_total": self._node_quarantines,
+                "quarantine_recoveries_total": self._quarantine_recoveries,
+                "nodes_quarantined": sum(
+                    1 for n in self._nodes.values()
+                    if n["alive"] and n.get("quarantined")),
+                "stale_incarnation_rejections": dict(self._stale_rejections),
+                "stale_incarnation_rejections_total": sum(
+                    self._stale_rejections.values()),
                 "hot_env_keys": [e["env_key"]
                                  for e in self._hot_envs_payload_locked()],
                 "warm_lease_joins": joins[-10:],
@@ -1890,7 +2141,7 @@ class GcsServer:
             views = [
                 NodeView(nid, n["resources_total"], n["resources_available"], n["labels"])
                 for nid, n in self._nodes.items()
-                if n["alive"]
+                if n["alive"] and not n.get("quarantined")
             ]
         if require_available and spec.scheduling.placement_group_id is None:
             views = [v for v in views if v.is_available(spec.resources)]
@@ -1909,6 +2160,11 @@ class GcsServer:
         with self._lock:
             info = self._actors[actor_id]
             info.node_id = target
+            # the actor's restart count IS its incarnation: the hosting
+            # worker learns it here and stamps every reply with it, and
+            # handles refuse to let a superseded instance service a call —
+            # exactly-one-live-instance across a partition heal
+            spec.incarnation = info.num_restarts
             # optimistic charge of the head's resource view: without it a
             # burst of creations all reads the same stale availability and
             # piles onto one node (the raylet's charge only flows back on
@@ -1934,6 +2190,36 @@ class GcsServer:
 
     def rpc_actor_creation_done(self, conn, req_id, payload):
         actor_id = payload["actor_id"]
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is not None and payload.get("success", True):
+                done_inc = payload.get("incarnation")
+                if done_inc is not None and done_inc < info.num_restarts:
+                    # a SUPERSEDED dispatch completing late (the node it
+                    # went to was partitioned/declared dead and the actor
+                    # was restarted elsewhere): marking ALIVE at its
+                    # address would resurrect the zombie instance — reject
+                    # and kill the stale worker instead
+                    stale_node = payload.get("node_id")
+                    kill_client = self._raylet_clients.get(stale_node) \
+                        if stale_node else None
+                    logger.warning(
+                        "rejecting stale actor_creation_done for %s "
+                        "(incarnation %s < current %s)", actor_id,
+                        done_inc, info.num_restarts)
+                else:
+                    kill_client = "accept"
+            else:
+                kill_client = "accept"
+        if kill_client != "accept":
+            self._count_stale("actor_creation_done")
+            if kill_client is not None:
+                try:
+                    kill_client.notify("kill_actor_worker",
+                                       {"actor_id": actor_id})
+                except OSError:
+                    pass
+            return False
         with self._lock:
             info = self._actors.get(actor_id)
             if info is None:
@@ -1982,7 +2268,8 @@ class GcsServer:
                 info.death_cause = payload.get("error", "creation failed")
             self._dirty = True
         self._publish(CH_ACTORS, {"actor_id": actor_id, "state": info.state.value,
-                                  "address": info.address, "death_cause": info.death_cause})
+                                  "address": info.address, "death_cause": info.death_cause,
+                                  "incarnation": info.num_restarts})
         return True
 
     def rpc_reregister_actor(self, conn, req_id, payload):
@@ -1990,9 +2277,30 @@ class GcsServer:
         (reference: GCS rebuilds the actor table from Redis +
         resubscription; here the worker IS the source of truth). Restores
         the ALIVE record, the creation spec (so restart-on-failure still
-        works) and the named-actor binding."""
+        works) and the named-actor binding. Incarnation-fenced: a zombie
+        instance (its actor was restarted elsewhere while its node was
+        partitioned) re-announcing a SUPERSEDED incarnation is rejected
+        with a typed fence reply — the worker exits instead of taking the
+        record back from the live instance."""
         actor_id: ActorID = payload["actor_id"]
         spec: Optional[ActorCreationSpec] = payload.get("spec")
+        offered = payload.get("incarnation")
+        with self._lock:
+            info = self._actors.get(actor_id)
+            stale = (info is not None and offered is not None
+                     and (offered < info.num_restarts
+                          or (info.state == ActorState.ALIVE
+                              and offered == info.num_restarts
+                              and info.address
+                              and info.address != payload["address"])))
+        if stale:
+            self._count_stale("reregister_actor")
+            logger.warning(
+                "rejecting reregister of actor %s from %s: incarnation %s "
+                "superseded (current %s at %s)", actor_id,
+                payload["address"], offered, info.num_restarts, info.address)
+            return {"fenced": True,
+                    "reason": "actor incarnation superseded"}
         with self._lock:
             info = self._actors.get(actor_id)
             if info is None:
@@ -2020,11 +2328,30 @@ class GcsServer:
             self._dirty = True
         self._publish(CH_ACTORS, {"actor_id": actor_id, "state": "ALIVE",
                                   "address": payload["address"],
-                                  "death_cause": ""})
+                                  "death_cause": "",
+                                  "incarnation": info.num_restarts})
         return True
 
     def rpc_actor_failed(self, conn, req_id, payload):
-        self._handle_actor_failure(payload["actor_id"], payload.get("reason", "worker died"))
+        """Worker-death report from a raylet. Node-scoped: a report from a
+        node that no longer HOSTS the actor (a fenced zombie killing its
+        superseded workers, a late report racing a restart) must not charge
+        the budget or restart the live instance."""
+        actor_id = payload["actor_id"]
+        reporter = payload.get("node_id")
+        if reporter is not None:
+            with self._lock:
+                info = self._actors.get(actor_id)
+                mismatch = (info is not None and info.node_id is not None
+                            and info.node_id != reporter)
+            if mismatch:
+                self._count_stale("actor_failed")
+                logger.info(
+                    "ignoring actor_failed for %s from node %s: actor is "
+                    "hosted on %s", actor_id, reporter.hex()[:8],
+                    info.node_id.hex()[:8])
+                return False
+        self._handle_actor_failure(actor_id, payload.get("reason", "worker died"))
         return True
 
     def _handle_actor_failure(self, actor_id: ActorID, reason: str) -> None:
@@ -2081,6 +2408,9 @@ class GcsServer:
                 "address": info.address,
                 "node_id": info.node_id,
                 "num_restarts": info.num_restarts,
+                # the restart count doubles as the live incarnation: handles
+                # pin calls to it so a superseded instance can never serve
+                "incarnation": info.num_restarts,
                 "death_cause": info.death_cause,
                 "class_name": info.class_name,
             }
@@ -2173,7 +2503,7 @@ class GcsServer:
                 views = [
                     NodeView(nid, n["resources_total"], n["resources_available"], n["labels"])
                     for nid, n in self._nodes.items()
-                    if n["alive"]
+                    if n["alive"] and not n.get("quarantined")
                 ]
             placement = self._policy.place_bundles(views, bundles, strategy)
             if placement is None:

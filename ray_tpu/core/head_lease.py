@@ -73,6 +73,11 @@ class HeadLease:
         self.ttl_s = ttl_s if ttl_s is not None \
             else get_config().head_lease_ttl_s
         self._lock = threading.Lock()
+        # holder's node endpoint, set by the owning head: gives the
+        # lease_renew fault point a partition SIDE — a partition rule
+        # cutting this origin from the "store" group starves renewals
+        # exactly like a real head-in-minority network split
+        self.origin: Optional[str] = None
 
     # ------------------------------------------------------------------ io
     def read(self) -> Optional[dict]:
@@ -159,7 +164,7 @@ class HeadLease:
                 # resurrect the relinquished lease for a full TTL — the
                 # whole point of relinquish is "a standby may take over NOW"
                 return
-            rpc.fault_point("lease_renew")
+            rpc.fault_point("lease_renew", origin=self.origin, dest="store")
             now = time.time()
             rec = {"epoch": epoch, "owner": owner,
                    "expires_at": now + self.ttl_s, "renewed_at": now,

@@ -183,6 +183,14 @@ class Raylet:
         self._gcs_epoch: int = 0
         self._session_id: Optional[str] = None  # cluster session fingerprint
         self._fencing_drops = 0
+        # node incarnation (partition failure domain): stamped by the GCS
+        # at registration, echoed in every heartbeat. A typed fence reply
+        # (this identity was declared dead while we were partitioned) makes
+        # this raylet kill its workers — they host actor incarnations that
+        # were restarted elsewhere — and rejoin as a FRESH node.
+        self.incarnation: int = 0
+        self._fenced_count = 0
+        self._fencing_now = False  # one self-fence at a time
         # delta-encoded resource broadcasts: last applied publish seq (None
         # until the first full lands) + one catch-up fetch at a time
         self._bcast_seen_seq: Optional[int] = None
@@ -219,9 +227,15 @@ class Raylet:
         self._gcs = rpc.ReconnectingClient(
             self.gcs_address, push_handler=self._on_gcs_push,
             on_reconnect=self._replay_gcs_registration,
-            resolve=self._resolve_gcs_address)
+            resolve=self._resolve_gcs_address,
+            origin=self._server.address)
         self._joined_at = time.monotonic()
         reply = self._gcs.call("register_node", self._registration_payload())
+        if isinstance(reply, dict) and reply.get("fenced"):
+            # a brand-new node id can only be fenced by id collision or a
+            # confused head — there is nothing to kill; surface it
+            raise RuntimeError(
+                f"GCS fenced our registration: {reply.get('reason')}")
         self._note_head_identity(reply)
         for n in reply["nodes"]:
             self._note_node(n)
@@ -229,7 +243,8 @@ class Raylet:
         # hot runtime-env keys so this node serves warm leases immediately
         # (node-join-to-first-warm-lease is the tracked number)
         self._worker_pool.prewarm(reply.get("hot_envs"))
-        self._gcs.call("subscribe", {"channels": ["resources", "nodes", "control"]})
+        self._gcs.call("subscribe", {"channels": ["resources", "nodes", "control"],
+                                     "origin": self._server.address})
         t = threading.Thread(target=self._heartbeat_loop, name="raylet-heartbeat", daemon=True)
         t.start()
         self._threads.append(t)
@@ -269,6 +284,9 @@ class Raylet:
             "labels": self.labels,
             "bundles": bundles,
             "start_time": self._start_time,
+            # incarnation echo: a re-register with the incarnation we hold
+            # KEEPS it (no bump); 0 = fresh join, the GCS issues the next
+            "incarnation": self.incarnation,
         }
 
     def _resolve_gcs_address(self) -> Optional[str]:
@@ -281,7 +299,8 @@ class Raylet:
 
     def _note_head_identity(self, reply: dict) -> None:
         """Record the head's fencing epoch + cluster session id from a
-        registration reply (the fingerprint promote_announce checks)."""
+        registration reply (the fingerprint promote_announce checks), and
+        the node incarnation the head stamped us with."""
         epoch = reply.get("epoch")
         if epoch is not None:
             with self._lock:
@@ -289,11 +308,22 @@ class Raylet:
         sid = reply.get("session_id")
         if sid:
             self._session_id = sid
+        inc = reply.get("incarnation")
+        if inc is not None:
+            self.incarnation = int(inc)
 
     def _replay_gcs_registration(self, raw: rpc.RpcClient) -> None:
         """Re-register on a fresh GCS connection (uses the RAW client — the
         wrapper's lock is held during replay)."""
         reply = raw.call("register_node", self._registration_payload(), timeout=30)
+        if isinstance(reply, dict) and reply.get("fenced"):
+            # our identity was declared dead while we were away (partition
+            # heal): kill the superseded workers and rejoin FRESH. Raising
+            # aborts installing this connection; the fence itself kicks a
+            # reconnect that registers the fresh identity.
+            self._self_fence(reply.get("reason") or "registration fenced")
+            raise rpc.RpcDisconnected(
+                f"registration fenced: {reply.get('reason')}")
         # the link may have followed a head replacement: workers spawned
         # from now on (and rpc_get_gcs_address callers) get the live head
         self.gcs_address = raw.address
@@ -302,12 +332,13 @@ class Raylet:
             self._note_node(n)
         with self._lock:
             self._bcast_seen_seq = None  # new head: wait for its first full
-        raw.call("subscribe", {"channels": ["resources", "nodes", "control"]},
+        raw.call("subscribe", {"channels": ["resources", "nodes", "control"],
+                               "origin": self._server.address},
                  timeout=30)
         self._worker_pool.prewarm(reply.get("hot_envs"))
-        logger.info("raylet %s re-registered with GCS at %s (epoch %s)",
-                    self.node_id.hex()[:8], raw.address,
-                    reply.get("epoch"))
+        logger.info("raylet %s re-registered with GCS at %s (epoch %s, "
+                    "incarnation %s)", self.node_id.hex()[:8], raw.address,
+                    reply.get("epoch"), reply.get("incarnation"))
 
     def _stale_announce(self, payload: dict, rpc_name: str) -> bool:
         """Fencing gate for head announces: an epoch below the one this
@@ -451,6 +482,104 @@ class Raylet:
         self._data_plane.stop()
         self._server.stop()
         self.store.shutdown()
+
+    def _self_fence(self, reason: str) -> None:
+        """Typed fence response received (our node identity was declared
+        dead — e.g. a partition was healed after the cluster moved on):
+        kill every worker and fork template on this node (their actor
+        incarnations were restarted elsewhere; letting them keep answering
+        is the two-addresses-per-named-actor split-brain), reset to a
+        FRESH node identity, and re-register. The process, its server and
+        its object store survive — only the node identity and the worker
+        population are replaced. Runs off-thread: callers sit on the
+        heartbeat loop or inside the GCS client's reconnect lock."""
+        with self._lock:
+            if self._fencing_now or self._shutdown.is_set():
+                return
+            self._fencing_now = True
+            self._fenced_count += 1
+        threading.Thread(target=self._do_self_fence, args=(reason,),
+                         name="raylet-self-fence", daemon=True).start()
+
+    def _do_self_fence(self, reason: str) -> None:
+        old_hex = self.node_id.hex()[:8]
+        logger.warning(
+            "raylet %s FENCED (incarnation %d): %s — killing workers and "
+            "rejoining as a fresh node", old_hex, self.incarnation, reason)
+        try:
+            with self._lock:
+                workers = [w for w in self._workers.values()
+                           if not w.is_driver]
+                for w in workers:
+                    # suppress actor_failed: those actors were restarted
+                    # elsewhere while we were declared dead — reporting
+                    # their "death" now would poke the LIVE instance
+                    w.actor_id = None
+                    self._workers.pop(w.worker_id, None)
+                self._idle_pools.clear()
+                starting = list(self._starting)
+                self._starting.clear()
+                starting_envs = list(self._starting_env.values())
+                self._starting_env.clear()
+                queued = [qt.spec for qt in self._queue]
+                self._queue.clear()
+                self._pending_actor_specs.clear()
+                self._bundles.clear()
+                self._bundles_committed.clear()
+                self._bundle_reservations.clear()
+                self._bundle_prepared_at.clear()
+                self.resources_available = dict(self.resources_total)
+                self._tpu_slots = {
+                    i: 1.0 for i in range(
+                        int(self.resources_total.get("TPU", 0)))}
+            for p in starting:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+            for ek in starting_envs:
+                self._env_manager.release(ek)
+            for w in workers:
+                if w.env_key:
+                    self._env_manager.release(w.env_key)
+                try:
+                    if w.proc is not None:
+                        w.proc.kill()
+                    else:
+                        os.kill(w.pid, 9)
+                except OSError:
+                    pass
+            # templates die too (their forked children would inherit the
+            # superseded actor state); the pool stays SERVING — the fresh
+            # identity reboots templates on demand / prewarm
+            try:
+                self._worker_pool.reset_for_fence()
+            except Exception:
+                logger.exception("worker pool fence reset failed")
+            # tasks we held (queued or mid-run) fail over at their owners
+            # exactly like a worker crash: retry budgets apply, owners on
+            # live nodes resubmit through their own raylets
+            for w in workers:
+                if w.current_task is not None:
+                    self._notify_owner_worker_died(w.current_task)
+                self._failover_recent_done(w.recent_done)
+            for spec in queued:
+                self._notify_owner_worker_died(spec)
+            # fresh identity: new node id, incarnation reissued by the GCS
+            from ray_tpu.core.ids import NodeID as _NodeID
+
+            with self._lock:
+                self.node_id = _NodeID.from_random()
+                self.incarnation = 0
+                self._start_time = time.time()
+                self._joined_at = time.monotonic()
+                self._bcast_seen_seq = None
+            logger.warning("raylet %s rejoining as fresh node %s after "
+                           "fence", old_hex, self.node_id.hex()[:8])
+            self._kick_gcs_reconnect()
+        finally:
+            with self._lock:
+                self._fencing_now = False
 
     def stop(self) -> None:
         self._shutdown.set()
@@ -611,7 +740,8 @@ class Raylet:
             c = self._raylet_clients.get(address)
             if c is not None and not c.closed:
                 return c
-        c = rpc.connect_with_retry(address, timeout=3)
+        c = rpc.connect_with_retry(address, timeout=3,
+                                   origin=self._server.address)
         with self._lock:
             existing = self._raylet_clients.get(address)
             if existing is not None and not existing.closed:
@@ -646,8 +776,9 @@ class Raylet:
                 demands = [self._effective_demand(qt.spec)
                            for qt in list(self._queue)[:100]]
             try:
-                self._gcs.call("heartbeat", {
+                reply = self._gcs.call("heartbeat", {
                     "node_id": self.node_id.binary(),
+                    "incarnation": self.incarnation,
                     "resources_available": dict(self.resources_available),
                     "pending_demands": demands,
                     "node_stats": self._node_stats(),
@@ -655,6 +786,23 @@ class Raylet:
                     # hot-env table that joining nodes prewarm from
                     "hot_envs": self._worker_pool.hot_envs(),
                 }, timeout=5)
+                if isinstance(reply, dict):
+                    if reply.get("fenced"):
+                        # our identity was invalidated (declared dead during
+                        # a partition): kill the superseded workers, rejoin
+                        # as a fresh node
+                        self._self_fence(reply.get("reason")
+                                         or "heartbeat fenced")
+                    elif reply.get("unknown"):
+                        # this head never saw our registration (replacement
+                        # head restored an older snapshot): re-register —
+                        # same identity, workers intact
+                        logger.warning(
+                            "raylet %s unknown to the head; re-registering",
+                            self.node_id.hex()[:8])
+                        threading.Thread(target=self._kick_gcs_reconnect,
+                                         name="gcs-rereg-kick",
+                                         daemon=True).start()
             except Exception:
                 if not self._shutdown.is_set():
                     logger.warning("heartbeat to GCS failed")
@@ -880,7 +1028,8 @@ class Raylet:
         for s in bad_actors:
             try:
                 self._gcs.notify("actor_failed", {
-                    "actor_id": s.actor_id, "reason": msg})
+                    "actor_id": s.actor_id, "reason": msg,
+                    "node_id": self.node_id.binary()})
             except OSError as e:
                 logger.warning("actor_failed notify lost (GCS down?): %s", e)
 
@@ -925,7 +1074,11 @@ class Raylet:
         if actor_id is not None:
             try:
                 self._gcs.notify("actor_failed", {
-                    "actor_id": actor_id, "reason": f"worker process {handle.pid} died"})
+                    "actor_id": actor_id,
+                    "reason": f"worker process {handle.pid} died",
+                    # node-scoped: the GCS ignores this if the actor is no
+                    # longer hosted here (late report racing a restart)
+                    "node_id": self.node_id.binary()})
             except OSError as e:
                 logger.warning("actor_failed notify lost (GCS down?): %s", e)
         self._schedule()
@@ -1422,7 +1575,8 @@ class Raylet:
                           self.resources_available, self.labels)]
         addr_by_hex = {self.node_id.hex(): self._server.address}
         for hexid, v in self._cluster_view.items():
-            if not v.get("alive", True):
+            if not v.get("alive", True) or v.get("quarantined"):
+                # quarantined: alive but degraded — takes no NEW dispatch
                 continue
             views.append(NodeView(bytes.fromhex(hexid), v["total"], v["available"], v.get("labels", {})))
             addr_by_hex[hexid] = v["address"]
@@ -1771,7 +1925,8 @@ class Raylet:
             env_err = self._env_manager.creation_error(ekey)
             if env_err is not None:
                 self._gcs.notify("actor_failed", {
-                    "actor_id": spec.actor_id, "reason": env_err})
+                    "actor_id": spec.actor_id, "reason": env_err,
+                    "node_id": self.node_id.binary()})
                 return True
         with self._lock:
             handle = self._acquire_worker(ekey)
@@ -1818,7 +1973,10 @@ class Raylet:
         tpu_ids = self._assign_tpus(tpu_amount)
         handle.tpu_grant = (tpu_ids, tpu_amount)
         handle.conn.push("become_actor", {
-            "spec": spec, "tpu_ids": tpu_ids or []})
+            "spec": spec, "tpu_ids": tpu_ids or [],
+            # the incarnation this worker instantiates (GCS-stamped at
+            # dispatch): its replies carry it, fence checks compare to it
+            "incarnation": getattr(spec, "incarnation", 0)})
 
     def _release_actor_charge(self, handle: WorkerHandle) -> None:
         charge = handle.actor_charge

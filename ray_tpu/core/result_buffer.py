@@ -140,6 +140,11 @@ class ResultBuffer:
     def _deliver(self, owner: str, items: List[list]) -> None:
         w = self._worker
         payload = {"batch": [(tid, res) for tid, res, _ in items]}
+        if getattr(w, "actor_id", None) is not None:
+            # one process = one actor incarnation: stamp the batch so a
+            # late delivery from a superseded instance (partition heal) is
+            # rejected at the owner instead of resolving a pinned call
+            payload["actor_incarnation"] = w._actor_incarnation
         try:
             w.peer(owner).notify("report_task_result", payload)
             return

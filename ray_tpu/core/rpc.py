@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import pickle
 import socket
 import struct
@@ -46,62 +47,131 @@ REQ, REP, ERR, PUSH = 0, 1, 2, 3
 #   sever_once:<method>           connection cut at the first match, then
 #                                 the rule disarms (one deterministic cut)
 #   sever:<method>[:<prob>]       connection cut per matching send
+#   partition:<a>|<b>[:<prob>]    bidirectional blackhole between the named
+#                                 node GROUPS a and b: any send whose origin
+#                                 resolves into one group and destination
+#                                 into the other is dropped (every method).
+#                                 Group membership = sets of node endpoint
+#                                 addresses ("host:port") plus the literal
+#                                 "store" (the snapshot/lease store — so a
+#                                 head-in-minority partition starves its
+#                                 lease renewals and PR 11's standby fencing
+#                                 takes over). Members come from
+#                                 define_group() (in-process harnesses) or
+#                                 the RAY_TPU_FAULT_PARTITION_GROUPS env
+#                                 ("a=addr+addr;b=addr+store") so spawned
+#                                 workers inherit the topology. prob < 1.0
+#                                 models a flaky (gray) link rather than a
+#                                 clean cut. Heal with FaultInjector.heal().
 #
 # Determinism: one seeded RNG drives every probabilistic decision, so a
 # single-threaded call sequence replays exactly under the same seed.
 # Prob-1.0 rules (drop/sever_once/delay without prob) are deterministic
 # regardless of threading.
 #
+# Partition sidedness: every long-lived client carries the NODE identity of
+# its owner (`origin=` — a raylet's own server address; for workers and
+# drivers, their raylet's address, so partitioning a node group cuts that
+# node's worker traffic too). Destinations resolve by the dialed address.
+# A send with an unknown side (an address in neither group) passes through:
+# partitions cut between named groups, never "everything else".
+#
 # Named socket-less points (fault_point below) for boundaries that are not
 # a single RPC send:
 #   serve_replica_call   router -> replica submission (serve failover)
 #   lease_renew          active head's lease-renewal WRITE (head_lease.py):
 #                        drop it and the lease expires under a healthy head
-#                        — the deterministic trigger for standby promotion
+#                        — the deterministic trigger for standby promotion.
+#                        Carries origin=<head address>, dest="store" so a
+#                        partition that cuts the head from the store side
+#                        starves the lease exactly like a real net split.
 # promote_announce needs no fault_point: it is a real client RPC, so
 # drop/sever rules hit its send boundary by method name.
 
 
 class _FaultRule:
-    __slots__ = ("action", "method", "prob", "delay_s", "armed", "hits")
+    __slots__ = ("action", "method", "prob", "delay_s", "armed", "hits",
+                 "group_a", "group_b")
 
     def __init__(self, action: str, method: str, prob: float = 1.0,
-                 delay_s: float = 0.0):
+                 delay_s: float = 0.0, group_a: str = "", group_b: str = ""):
         self.action = action
         self.method = method
         self.prob = prob
         self.delay_s = delay_s
         self.armed = True
         self.hits = 0
+        self.group_a = group_a
+        self.group_b = group_b
 
     def matches(self, method: str) -> bool:
-        return self.armed and (self.method == "*" or self.method == method)
+        if not self.armed:
+            return False
+        if self.action == "partition":
+            return True  # partitions blackhole every method between groups
+        return self.method == "*" or self.method == method
 
     def __repr__(self):
+        if self.action == "partition":
+            return (f"_FaultRule(partition:{self.group_a}|{self.group_b} "
+                    f"prob={self.prob} armed={self.armed} hits={self.hits})")
         return (f"_FaultRule({self.action}:{self.method} prob={self.prob} "
                 f"delay={self.delay_s}s hits={self.hits})")
 
 
 class FaultInjector:
-    def __init__(self, spec: str, seed: int = 0):
+    def __init__(self, spec: str, seed: int = 0,
+                 groups: Optional[Dict[str, set]] = None):
         import random as _random
 
         self.spec = spec
         self.seed = seed
         self._rng = _random.Random(seed)
         self._lock = threading.Lock()
+        # partition group membership: name -> set of node endpoint
+        # addresses (+ the literal "store"); env-inherited so worker
+        # subprocesses share the topology, define_group() for harnesses
+        self.groups: Dict[str, set] = {
+            name: set(members) for name, members in (groups or {}).items()}
+        self.groups.update(self._parse_groups(
+            os.environ.get("RAY_TPU_FAULT_PARTITION_GROUPS", "")))
         self.rules = [self._parse_rule(r) for r in
                       spec.replace(",", ";").split(";") if r.strip()]
-        self.stats: Dict[str, int] = {"drop": 0, "delay": 0, "sever": 0}
+        self.stats: Dict[str, int] = {"drop": 0, "delay": 0, "sever": 0,
+                                      "partition": 0}
+
+    @staticmethod
+    def _parse_groups(text: str) -> Dict[str, set]:
+        """"a=host:p1+host:p2;b=host:p3+store" -> {"a": {...}, "b": {...}}
+        ("+" separates members because addresses contain ":")."""
+        out: Dict[str, set] = {}
+        for part in text.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, members = part.partition("=")
+            out[name.strip()] = {m.strip() for m in members.split("+")
+                                 if m.strip()}
+        return out
 
     @staticmethod
     def _parse_rule(text: str) -> "_FaultRule":
         parts = [p.strip() for p in text.strip().split(":")]
         action = parts[0]
-        if action not in ("drop", "delay", "sever", "sever_once"):
+        if action not in ("drop", "delay", "sever", "sever_once",
+                          "partition"):
             raise ValueError(f"unknown fault action {action!r} in {text!r}")
         if len(parts) < 2 or not parts[1]:
             raise ValueError(f"fault rule {text!r} needs a method name")
+        if action == "partition":
+            a, sep, b = parts[1].partition("|")
+            if not sep or not a.strip() or not b.strip():
+                raise ValueError(
+                    f"partition rule {text!r} needs two group names "
+                    f"('partition:<a>|<b>[:<prob>]')")
+            prob = float(parts[2]) if len(parts) > 2 else 1.0
+            return _FaultRule("partition", "*", prob=prob,
+                              group_a=a.strip(), group_b=b.strip())
         method = parts[1]
         if action == "delay":
             if len(parts) < 3:
@@ -112,14 +182,98 @@ class FaultInjector:
         prob = float(parts[2]) if len(parts) > 2 else 1.0
         return _FaultRule(action, method, prob=prob)
 
-    def on_send(self, method: str,
-                client: Optional["RpcClient"]) -> Optional[str]:
+    # ------------------------------------------------------- partition API
+    def define_group(self, name: str, members) -> None:
+        """(Re)define a partition group's membership: node endpoint
+        addresses ("host:port") and/or the literal "store"."""
+        with self._lock:
+            self.groups[name] = set(members)
+
+    def partition(self, group_a: str, group_b: str,
+                  prob: float = 1.0) -> "_FaultRule":
+        """Install (or re-arm) a partition rule between two named groups
+        at runtime — the harness-side sibling of the spec grammar."""
+        with self._lock:
+            for rule in self.rules:
+                if (rule.action == "partition"
+                        and {rule.group_a, rule.group_b}
+                        == {group_a, group_b}):
+                    rule.armed = True
+                    rule.prob = prob
+                    return rule
+            rule = _FaultRule("partition", "*", prob=prob,
+                              group_a=group_a, group_b=group_b)
+            self.rules.append(rule)
+            return rule
+
+    def heal(self) -> int:
+        """Heal every partition: disarm all partition rules (other rule
+        kinds are untouched — partitions compose with drop/delay/sever).
+        Returns the number of rules disarmed."""
+        healed = 0
+        with self._lock:
+            for rule in self.rules:
+                if rule.action == "partition" and rule.armed:
+                    rule.armed = False
+                    healed += 1
+        if healed:
+            logger.warning("fault injection: %d partition rule(s) healed",
+                           healed)
+        return healed
+
+    def _partition_severed(self, rule: "_FaultRule", origin: Optional[str],
+                           dest: Optional[str]) -> bool:
+        """Does (origin -> dest) straddle this rule's two groups? Unknown
+        sides (None, or an address in neither group) never match."""
+        if origin is None or dest is None:
+            return False
+        a = self.groups.get(rule.group_a, ())
+        b = self.groups.get(rule.group_b, ())
+        return ((origin in a and dest in b)
+                or (origin in b and dest in a))
+
+    def partition_drop(self, origin: Optional[str],
+                       dest: Optional[str]) -> bool:
+        """THE partition evaluator — shared by client sends (on_send) and
+        boundaries that are not client sends (server->client pushes, e.g.
+        GCS pubsub fan-out). True when the (origin, dest) pair is
+        currently blackholed: a blackhole, not a cut — connections stay
+        up and every message into them is lost, the asymmetric-
+        reachability model. Never raises."""
+        for rule in self.rules:
+            if rule.action != "partition" or not rule.armed:
+                continue
+            if not self._partition_severed(rule, origin, dest):
+                continue
+            with self._lock:
+                if not rule.armed:
+                    continue
+                if rule.prob < 1.0 and self._rng.random() >= rule.prob:
+                    continue
+                rule.hits += 1
+                self.stats["partition"] += 1
+            return True
+        return False
+
+    def on_send(self, method: str, client: Optional["RpcClient"],
+                origin: Optional[str] = None,
+                dest: Optional[str] = None) -> Optional[str]:
         """Apply matching rules; returns "drop" when the message must be
         lost, raises RpcDisconnected after severing the connection.
         `client` may be None for socket-less named injection points
-        (`fault_point`): sever then cuts nothing but still raises."""
+        (`fault_point`): sever then cuts nothing but still raises.
+        `origin`/`dest` resolve partition sidedness (defaulted from the
+        client's origin label and dialed address); partitions are judged
+        first — a blackholed send never reaches the per-method rules."""
+        if client is not None:
+            if origin is None:
+                origin = client.origin
+            if dest is None:
+                dest = client.address
+        if self.partition_drop(origin, dest):
+            return "drop"
         for rule in self.rules:
-            if not rule.matches(method):
+            if rule.action == "partition" or not rule.matches(method):
                 continue
             with self._lock:
                 if not rule.armed:
@@ -174,11 +328,14 @@ _fault_checked = False
 _fault_lock = threading.Lock()
 
 
-def install_fault_injector(spec: str, seed: int = 0) -> FaultInjector:
+def install_fault_injector(spec: str, seed: int = 0,
+                           groups: Optional[Dict[str, set]] = None
+                           ) -> FaultInjector:
     """Programmatic injection for in-process tests. Returns the injector
-    (its .stats/.rules expose hit counts for assertions)."""
+    (its .stats/.rules expose hit counts for assertions). `groups` seeds
+    partition group membership (see FaultInjector.define_group)."""
     global _fault_injector, _fault_checked
-    inj = FaultInjector(spec, seed)
+    inj = FaultInjector(spec, seed, groups=groups)
     with _fault_lock:
         _fault_injector = inj
         _fault_checked = True
@@ -188,17 +345,20 @@ def install_fault_injector(spec: str, seed: int = 0) -> FaultInjector:
     return inj
 
 
-def fault_point(name: str) -> None:
+def fault_point(name: str, origin: Optional[str] = None,
+                dest: Optional[str] = None) -> None:
     """Named, socket-less injection point for boundaries that are not a
     single RPC send (e.g. the serve router's replica-call submission,
     name `serve_replica_call`). Rules target it exactly like an RPC
     method: `drop`/`sever`/`sever_once` raise RpcDisconnected here (the
     caller's failover path takes over), `delay` stalls the caller. A
-    no-op (zero overhead beyond one None check) without an injector."""
+    no-op (zero overhead beyond one None check) without an injector.
+    `origin`/`dest` give partition rules a sidedness to judge (e.g. the
+    head's lease renewal passes origin=<head address>, dest="store")."""
     inj = get_fault_injector()
     if inj is None:
         return
-    if inj.on_send(name, None) == "drop":
+    if inj.on_send(name, None, origin=origin, dest=dest) == "drop":
         raise RpcDisconnected(
             f"[fault-injection seed={inj.seed}] dropped {name}")
 
@@ -265,6 +425,10 @@ class ServerConnection:
         self._writer = writer
         self.peer = peer
         self.ident: Any = None  # set by a `hello` handler if the app wants
+        # NODE identity the subscriber declared (subscribe payload
+        # "origin"): lets server->client pushes (pubsub fan-out) honor
+        # partition rules — a blackholed side gets no pushes either
+        self.origin: Optional[str] = None
         self.alive = True
         self.on_close: list[Callable[["ServerConnection"], None]] = []
 
@@ -433,9 +597,14 @@ class RpcClient:
     """Thread-safe synchronous client with pipelining and push dispatch."""
 
     def __init__(self, address: str, push_handler: Optional[Callable[[str, Any], None]] = None,
-                 connect_timeout: float = 30.0, on_disconnect: Optional[Callable[[], None]] = None):
+                 connect_timeout: float = 30.0, on_disconnect: Optional[Callable[[], None]] = None,
+                 origin: Optional[str] = None):
         host, port = address.rsplit(":", 1)
         self.address = address
+        # NODE identity of this client's owner (a daemon's own server
+        # address; a worker's/driver's raylet address) — what partition
+        # rules use to decide which side of a net split a send starts from
+        self.origin = origin
         self._sock = socket.create_connection((host, int(port)), timeout=connect_timeout)
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -565,8 +734,10 @@ class ReconnectingClient:
                  timeout: float = 30.0,
                  on_reconnect: Optional[Callable[["RpcClient"], None]] = None,
                  reconnect_timeout: float = 30.0,
-                 resolve: Optional[Callable[[], Optional[str]]] = None):
+                 resolve: Optional[Callable[[], Optional[str]]] = None,
+                 origin: Optional[str] = None):
         self.address = address
+        self.origin = origin
         self._push_handler = push_handler
         self._on_reconnect = on_reconnect
         self._reconnect_timeout = reconnect_timeout
@@ -614,7 +785,8 @@ class ReconnectingClient:
                 return RpcClient(
                     addr, push_handler=self._push_handler,
                     on_disconnect=self._schedule_reconnect,
-                    connect_timeout=min(timeout, 5.0))
+                    connect_timeout=min(timeout, 5.0),
+                    origin=self.origin)
             except (ConnectionRefusedError, OSError) as e:
                 last = e
             remaining = deadline - time.monotonic()

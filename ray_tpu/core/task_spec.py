@@ -72,6 +72,14 @@ class TaskSpec:
     # Actor fields
     actor_id: Optional[ActorID] = None
     actor_creation_spec: Optional["ActorCreationSpec"] = None
+    # incarnation fencing (partition failure domain): the actor RESTART
+    # count the caller's handle resolved this call against. The hosting
+    # worker refuses a mismatch — a call can never be serviced by a
+    # superseded instance that a partition kept alive, and a zombie
+    # learning of a newer incarnation self-terminates. None = resolved
+    # before the caller learned an incarnation (first call racing
+    # creation): accepted by any incarnation.
+    actor_incarnation: Optional[int] = None
     sequence_number: int = 0  # per-caller ordering for actor tasks
     caller_id: Optional[WorkerID] = None
     # call-site concurrency-group override (reference actor.py:82
@@ -114,6 +122,10 @@ class ActorCreationSpec:
     # named thread pools: methods annotated (or called) with a group run on
     # that group's threads (reference actor.py:65 concurrency_groups)
     concurrency_groups: Optional[Dict[str, int]] = None
+    # incarnation this creation/restart instantiates (stamped by the GCS at
+    # dispatch = ActorInfo.num_restarts): the hosting worker adopts it, its
+    # replies carry it, and every fence check compares against it
+    incarnation: int = 0
 
 
 class ActorState(Enum):

@@ -118,7 +118,8 @@ class ReferenceCounter:
             if link is None or link.closed:
                 link = rpc.ReconnectingClient(
                     owner,
-                    on_reconnect=lambda raw, o=owner: self._replay_borrows(o, raw))
+                    on_reconnect=lambda raw, o=owner: self._replay_borrows(o, raw),
+                    origin=self._worker.raylet_address)
                 self._owner_links[owner] = link
             return link
 
@@ -303,6 +304,11 @@ class CoreWorker:
         self.actor_id: Optional[ActorID] = None
         self._actor_instance: Any = None
         self._actor_creation_spec: Optional[ActorCreationSpec] = None
+        # the incarnation THIS process instantiates (GCS-stamped restart
+        # count at dispatch): replies carry it, and calls resolved against
+        # a different incarnation are refused (partition failure domain —
+        # a superseded instance must never service a call)
+        self._actor_incarnation: int = 0
         self._actor_seq_lock = threading.Lock()
         self._actor_next_seq: Dict[bytes, int] = {}       # caller -> expected seq
         self._actor_ooo_buffer: Dict[bytes, Dict[int, TaskSpec]] = {}
@@ -310,8 +316,17 @@ class CoreWorker:
         # actor submission (when this worker calls actors)
         self._actor_seq_counters: Dict[ActorID, int] = {}
         self._actor_addresses: Dict[ActorID, str] = {}
+        # incarnation the address above was learned WITH: stamped into
+        # every outgoing actor task so the target can fence a stale handle
+        # (or discover it is itself superseded)
+        self._actor_incarnations: Dict[ActorID, int] = {}
         self._actor_dead: Dict[ActorID, str] = {}
         self._actor_cv = threading.Condition()  # pubsub wakes address waits
+        # fenced-call resends (target refused our incarnation): bounded per
+        # task so a confused topology can't ping-pong a call forever
+        self._fence_resends: Dict[TaskID, int] = {}
+        # late replies dropped for carrying a superseded incarnation
+        self.stale_reply_rejections = 0
 
         # execution
         self._registered = threading.Event()
@@ -337,9 +352,13 @@ class CoreWorker:
         # outgoing TaskSpec (microbenchmark wire-bytes probe); None = off
         self._spec_bytes_probe = None
 
+        # origin = OUR RAYLET's address: workers and drivers belong to
+        # their node for partition purposes, so cutting a node group also
+        # blackholes its workers' control-plane and peer traffic
         self.raylet = rpc.connect_with_retry(
             raylet_address, push_handler=self._on_raylet_push,
-            timeout=connect_timeout or get_config().rpc_connect_timeout_s)
+            timeout=connect_timeout or get_config().rpc_connect_timeout_s,
+            origin=raylet_address)
         # Reconnecting control-plane link: survives a GCS restart by
         # re-registering this process's durable facts (job, subscriptions,
         # hosted actor) on every fresh connection. The resolver follows a
@@ -349,7 +368,8 @@ class CoreWorker:
         self.gcs = rpc.ReconnectingClient(
             gcs_address, push_handler=self._on_gcs_push,
             on_reconnect=self._replay_gcs_state,
-            resolve=self._resolve_gcs_address)
+            resolve=self._resolve_gcs_address,
+            origin=raylet_address)
 
         # task-path fast lanes: export-once function table + batched
         # task-event/profile shipping (both ride self.gcs)
@@ -392,7 +412,8 @@ class CoreWorker:
             channels = ["actors", "nodes"]
             if self.log_to_driver:
                 channels.append("logs")
-            self.gcs.call("subscribe", {"channels": channels})
+            self.gcs.call("subscribe", {"channels": channels,
+                                        "origin": self.raylet_address})
             with self._pending_lock:
                 self._nodes_subscribed = True
         # workers own the subtasks they submit and get the same node-death
@@ -419,7 +440,8 @@ class CoreWorker:
                 return c
         c = rpc.connect_with_retry(
             address,
-            timeout=connect_timeout_s or get_config().rpc_connect_timeout_s)
+            timeout=connect_timeout_s or get_config().rpc_connect_timeout_s,
+            origin=self.raylet_address)
         with self._peers_lock:
             existing = self._peers.get(address)
             if existing is not None and not existing.closed:
@@ -1289,11 +1311,17 @@ class CoreWorker:
         legacy single-task payload and the ResultBuffer's multi-task batch
         (`{"batch": [(task_id, results), ...]}`, applied in completion
         order); object-state wakeups coalesce into ONE `_obj_cv.notify_all()`
-        per call instead of one per result entry."""
+        per call instead of one per result entry. Actor replies carry the
+        reporting instance's incarnation: a LATE reply from a superseded
+        instance (partition heal) is rejected here rather than applied."""
         batch = payload.get("batch")
         if batch is None:
             batch = [(payload["task_id"], payload["results"])]
+        reporter_inc = payload.get("actor_incarnation")
         for task_id, results in batch:
+            if reporter_inc is not None \
+                    and self._reject_stale_reply(task_id, reporter_inc):
+                continue
             try:
                 self._handle_task_result(task_id, results)
             except Exception:
@@ -1302,6 +1330,37 @@ class CoreWorker:
                 logger.exception("failed to apply results of task %s", task_id)
         with self._obj_cv:
             self._obj_cv.notify_all()
+        return True
+
+    def _reject_stale_reply(self, task_id: TaskID, reporter_inc: int) -> bool:
+        """True when this reply comes from an actor incarnation OLDER than
+        the one the call was pinned to — it must not resolve the task's
+        objects (the pinned incarnation's own reply, or a failover path,
+        owns that)."""
+        with self._pending_lock:
+            pend = self._pending_tasks.get(task_id)
+            if pend is None:
+                return False  # unknown task: normal idempotent-drop path
+            spec = pend[0]
+            pinned = getattr(spec, "actor_incarnation", None)
+            if spec.task_type != TaskType.ACTOR_TASK or pinned is None \
+                    or reporter_inc >= pinned:
+                return False
+        self.stale_reply_rejections += 1
+        try:
+            from ray_tpu.util.metrics import get_or_create
+
+            get_or_create(
+                "counter", "ray_tpu_stale_incarnation_rejections_total",
+                "messages rejected for carrying a superseded node/actor "
+                "incarnation", tag_keys=("site",)).inc(
+                    tags={"site": "task_reply"})
+        except Exception:
+            pass
+        logger.warning(
+            "rejected late reply for task %s from superseded actor "
+            "incarnation %d (call pinned to %d)", task_id, reporter_inc,
+            pinned)
         return True
 
     def _handle_task_result(self, task_id: TaskID, results) -> None:
@@ -1320,6 +1379,7 @@ class CoreWorker:
                 retries_left = pend[1]
             else:
                 self._pending_tasks.pop(task_id, None)
+                self._fence_resends.pop(task_id, None)
             self._task_locations.pop(task_id, None)
         if retry:
             delay = get_config().task_retry_delay_ms / 1000.0
@@ -1564,7 +1624,8 @@ class CoreWorker:
                 return
             self._nodes_subscribed = True
         try:
-            self.gcs.call("subscribe", {"channels": ["nodes"]})
+            self.gcs.call("subscribe", {"channels": ["nodes"],
+                                        "origin": self.raylet_address})
         except Exception:
             with self._pending_lock:
                 self._nodes_subscribed = False
@@ -2094,6 +2155,11 @@ class CoreWorker:
             addr = self._wait_actor_address(actor_id, spec)
             if addr is None:
                 return  # _fail_task already called
+        # pin the call to the incarnation this address was learned with:
+        # the target refuses a mismatch, so the call can never be serviced
+        # by a superseded instance a partition kept alive (nor accepted by
+        # a newer one the caller hasn't resolved yet)
+        spec.actor_incarnation = self._actor_incarnations.get(actor_id)
         try:
             # short dial budget: this address came from a LIVE registration
             # (GCS state or a pubsub push), so a refused connect means the
@@ -2140,6 +2206,9 @@ class CoreWorker:
                     self._fail_task(spec, ActorDiedError(f"actor {actor_id} unknown"))
                     return None
                 if info["state"] == "ALIVE":
+                    if info.get("incarnation") is not None:
+                        self._actor_incarnations[actor_id] = \
+                            info["incarnation"]
                     self._actor_addresses[actor_id] = info["address"]
                     return info["address"]
                 if info["state"] == "DEAD":
@@ -2194,6 +2263,7 @@ class CoreWorker:
         with self._pending_lock:
             self._pending_tasks.pop(spec.task_id, None)
             self._task_locations.pop(spec.task_id, None)
+        self._fence_resends.pop(spec.task_id, None)
         blob = serialization.dumps(err)
         for oid in spec.return_object_ids():
             with self._obj_lock:
@@ -2264,7 +2334,9 @@ class CoreWorker:
             channels = ["actors", "nodes"]
             if self.log_to_driver:
                 channels.append("logs")
-            raw.call("subscribe", {"channels": channels}, timeout=30)
+            raw.call("subscribe", {"channels": channels,
+                                   "origin": self.raylet_address},
+                     timeout=30)
         else:
             # workers subscribe to the nodes channel LAZILY (first spill
             # only — see _nodes_subscribed): re-establish the subscription
@@ -2274,7 +2346,9 @@ class CoreWorker:
             with self._pending_lock:
                 resub = self._nodes_subscribed
             if resub:
-                raw.call("subscribe", {"channels": ["nodes"]}, timeout=30)
+                raw.call("subscribe", {"channels": ["nodes"],
+                                       "origin": self.raylet_address},
+                         timeout=30)
         # The reconnect window may have swallowed node-removal events for
         # nodes holding our spilled tasks (the classic pairing: node death
         # AND a GCS restart). Reconcile the location table against the
@@ -2288,15 +2362,28 @@ class CoreWorker:
         with self._channel_cb_lock:
             dynamic = [ch for ch, cbs in self._channel_callbacks.items() if cbs]
         if dynamic:
-            raw.call("subscribe", {"channels": dynamic}, timeout=30)
+            raw.call("subscribe", {"channels": dynamic,
+                                   "origin": self.raylet_address},
+                     timeout=30)
         if self.actor_id is not None and self._actor_instance is not None:
             spec = self._actor_creation_spec
-            raw.call("reregister_actor", {
+            reply = raw.call("reregister_actor", {
                 "actor_id": self.actor_id,
                 "address": self.address,
                 "node_id": self.node_id,
+                "incarnation": self._actor_incarnation,
                 "spec": spec,
             }, timeout=30)
+            if isinstance(reply, dict) and reply.get("fenced"):
+                # our incarnation was superseded while this process was
+                # unreachable (the actor lives elsewhere now): exit rather
+                # than ever answering a call again
+                logger.warning(
+                    "actor %s incarnation %d fenced at re-register: %s — "
+                    "exiting", self.actor_id, self._actor_incarnation,
+                    reply.get("reason"))
+                self._fenced_exit()
+                return
             logger.info("actor %s re-registered with restarted GCS",
                         self.actor_id)
 
@@ -2310,7 +2397,9 @@ class CoreWorker:
             first = not cbs
             cbs.append(callback)
         if first:
-            self.gcs.call("subscribe", {"channels": [channel]}, timeout=30)
+            self.gcs.call("subscribe", {"channels": [channel],
+                                        "origin": self.raylet_address},
+                          timeout=30)
 
     def unsubscribe_channel(self, channel: str, callback) -> None:
         with self._channel_cb_lock:
@@ -2361,15 +2450,19 @@ class CoreWorker:
             aid = msg["actor_id"]
             state = msg["state"]
             if state == "ALIVE":
+                if msg.get("incarnation") is not None:
+                    self._actor_incarnations[aid] = msg["incarnation"]
                 self._actor_addresses[aid] = msg["address"]
                 self._actor_dead.pop(aid, None)
             elif state == "DEAD":
                 self._actor_addresses.pop(aid, None)
+                self._actor_incarnations.pop(aid, None)
                 self._actor_dead[aid] = msg.get("death_cause") or "actor died"
                 self._fail_inflight_actor_tasks(aid, self._actor_dead[aid])
             else:  # RESTARTING: old incarnation's in-flight tasks are lost,
                 # and the fresh incarnation expects sequence numbers from 0.
                 self._actor_addresses.pop(aid, None)
+                self._actor_incarnations.pop(aid, None)
                 with self._actor_seq_lock:
                     self._actor_seq_counters.pop(aid, None)
                 self._fail_inflight_actor_tasks(
@@ -2409,7 +2502,8 @@ class CoreWorker:
             self._task_queue.put(spec)
         elif method == "become_actor":
             self._actor_tpu_ids = list(payload.get("tpu_ids") or [])
-            self._become_actor(payload["spec"])
+            self._become_actor(payload["spec"],
+                               payload.get("incarnation"))
         elif method == "global_gc":
             import gc
 
@@ -2483,8 +2577,20 @@ class CoreWorker:
         (self._group_queues[group] if group else self._task_queue).put(spec)
 
     def rpc_push_actor_task(self, conn, req_id, payload) -> None:
-        """Direct actor transport target (callers push here)."""
+        """Direct actor transport target (callers push here). Incarnation
+        fence first: a call pinned to a different incarnation than the one
+        this process instantiates is REFUSED — the caller re-resolves and
+        resends (rpc_actor_call_fenced) — and a call pinned to a NEWER
+        incarnation additionally proves this process is a superseded
+        zombie (its actor was restarted elsewhere during a partition): it
+        self-terminates instead of ever answering again."""
         spec: TaskSpec = payload["spec"]
+        pinned = getattr(spec, "actor_incarnation", None)
+        if pinned is not None and spec.actor_id is not None \
+                and (spec.actor_id != self.actor_id
+                     or pinned != self._actor_incarnation):
+            self._refuse_fenced_call(spec, pinned)
+            return
         caller = spec.caller_id.binary() if spec.caller_id else b""
         with self._actor_seq_lock:
             expected = self._actor_next_seq.get(caller, 0)
@@ -2501,6 +2607,92 @@ class CoreWorker:
             else:
                 self._actor_ooo_buffer.setdefault(caller, {})[spec.sequence_number] = spec
 
+    def _fenced_exit(self) -> None:
+        """This process was proven a SUPERSEDED actor incarnation: flush
+        the delivery buffers and exit off-thread (callers sit on RPC
+        reader / reconnect-lock paths), never to answer again."""
+        def die():
+            try:
+                self.result_buffer.stop()
+                self.task_events.flush()
+            except Exception:
+                pass
+            os._exit(0)
+
+        threading.Thread(target=die, name="fenced-exit",
+                         daemon=True).start()
+
+    def _refuse_fenced_call(self, spec: TaskSpec, pinned: int) -> None:
+        """Executor side of the incarnation fence: tell the owner (it
+        re-resolves and resends), then — if the call proves a NEWER
+        incarnation exists — terminate this superseded instance."""
+        superseded = (spec.actor_id == self.actor_id
+                      and pinned > self._actor_incarnation)
+        logger.warning(
+            "refusing actor call %s pinned to incarnation %s (this worker "
+            "instantiates %s of %s)%s", spec.method_name, pinned,
+            self._actor_incarnation, self.actor_id,
+            " — superseded, terminating" if superseded else "")
+        try:
+            self.peer(spec.owner_address).notify("actor_call_fenced", {
+                "task_id": spec.task_id, "actor_id": spec.actor_id,
+                "pinned": pinned, "actual": self._actor_incarnation})
+        except Exception:
+            logger.debug("fence notify to owner %s lost",
+                         spec.owner_address, exc_info=True)
+        if superseded:
+            # the cluster moved past us while we were partitioned; exit
+            # before any stale state can answer (raylet-side fencing kills
+            # us too — this is the faster, call-triggered path)
+            self._fenced_exit()
+
+    def rpc_actor_call_fenced(self, conn, req_id, payload):
+        """Owner side: the target refused our call's incarnation pin. The
+        cached (address, incarnation) is stale — drop it, re-resolve from
+        the GCS and resend with a fresh sequence number (ordering against
+        the refused send is void: nothing executed). Bounded per task; a
+        call that keeps getting fenced fails typed."""
+        task_id: TaskID = payload["task_id"]
+        actor_id = payload["actor_id"]
+        with self._pending_lock:
+            pend = self._pending_tasks.get(task_id)
+        if pend is None:
+            return True  # already failed/completed elsewhere
+        spec = pend[0]
+        resends = self._fence_resends.get(task_id, 0)
+        if resends >= 3:
+            self._fence_resends.pop(task_id, None)
+            self._fail_task(spec, ActorDiedError(
+                f"actor {actor_id} fenced call {resends + 1}x "
+                f"(cluster incarnation view never converged)"))
+            return True
+        self._fence_resends[task_id] = resends + 1
+        pinned = payload.get("pinned")
+        with self._actor_seq_lock:
+            cached_inc = self._actor_incarnations.get(actor_id)
+            if cached_inc is not None and (pinned is None
+                                           or cached_inc == pinned):
+                # the cache still holds the STALE view this fence reports:
+                # invalidate it once and restart the per-caller sequence —
+                # the re-resolve lands on a new incarnation that expects 0.
+                # A later fence for the same stale view finds the cache
+                # already refreshed (cached != pinned) or empty and keeps
+                # counting, so two fenced tasks can never both take seq 0.
+                self._actor_addresses.pop(actor_id, None)
+                self._actor_incarnations.pop(actor_id, None)
+                self._actor_seq_counters.pop(actor_id, None)
+            seq = self._actor_seq_counters.get(actor_id, 0)
+            self._actor_seq_counters[actor_id] = seq + 1
+            spec.sequence_number = seq
+
+        def resend():
+            self._send_actor_task(actor_id, spec, attempts=0)
+
+        # off the push reader thread: _send_actor_task may block resolving
+        threading.Thread(target=resend, name="fenced-resend",
+                         daemon=True).start()
+        return True
+
     @property
     def placement_group_id(self):
         """PG of the currently-executing task, else the hosting actor's PG."""
@@ -2510,8 +2702,14 @@ class CoreWorker:
         spec = self._actor_creation_spec
         return spec.scheduling.placement_group_id if spec is not None else None
 
-    def _become_actor(self, spec: ActorCreationSpec) -> None:
+    def _become_actor(self, spec: ActorCreationSpec,
+                      incarnation: Optional[int] = None) -> None:
         self.actor_id = spec.actor_id
+        # set BEFORE callers can learn our address (creation_done comes
+        # later): every arriving call is fence-checked against this
+        if incarnation is None:
+            incarnation = getattr(spec, "incarnation", 0)
+        self._actor_incarnation = int(incarnation or 0)
         self._actor_creation_spec = spec
         threading.Thread(target=self._init_actor, args=(spec,), daemon=True).start()
 
@@ -2535,10 +2733,13 @@ class CoreWorker:
                         self._spawn_exec_thread(q, f"task-exec-{gname}")
             self._start_exec_threads(max(1, spec.max_concurrency))
             # spec included so a GCS that restarted DURING our __init__ (and
-            # so never saw the registration) can rebuild the actor record.
+            # so never saw the registration) can rebuild the actor record;
+            # incarnation lets it reject a SUPERSEDED dispatch completing
+            # late (the actor was restarted elsewhere mid-partition)
             self.gcs.call("actor_creation_done", {
                 "actor_id": spec.actor_id, "success": True,
                 "address": self.address, "node_id": self.node_id,
+                "incarnation": self._actor_incarnation,
                 "spec": spec})
         except Exception as e:
             logger.exception("actor creation failed")
@@ -2693,7 +2894,10 @@ class CoreWorker:
         self._emit_task_event(spec, "FAILED" if failed else "FINISHED")
         try:
             if spec.owner_address == self.address:
-                self.rpc_report_task_result(None, 0, {"task_id": spec.task_id, "results": results})
+                self.rpc_report_task_result(None, 0, {
+                    "task_id": spec.task_id, "results": results,
+                    "actor_incarnation": self._actor_incarnation
+                    if self.actor_id is not None else None})
             else:
                 # batched fast lane: coalesces per owner under load, delivers
                 # immediately when idle, requeues on a down owner link

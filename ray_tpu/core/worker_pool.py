@@ -617,6 +617,30 @@ class WorkerPool:
                 slot.handle.close()
             self._release_env_ref(slot)
 
+    def reset_for_fence(self) -> None:
+        """Node fencing (partition failure domain): SIGKILL every template
+        — their forked children and any state they'd hand out belong to a
+        node identity that was declared dead — but keep the pool SERVING:
+        the fenced raylet rejoins as a fresh node and must boot templates
+        again on demand/prewarm. Unlike kill_all this does NOT shut the
+        pool down."""
+        with self._cv:
+            self._pending.clear()
+            slots = list(self._templates.values())
+            self._templates.clear()
+            self._cv.notify_all()
+        for slot in slots:
+            handle = slot.handle
+            if handle is not None:
+                try:
+                    handle.proc.kill()
+                except OSError:
+                    pass
+            self._release_env_ref(slot)
+        with self._lock:
+            # the fresh identity re-measures its own onboarding
+            self.join_to_first_warm_lease_s = None
+
     def kill_all(self) -> None:
         """Whole-node crash simulation: SIGKILL every template outright —
         no EXIT handshake, no graceful close — the way templates die when

@@ -552,6 +552,106 @@ def test_restart_dispatched_to_dying_node_recovers(fast_health):
         cluster.shutdown()
 
 
+class _FakeKubeApi:
+    """Stateful fake of the Kubernetes pods API (the provider's injectable
+    transport): POST creates a Running pod, GET lists by label selector,
+    DELETE removes (404 on unknown). Pods can be killed behind the
+    provider's back (preemption) so the autoscaler's vanished-node
+    reconcile is exercised END TO END, not just per-call."""
+
+    class _NotFound(Exception):
+        status = 404
+
+    def __init__(self, fail_creates: int = 0):
+        self.pods: dict = {}
+        self.create_calls = 0
+        self.delete_calls = 0
+        self.fail_creates = fail_creates
+
+    def __call__(self, method, url, body=None, headers=None):
+        if method == "POST":
+            self.create_calls += 1
+            if self.create_calls <= self.fail_creates:
+                raise RuntimeError("apiserver 500 (injected)")
+            name = body["metadata"]["name"]
+            self.pods[name] = dict(body, status={"phase": "Running"})
+            return {}
+        if method == "GET":
+            return {"items": [p for p in self.pods.values()
+                              if p["metadata"]["labels"]
+                              .get("ray-tpu-cluster") == "1"]}
+        if method == "DELETE":
+            self.delete_calls += 1
+            name = url.rsplit("/", 1)[-1]
+            if name not in self.pods:
+                raise self._NotFound("pod not found")
+            del self.pods[name]
+            return {}
+        raise AssertionError(f"unexpected {method} {url}")
+
+    def preempt(self, name: str) -> None:
+        """The node vanishes out from under the provider (spot reclaim)."""
+        del self.pods[name]
+
+
+def test_kubernetes_provider_reap_and_replace_loop(fast_health):
+    """ROADMAP item 1 leftover: drive the autoscaler's reap-and-replace
+    CONTROL LOOP through KubernetesTpuNodeProvider over its fake
+    transport — minimums converge, a preempted pod is detected as
+    vanished and relaunched, a transient apiserver failure becomes
+    breaker/backoff state (never a dead update thread), and the 404
+    double-reap stays a no-op."""
+    from ray_tpu.autoscaler import KubernetesTpuNodeProvider
+
+    cluster = Cluster()  # a real (empty) control plane for the demand polls
+    cluster.add_node(num_cpus=1, resources={"head": 1})
+    cluster.connect()
+    api = _FakeKubeApi(fail_creates=1)
+    provider = KubernetesTpuNodeProvider(
+        "testns", cluster.gcs_address, request_fn=api)
+    autoscaler = StandardAutoscaler(
+        cluster.gcs_address, provider,
+        [NodeType("tpu_pod", {"TPU": 4.0}, min_workers=2, max_workers=4)],
+        update_interval_s=0.1, idle_timeout_s=10_000.0)
+    try:
+        autoscaler.start()
+        # minimums converge THROUGH the injected create failure
+        deadline = time.monotonic() + 15
+        while len(provider.non_terminated_nodes()) < 2:
+            assert time.monotonic() < deadline, \
+                f"pod fleet never formed: {autoscaler.stats()}"
+            time.sleep(0.05)
+        assert autoscaler.stats()["launch_failures"] >= 1
+
+        # spot preemption: the pod vanishes from the API; the reconcile
+        # counts the death and relaunches to min_workers
+        victim = provider.non_terminated_nodes()[0]
+        auto0 = autoscaler.stats()
+        api.preempt(victim)
+        deadline = time.monotonic() + 15
+        while True:
+            stats = autoscaler.stats()
+            if (stats["relaunches"] > auto0["relaunches"]
+                    and len(provider.non_terminated_nodes()) >= 2):
+                break
+            assert time.monotonic() < deadline, \
+                f"preempted pod never replaced: {stats}"
+            time.sleep(0.05)
+        assert stats["deaths_by_reason"].get("vanished", 0) >= 1
+        assert autoscaler._thread.is_alive()
+        # 404 double reap is a no-op at the provider (idempotent terminate)
+        provider.terminate_node(victim)
+        provider.terminate_node("never-existed")
+        # pods the autoscaler launched carry the cluster labels + TPU
+        # resource request (the manifest path actually used by the loop)
+        pod = next(iter(api.pods.values()))
+        assert pod["metadata"]["labels"]["ray-tpu-type"] == "tpu_pod"
+        limits = pod["spec"]["containers"][0]["resources"]["limits"]
+        assert limits["google.com/tpu"] == "4"
+    finally:
+        _teardown(cluster, autoscaler)
+
+
 def test_gcs_stats_surfaces_node_failure_domain(fast_health):
     """Metrics satellite: deaths by reason, autoscaler counters and
     warm-lease joins are all readable from one gcs_stats call."""

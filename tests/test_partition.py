@@ -1,0 +1,367 @@
+"""Partition failure domain: peer-scoped partition injection, node/actor
+incarnation fencing, gray-failure quarantine, and head-in-minority lease
+fencing.
+
+Covers the PR-13 contract:
+  - `partition:<a>|<b>` FaultInjector rules bidirectionally blackhole
+    sends between named node groups (origin/destination resolved per
+    client), compose with the other rule kinds, and heal on command;
+  - a node declared dead during a partition is FENCED when the network
+    heals: its heartbeat/registration gets a typed fence reply, it kills
+    its workers (superseded actor incarnations) and rejoins as a FRESH
+    node — the stale identity can never re-register;
+  - a named actor's calls fail over to the restarted incarnation and the
+    healed stale instance never answers again (a deliberately stale
+    handle is served by the NEW instance);
+  - late replies carrying a superseded actor incarnation are rejected at
+    the owner instead of resolving a pinned call;
+  - a node with degraded heartbeat delivery is QUARANTINED (no new
+    dispatch) before the death bound and rejoins with its actors intact —
+    zero deaths, zero restarts;
+  - the head in a partition minority (cut from the store side) starves
+    its lease renewals, the PR-11 standby promotes via the epoch CAS, and
+    the old head self-fences through the existing lease path.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import rpc
+from ray_tpu.core.cluster import Cluster
+from ray_tpu.core.config import get_config
+
+FAULT_SEED = int(os.environ.get("RAY_TPU_FAULT_INJECTION_SEED", "20260804"))
+
+
+@pytest.fixture
+def fast_health():
+    """Shrink the failure-detection clocks (health + quarantine) so
+    partition cycles run at test speed; must run BEFORE the cluster boots
+    (the GCS health loop caches its periods at start)."""
+    cfg = get_config()
+    saved = (cfg.health_check_period_ms, cfg.health_check_timeout_ms,
+             cfg.node_quarantine_timeout_ms)
+    cfg.health_check_period_ms = 200
+    cfg.health_check_timeout_ms = 2000
+    cfg.node_quarantine_timeout_ms = 800
+    yield cfg
+    (cfg.health_check_period_ms, cfg.health_check_timeout_ms,
+     cfg.node_quarantine_timeout_ms) = saved
+
+
+def _driver():
+    from ray_tpu.core.worker import current_worker
+
+    return current_worker()
+
+
+def _nf(driver):
+    return driver.gcs.call("gcs_stats", {}, timeout=10)["node_failure"]
+
+
+def _await(cond, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.1)
+    raise AssertionError(f"{what} never held within {timeout}s")
+
+
+def test_partition_rule_parsing_and_heal():
+    """Spec grammar + sidedness unit: partition rules blackhole both
+    directions between group members, ignore unknown sides, respect
+    probability seeding, and disarm on heal() without touching other
+    rule kinds."""
+    inj = rpc.FaultInjector("partition:min|maj;drop:ping", seed=FAULT_SEED)
+    inj.define_group("min", {"127.0.0.1:1"})
+    inj.define_group("maj", {"127.0.0.1:2", "store"})
+    assert inj.on_send("anything", None, origin="127.0.0.1:1",
+                       dest="127.0.0.1:2") == "drop"
+    assert inj.on_send("anything", None, origin="127.0.0.1:2",
+                       dest="127.0.0.1:1") == "drop"
+    # unknown side: never cut
+    assert inj.on_send("anything", None, origin="127.0.0.1:9",
+                       dest="127.0.0.1:1") is None
+    # the store is a first-class member (head-in-minority lease starvation)
+    assert inj.on_send("lease_renew", None, origin="127.0.0.1:1",
+                       dest="store") == "drop"
+    assert inj.partition_drop("127.0.0.1:2", "127.0.0.1:1")
+    healed = inj.heal()
+    assert healed == 1
+    assert inj.on_send("anything", None, origin="127.0.0.1:1",
+                       dest="127.0.0.1:2") is None
+    # the drop rule survives the heal (partitions compose, not replace)
+    assert inj.on_send("ping", None) == "drop"
+    with pytest.raises(ValueError):
+        rpc.FaultInjector("partition:only_one_group")
+
+
+def test_zombie_node_fenced_and_rejoins_fresh(fast_health):
+    """A node partitioned past the death bound comes back at heal as a
+    ZOMBIE: its stale heartbeat gets a typed fence reply, its workers are
+    killed, and it rejoins as a fresh node id on the same address. The
+    dead identity can never re-register (register fence), and the stale
+    heartbeat is counted as a stale-incarnation rejection."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2, resources={"keep": 1})
+    b = cluster.add_node(num_cpus=2, resources={"fleet": 1.0})
+    cluster.connect()
+    try:
+        driver = _driver()
+        b_id = b.node_id.binary()
+        b_hex = b.node_id.hex()
+        inj = rpc.install_fault_injector("", seed=FAULT_SEED)
+        inj.define_group("min", {b.address})
+        inj.define_group("maj", {cluster.head.address,
+                                 cluster.gcs_address})
+        inj.partition("min", "maj")
+        _await(lambda: _nf(driver)["deaths_total"] >= 1,
+               what="partitioned node declared dead")
+        inj.heal()
+        # the zombie's next heartbeat fences it; it rejoins fresh
+        _await(lambda: _nf(driver)["fences_total"] >= 1,
+               what="zombie fence")
+        _await(lambda: any(
+            n["address"] == b.address and n["node_id"] != b_id
+            and n.get("alive")
+            for n in driver.gcs.call("get_all_nodes", {}, timeout=10)),
+            what="fresh rejoin on the zombie's address")
+        assert b.node_id.hex() != b_hex  # the raylet reset its identity
+        nf = _nf(driver)
+        assert nf["stale_incarnation_rejections"].get("heartbeat", 0) >= 1
+        # the DEAD identity stays fenced at every door: register + heartbeat
+        reply = driver.gcs.call("register_node", {
+            "node_id": b_id, "address": b.address,
+            "resources": {"CPU": 1.0}}, timeout=10)
+        assert reply.get("fenced")
+        reply = driver.gcs.call("heartbeat", {
+            "node_id": b_id, "incarnation": 1}, timeout=10)
+        assert reply.get("fenced")
+    finally:
+        rpc.clear_fault_injector()
+        cluster.shutdown()
+
+
+def test_named_actor_fails_over_and_stale_instance_never_answers(
+        fast_health):
+    """The named actor's node is partitioned out: the GCS restarts it
+    (incarnation+1) on surviving capacity and calls by name answer from
+    the new instance. After the heal the old instance is fenced/killed —
+    a deliberately STALE handle (old address + old incarnation forced
+    back into the submitter cache) must be served by the NEW instance,
+    never the old one."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2, resources={"keep": 1})
+    n1 = cluster.add_node(num_cpus=2, resources={"fleet": 1.0})
+    n2 = cluster.add_node(num_cpus=2, resources={"fleet": 1.0})
+    cluster.connect()
+    try:
+        driver = _driver()
+
+        @ray_tpu.remote
+        class Named:
+            def ping(self):
+                from ray_tpu.core.worker import current_worker as cw
+
+                return (os.getpid(), cw()._actor_incarnation)
+
+        a = Named.options(num_cpus=0, max_restarts=4, name="pinny",
+                          resources={"fleet": 1.0}).remote()
+        pid0, inc0 = ray_tpu.get(a.ping.remote(), timeout=30)
+        assert inc0 == 0
+        info0 = driver.get_actor_info(actor_id=a._actor_id)
+        host = n1 if info0["node_id"] == n1.node_id.binary() else n2
+        other = n2 if host is n1 else n1
+
+        inj = rpc.install_fault_injector("", seed=FAULT_SEED)
+        inj.define_group("min", {host.address})
+        inj.define_group("maj", {cluster.head.address, other.address,
+                                 cluster.gcs_address})
+        inj.partition("min", "maj")
+
+        # failover DURING the partition: restart lands on the survivor
+        def restarted():
+            i = driver.get_actor_info(actor_id=a._actor_id)
+            return i if (i and i["state"] == "ALIVE"
+                         and i["incarnation"] > inc0) else None
+
+        info1 = _await(restarted, timeout=40,
+                       what="named actor restart on the survivor")
+        assert info1["node_id"] == other.node_id.binary()
+        named = ray_tpu.get_actor("pinny")
+        pid1, inc1 = ray_tpu.get(named.ping.remote(), timeout=30)
+        assert pid1 != pid0 and inc1 == info1["incarnation"]
+
+        inj.heal()
+        _await(lambda: _nf(driver)["fences_total"] >= 1,
+               what="zombie host fence")
+        # stale-handle probe: the OLD (address, incarnation) must route to
+        # the NEW instance via the fence path — the healed stale instance
+        # never answers (its worker was killed by the fencing raylet)
+        with driver._actor_seq_lock:
+            driver._actor_addresses[a._actor_id] = info0["address"]
+            driver._actor_incarnations[a._actor_id] = inc0
+        for _ in range(3):
+            rpid, rinc = ray_tpu.get(a.ping.remote(), timeout=30)
+            assert rpid == pid1 and rinc == inc1, \
+                f"stale instance answered: {(rpid, rinc)}"
+    finally:
+        rpc.clear_fault_injector()
+        cluster.shutdown()
+
+
+def test_stale_incarnation_reply_rejected(fast_health):
+    """A late reply stamped with a SUPERSEDED actor incarnation must not
+    resolve a call pinned to the live incarnation — the owner drops it
+    (counted) and the real reply still lands."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    try:
+        driver = _driver()
+
+        @ray_tpu.remote
+        class Slow:
+            def ping(self):
+                return "ok"
+
+            def slow(self):
+                time.sleep(1.0)
+                return "real"
+
+        a = Slow.options(num_cpus=0, max_restarts=4).remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=30) == "ok"
+        # restart once so the live incarnation is 1 (a stale reply from
+        # incarnation 0 is then representable)
+        driver.kill_actor(a._actor_id, no_restart=False)
+        _await(lambda: (driver.get_actor_info(actor_id=a._actor_id) or {})
+               .get("incarnation") == 1, what="actor restart")
+        _await(lambda: (driver.get_actor_info(actor_id=a._actor_id) or {})
+               .get("state") == "ALIVE", what="actor alive")
+
+        ref = a.slow.remote()
+        task_id = ref.id.task_id()
+        with driver._pending_lock:
+            assert driver._pending_tasks[task_id][0].actor_incarnation == 1
+        rejected0 = driver.stale_reply_rejections
+        from ray_tpu.core import serialization
+
+        stale_blob = serialization.dumps(RuntimeError("stale instance"))
+        driver.rpc_report_task_result(None, 0, {
+            "task_id": task_id,
+            "results": [("error", oid, stale_blob)
+                        for oid in driver._pending_tasks[task_id][0]
+                        .return_object_ids()],
+            "actor_incarnation": 0,
+        })
+        assert driver.stale_reply_rejections == rejected0 + 1
+        # the call is still pending (the stale error did not resolve it)
+        # and the REAL reply completes it
+        assert ray_tpu.get(ref, timeout=30) == "real"
+    finally:
+        rpc.clear_fault_injector()
+        cluster.shutdown()
+
+
+def test_quarantined_node_recovers_with_actors_intact(fast_health):
+    """A partition shorter than the death bound: the node is QUARANTINED
+    (no new dispatch — scheduling skips it) and then RECOVERS with its
+    actors untouched: zero deaths, zero restarts, same pid."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2, resources={"keep": 1})
+    b = cluster.add_node(num_cpus=2, resources={"fleet": 1.0})
+    cluster.connect()
+    try:
+        driver = _driver()
+
+        @ray_tpu.remote
+        class Pinned:
+            def ping(self):
+                return os.getpid()
+
+        a = Pinned.options(num_cpus=0, max_restarts=4,
+                           resources={"fleet": 1.0}).remote()
+        pid0 = ray_tpu.get(a.ping.remote(), timeout=30)
+
+        inj = rpc.install_fault_injector("", seed=FAULT_SEED)
+        inj.define_group("min", {b.address})
+        inj.define_group("maj", {cluster.head.address,
+                                 cluster.gcs_address})
+        inj.partition("min", "maj")
+        _await(lambda: _nf(driver)["quarantines_total"] >= 1,
+               what="quarantine of the grayed node")
+        # quarantined = excluded from NEW dispatch: the cluster view says so
+        view = driver.gcs.call("get_cluster_view", {}, timeout=10)
+        assert view[b.node_id.hex()]["quarantined"] is True
+        inj.heal()
+        _await(lambda: _nf(driver)["quarantine_recoveries_total"] >= 1,
+               what="quarantine recovery")
+        nf = _nf(driver)
+        assert nf["deaths_total"] == 0
+        assert nf["nodes_quarantined"] == 0
+        info = driver.get_actor_info(actor_id=a._actor_id)
+        assert info["state"] == "ALIVE" and info["num_restarts"] == 0
+        assert ray_tpu.get(a.ping.remote(), timeout=30) == pid0
+        view = driver.gcs.call("get_cluster_view", {}, timeout=10)
+        assert view[b.node_id.hex()]["quarantined"] is False
+    finally:
+        rpc.clear_fault_injector()
+        cluster.shutdown()
+
+
+def test_head_in_minority_self_fences_via_lease(fast_health):
+    """The head lands in the partition minority, cut from the STORE side:
+    its lease renewals starve, the PR-11 standby promotes via the epoch
+    CAS, the old head discovers the bumped epoch through the existing
+    lease path and self-fences, and the healed fleet re-adopts the
+    promoted head."""
+    cfg = get_config()
+    saved_ttl = cfg.head_lease_ttl_s
+    cfg.head_lease_ttl_s = 1.0
+    cluster = Cluster(
+        snapshot_uri=f"memory://test-partition-head-{os.getpid()}")
+    node = cluster.add_node(num_cpus=2)
+    cluster.connect()
+    try:
+        driver = _driver()
+        old_head = cluster.gcs
+        old_addr = old_head.address
+        epoch0 = old_head.fence_epoch
+        standby = cluster.start_standby()
+        # the standby promotes FROM its tailed snapshot: hand it one that
+        # already knows the fleet (the periodic 5s loop hasn't run yet)
+        old_head._write_snapshot()
+        time.sleep(0.5)  # a healthy renewal + one standby tail poll
+
+        inj = rpc.install_fault_injector("", seed=FAULT_SEED)
+        inj.define_group("min", {old_addr})
+        inj.define_group("maj", {node.address, "store"})
+        inj.partition("min", "maj")
+
+        promoted = standby.wait_promoted(30)
+        assert promoted is not None, standby.stats()
+        assert promoted.fence_epoch > epoch0
+        inj.heal()
+        cluster.adopt_promoted(standby)
+        # the old head self-fences through the lease path (bumped epoch)
+        _await(lambda: old_head._fenced.is_set(), timeout=20,
+               what="old head self-fence after heal")
+        # the fleet re-adopts the promoted head and work still runs
+        _await(lambda: driver.gcs.call("gcs_stats", {}, timeout=5)
+               ["fence_epoch"] > epoch0, timeout=30,
+               what="driver re-resolving the promoted head")
+
+        @ray_tpu.remote
+        def two():
+            return 2
+
+        assert ray_tpu.get(two.remote(), timeout=60) == 2
+    finally:
+        rpc.clear_fault_injector()
+        cfg.head_lease_ttl_s = saved_ttl
+        cluster.shutdown()
