@@ -106,7 +106,8 @@ run_stress() {
 run_chaos() {
   echo "=== [4/9] control-plane HA chaos suite ==="
   # Deterministic fault injection: pin + print the seed so a red run
-  # reproduces bit-for-bit (override by exporting the variable).
+  # replays the same chaos schedule (override by exporting the variable;
+  # timing-dependent counters can still drift between runs).
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
