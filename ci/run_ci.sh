@@ -87,7 +87,10 @@ run_fast() {
 import json, os
 rows = {r["benchmark"] for r in
         json.load(open(os.environ["MB_JSON"]))["results"]}
-need = {"task_submit_p50", "task_e2e_p50", "task_completions_per_s"}
+need = {"task_submit_p50", "task_e2e_p50", "task_completions_per_s",
+        # zero-copy object plane (OBJPLANE_r14): the data-plane rows must
+        # be present so the pin-protocol fast path can't silently drop out
+        "put_get_10mb_bytes", "np_roundtrip_100mb", "arg_1mb_fanout"}
 missing = need - rows
 assert not missing, f"microbenchmark smoke missing rows: {missing}"
 print("microbenchmark rows ok:", ", ".join(sorted(need)))

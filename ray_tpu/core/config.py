@@ -32,6 +32,21 @@ class Config:
     object_spilling_threshold: float = 0.8
     min_spilling_size: int = 100 * 1024 * 1024
     object_transfer_chunk_bytes: int = 8 * 1024 * 1024
+    # --- zero-copy object plane (pin protocol; ROADMAP item 3) ---
+    # same-node get() of a sealed plasma object attaches the shm segment
+    # and deserializes IN PLACE: pickle-5 buffers stay read-only views
+    # into the mapping, refcount-pinned on the raylet until the reader's
+    # last view is GC'd (finalizer-driven obj_unpin; the raylet reaps a
+    # dead reader's pins at connection close). Off -> every get copies.
+    object_zero_copy_enabled: bool = True
+    # worker-side LRU of (segment, size) locations: repeat gets of a hot
+    # object skip owner resolution AND the pull round-trip entirely
+    object_location_cache_entries: int = 4096
+    # deleted file segments park here (bucketed by page-rounded size)
+    # instead of unlinking: a recycled segment hands the next same-size
+    # put ALREADY-FAULTED tmpfs pages (~4-5x the fresh-page write path).
+    # Drained first under memory pressure; 0 disables recycling.
+    object_segment_pool_bytes: int = 256 * 1024 * 1024
 
     # --- health / heartbeats (cf. gcs_health_check_manager.h) ---
     health_check_period_ms: int = 1000

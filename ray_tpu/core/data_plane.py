@@ -163,17 +163,16 @@ class DataPlaneServer:
 
     def _serve_fetch(self, conn: socket.socket, oid: ObjectID,
                      offset: int, length: int) -> None:
-        buf = self._store.get_buffer(oid)
-        if buf is None:
-            conn.sendall(_REP.pack(MISSING, 0))
-            return
-        try:
+        # pinned for the whole stream: a spill mid-transfer would unlink
+        # the segment under the send and force a restore per stripe
+        with self._store.pinned_view(oid) as buf:
+            if buf is None:
+                conn.sendall(_REP.pack(MISSING, 0))
+                return
             view = memoryview(buf.view)[offset:offset + length]
             conn.sendall(_REP.pack(OK, len(view)))
             # zero-copy source: sendall walks the shm mapping directly
             conn.sendall(view)
-        finally:
-            buf.close()
 
     def _serve_push(self, conn: socket.socket, oid: ObjectID,
                     size: int) -> None:

@@ -32,6 +32,7 @@ import os
 import threading
 import time
 from collections import OrderedDict
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
@@ -54,15 +55,29 @@ class ShmSegment:
     the store daemon alone unlinks.
     """
 
-    def __init__(self, name: str, size: int, create: bool = False):
+    def __init__(self, name: str, size: int, create: bool = False,
+                 readonly: bool = False, file_size: Optional[int] = None):
         self.name = name
         path = os.path.join(_SHM_DIR, name)
-        flags = os.O_RDWR | (os.O_CREAT | os.O_EXCL if create else 0)
-        fd = os.open(path, flags, 0o600)
+        if readonly:
+            fd = os.open(path, os.O_RDONLY)
+        else:
+            flags = os.O_RDWR | (os.O_CREAT | os.O_EXCL if create else 0)
+            fd = os.open(path, flags, 0o600)
         try:
             if create:
-                os.ftruncate(fd, max(size, 1))
-            self._mmap = mmap.mmap(fd, max(size, 1))
+                # file_size may exceed the mapped size: the store sizes
+                # files to page-rounded buckets so the reuse pool can hand
+                # a segment to any object in the same bucket
+                os.ftruncate(fd, max(file_size or size, 1))
+            if readonly:
+                # PROT_READ mapping: every view (and every numpy array
+                # reconstructed over one) is read-only — the aliasing
+                # contract for zero-copy get()
+                self._mmap = mmap.mmap(fd, max(size, 1),
+                                       prot=mmap.PROT_READ)
+            else:
+                self._mmap = mmap.mmap(fd, max(size, 1))
         finally:
             os.close(fd)
 
@@ -126,7 +141,8 @@ class _Entry:
     size: int
     sealed: bool = False
     spilled_path: Optional[str] = None
-    pinned: int = 0     # pin count (in-use by local get buffers)
+    pinned: int = 0     # pin count (live zero-copy reader views)
+    doomed: bool = False  # deleted while pinned: unlink deferred to last unpin
     arena_offset: Optional[int] = None
     created_at: float = field(default_factory=time.monotonic)
 
@@ -146,6 +162,20 @@ class SharedObjectStore:
         self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()  # LRU order
         self._lock = threading.RLock()
         self._used = 0
+        # Segment-reuse pool: deleted (unpinned, unspilled) file segments
+        # park here instead of unlinking, bucketed by their page-rounded
+        # file size. Reusing a segment hands the writer ALREADY-FAULTED
+        # tmpfs pages — a large put costs one memcpy into hot pages
+        # (~4-5x the fresh-page path, which pays allocation + zeroing).
+        # Safe against stale readers because consumers confirm a pin of
+        # the ObjectID (and the segment name it maps to) before trusting
+        # an attached view; a recycled inode fails that confirmation.
+        self._pool: Dict[int, list] = {}   # file_size -> [names]
+        self._pool_bytes = 0
+        # never let idle pooled segments crowd out live objects: the pool
+        # is capped at a quarter of the store even when the knob is larger
+        self._pool_cap = min(cfg.object_segment_pool_bytes,
+                             self.capacity // 4)
         # unique per store instance: several raylets (and their stores) can
         # share one process in in-process test clusters
         self._prefix = f"rtpu-{os.getpid()}-{os.urandom(3).hex()}-"
@@ -164,10 +194,24 @@ class SharedObjectStore:
             logger.debug("arena unavailable", exc_info=True)
 
     # ---- producer API ----------------------------------------------------
-    def create(self, object_id: ObjectID, size: int) -> ShmSegment:
-        """Allocate a segment for `object_id`; caller writes then seals."""
+    @staticmethod
+    def _bucket(size: int) -> int:
+        return (max(size, 1) + 4095) & ~4095  # page-rounded file size
+
+    def create(self, object_id: ObjectID, size: int,
+               info: Optional[dict] = None) -> ShmSegment:
+        """Allocate a segment for `object_id`; caller writes then seals.
+        `info`, when given, is filled with {"recycled": bool} so the writer
+        can pick its write strategy (mmap memcpy into hot recycled pages vs
+        writev into a fresh file)."""
         with self._lock:
-            if object_id in self._entries:
+            e = self._entries.get(object_id)
+            if e is not None:
+                if e.doomed and e.sealed:
+                    # re-put of an object deleted while readers were still
+                    # pinned (lineage re-execution): the immutable old copy
+                    # IS the object — resurrect it instead of reallocating
+                    e.doomed = False
                 raise FileExistsError(f"object {object_id} already exists")
             self._maybe_evict(size)
             if self._arena is not None and size <= self.arena_threshold:
@@ -178,20 +222,36 @@ class SharedObjectStore:
                         name=name, size=size, arena_offset=off)
                     self._used += size
                     return ArenaBuffer(self._arena.view(off, size), name, size)
-            shm = None
-            for _ in range(1000):
-                self._seq += 1
-                name = f"{self._prefix}{self._seq}"
-                try:
-                    shm = ShmSegment(name, size, create=True)
-                    break
-                except FileExistsError:
-                    continue  # stale segment from a crashed prior run
-            if shm is None:
-                raise RuntimeError("could not allocate shm segment")
-            self._entries[object_id] = _Entry(name=name, size=size)
+            shm, recycled = self._alloc_file_segment(size)
+            if info is not None:
+                info["recycled"] = recycled
+            self._entries[object_id] = _Entry(name=shm.name, size=size)
             self._used += size
             return shm
+
+    def _alloc_file_segment(self, size: int):
+        """Caller holds _lock. Returns (ShmSegment, recycled)."""
+        bucket = self._bucket(size)
+        names = self._pool.get(bucket)
+        while names:
+            name = names.pop()
+            self._pool_bytes -= bucket
+            try:
+                return ShmSegment(name, size), True
+            except OSError:
+                continue  # swept by an external cleaner; fall through
+        shm = None
+        for _ in range(1000):
+            self._seq += 1
+            name = f"{self._prefix}{self._seq}"
+            try:
+                shm = ShmSegment(name, size, create=True, file_size=bucket)
+                break
+            except FileExistsError:
+                continue  # stale segment from a crashed prior run
+        if shm is None:
+            raise RuntimeError("could not allocate shm segment")
+        return shm, False
 
     def seal(self, object_id: ObjectID) -> None:
         with self._lock:
@@ -202,9 +262,25 @@ class SharedObjectStore:
             self._entries.move_to_end(object_id)
 
     def put_bytes(self, object_id: ObjectID, data: bytes | memoryview) -> None:
-        shm = self.create(object_id, len(data))
+        n = len(data) if isinstance(data, (bytes, bytearray)) else data.nbytes
+        shm = self.create(object_id, n)
         try:
-            shm.buf[: len(data)] = data
+            if shm.name.startswith("@"):
+                shm.buf[:n] = data
+            else:
+                # fd write, not the mapping: populates tmpfs pages directly
+                # instead of zero-faulting a fresh mapping first (and on a
+                # recycled segment skips repopulating the page table)
+                fd = os.open(os.path.join(_SHM_DIR, shm.name), os.O_WRONLY)
+                try:
+                    mv = memoryview(data)
+                    if mv.format != "B" or mv.ndim != 1:
+                        mv = mv.cast("B")
+                    off = 0
+                    while off < n:
+                        off += os.write(fd, mv[off:])
+                finally:
+                    os.close(fd)
         finally:
             shm.close()
         self.seal(object_id)
@@ -276,73 +352,203 @@ class SharedObjectStore:
         return not errors
 
     # ---- consumer API ----------------------------------------------------
+    def status(self, object_id: ObjectID) -> Optional[str]:
+        """"sealed" | "unsealed" | None (absent or deleted-while-pinned)."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None or e.doomed:
+                return None
+            return "sealed" if e.sealed else "unsealed"
+
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
             e = self._entries.get(object_id)
-            return e is not None and e.sealed
+            return e is not None and e.sealed and not e.doomed
 
     def lookup(self, object_id: ObjectID) -> Optional[tuple[str, int]]:
         """Return (segment_name, size) for a sealed object, restoring from
-        spill if needed; None if absent."""
+        spill if needed; None if absent (or deleted-but-pinned)."""
         with self._lock:
             e = self._entries.get(object_id)
-            if e is None or not e.sealed:
+            if e is None or not e.sealed or e.doomed:
                 return None
             if e.spilled_path is not None:
                 self._restore(object_id, e)
             self._entries.move_to_end(object_id)
             return (e.name, e.size)
 
+    # ---- pin protocol ----------------------------------------------------
+    def pin(self, object_id: ObjectID) -> Optional[tuple[str, int]]:
+        """Pin a sealed object for a zero-copy reader and return its
+        CURRENT (segment_name, size); None if absent/unsealed/doomed.
+        While pinned the entry is excluded from spill and eviction, and a
+        delete() defers the unlink until the last unpin — so reader views
+        into the segment stay valid (and accounted) for their lifetime.
+        Restores from spill first: pinning declares intent to attach."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None or not e.sealed or e.doomed:
+                return None
+            if e.spilled_path is not None:
+                self._restore(object_id, e)
+            e.pinned += 1
+            self._entries.move_to_end(object_id)
+            return (e.name, e.size)
+
+    def unpin(self, object_id: ObjectID) -> None:
+        """Release one pin; finishes a deferred delete at the last one.
+        Unknown ids are ignored (a reader's compensating unpin after a
+        failed attach may race the owner's delete)."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None:
+                return
+            e.pinned = max(0, e.pinned - 1)
+            if e.doomed and e.pinned == 0:
+                self._entries.pop(object_id, None)
+                if e.arena_offset is not None:
+                    if self._arena is not None:
+                        self._arena.free(e.arena_offset)
+                    self._used -= e.size
+                else:
+                    self._reclaim(e)
+
     def get_buffer(self, object_id: ObjectID):
-        """In-process zero-copy read (same process as the store)."""
-        loc = self.lookup(object_id)
+        """In-process zero-copy read (same process as the store). The
+        buffer holds a PIN until close() — under the segment-reuse pool an
+        unpinned attach would be unsafe (a concurrent delete could recycle
+        and overwrite the inode beneath the view), so callers MUST close.
+        Scoped readers should prefer pinned_view."""
+        loc = self.pin(object_id)
         if loc is None:
             return None
-        name, size = loc
-        return attach_object(name, size)
+        try:
+            buf = attach_object(*loc)
+        except (FileNotFoundError, OSError):
+            self.unpin(object_id)
+            return None
+        inner_close = buf.close
+        released = []
+
+        def close():
+            if not released:
+                released.append(True)
+                inner_close()
+                self.unpin(object_id)
+
+        buf.close = close
+        return buf
+
+    @contextmanager
+    def pinned_view(self, object_id: ObjectID):
+        """Pin + attach + release in one scope: the shared from-view read
+        used by every server-side consumer (data-plane fetch, RPC chunk
+        serves). The pin keeps the segment out of spill/eviction for the
+        duration, so a long transfer can't race a spill into a
+        double-IO restore (or a recycled inode). Yields the buffer, or
+        None when the object is absent."""
+        loc = self.pin(object_id)
+        if loc is None:
+            yield None
+            return
+        buf = None
+        try:
+            try:
+                buf = attach_object(*loc, readonly=True)
+            except (FileNotFoundError, OSError):
+                yield None
+                return
+            yield buf
+        finally:
+            if buf is not None:
+                buf.close()
+            self.unpin(object_id)
 
     def read_bytes(self, object_id: ObjectID) -> Optional[bytes]:
-        buf = self.get_buffer(object_id)
-        if buf is None:
-            return None
-        try:
+        """Materializing read — ONLY for callers that need owned bytes
+        (the wire). Consumers that immediately deserialize should use
+        pinned_view + serialization.loads instead (no intermediate copy)."""
+        with self.pinned_view(object_id) as buf:
+            if buf is None:
+                return None
             return bytes(buf.view)
-        finally:
-            buf.close()
 
     # ---- lifecycle -------------------------------------------------------
     def delete(self, object_id: ObjectID) -> None:
         with self._lock:
-            e = self._entries.pop(object_id, None)
+            e = self._entries.get(object_id)
             if e is None:
                 return
+            if e.pinned > 0 and e.spilled_path is None:
+                # zero-copy (or pinned_view) readers still hold views into
+                # the segment / arena slot: hide the entry (lookup/contains
+                # say gone) but defer the reclaim — the last unpin runs it
+                e.doomed = True
+                return
+            self._entries.pop(object_id, None)
             if e.arena_offset is not None:
                 if self._arena is not None:
                     self._arena.free(e.arena_offset)
                 self._used -= e.size
             elif e.spilled_path is None:
-                self._unlink(e)
-                self._used -= e.size
+                self._reclaim(e)
             elif os.path.exists(e.spilled_path):
                 try:
                     os.unlink(e.spilled_path)
                 except OSError:
                     pass
 
+    def _reclaim(self, e: _Entry) -> None:
+        """Caller holds _lock. Retire a live file segment: park it in the
+        reuse pool (pages stay hot for the next same-bucket create),
+        evicting older pooled segments to make room — the workload's
+        CURRENT object size wins the pool. Oversized segments unlink."""
+        self._used -= e.size
+        bucket = self._bucket(e.size)
+        if bucket > self._pool_cap:
+            self._unlink(e)
+            return
+        need = self._pool_bytes + bucket - self._pool_cap
+        if need > 0:
+            self._drain_pool(need)
+        self._pool.setdefault(bucket, []).append(e.name)
+        self._pool_bytes += bucket
+
+    def _drain_pool(self, want: int) -> int:
+        """Caller holds _lock. Unlink pooled segments until `want` bytes
+        are freed (memory pressure beats reuse warmth). Returns freed."""
+        freed = 0
+        for bucket in sorted(self._pool, reverse=True):
+            names = self._pool[bucket]
+            while names and freed < want:
+                ShmSegment.unlink(names.pop())
+                self._pool_bytes -= bucket
+                freed += bucket
+            if freed >= want:
+                break
+        return freed
+
     def stats(self) -> dict:
         with self._lock:
             spilled = sum(1 for e in self._entries.values() if e.spilled_path)
+            pinned = sum(1 for e in self._entries.values() if e.pinned > 0)
             return {
                 "num_objects": len(self._entries),
                 "used_bytes": self._used,
                 "capacity_bytes": self.capacity,
                 "num_spilled": spilled,
+                "num_pinned": pinned,
+                "pinned_refs": sum(e.pinned for e in self._entries.values()),
+                "pool_bytes": self._pool_bytes,
             }
 
     def shutdown(self) -> None:
         with self._lock:
-            for oid in list(self._entries):
+            for oid, e in list(self._entries.items()):
+                e.pinned = 0  # process exiting: force-reclaim
+                e.doomed = False
                 self.delete(oid)
+            self._drain_pool(self._pool_bytes)
             if self._arena is not None:
                 self._arena.close()
                 self._arena.unlink()
@@ -359,15 +565,21 @@ class SharedObjectStore:
         (`object_spilling_threshold` 0.8, `ray_config_def.h:583`).
         """
         threshold = get_config().object_spilling_threshold
-        if self._used + incoming <= self.capacity * threshold:
+        budget = self.capacity * threshold - self._pool_bytes
+        if self._used + incoming <= budget:
             return
+        # reclaim idle pooled segments before spilling LIVE objects: pool
+        # warmth never costs a spill
+        self._drain_pool(int(self._used + incoming - budget))
+        budget = self.capacity * threshold - self._pool_bytes
         for oid in list(self._entries):
-            if self._used + incoming <= self.capacity * threshold:
+            if self._used + incoming <= budget:
                 break
             e = self._entries[oid]
             if (not e.sealed or e.spilled_path is not None or e.pinned > 0
                     or e.arena_offset is not None):
-                continue  # arena objects are small; only file segments spill
+                continue  # pinned entries hold reader views; arena objects
+                # are small — only idle file segments spill
             self._spill(oid, e)
 
     def _spill(self, object_id: ObjectID, e: _Entry) -> None:
@@ -388,10 +600,10 @@ class SharedObjectStore:
     def _restore(self, object_id: ObjectID, e: _Entry) -> None:
         assert e.spilled_path is not None
         self._maybe_evict(e.size)
-        self._seq += 1
-        name = f"{self._prefix}r{self._seq}"
-        shm = ShmSegment(name, e.size, create=True)
-        shm.buf[: e.size] = open(e.spilled_path, "rb").read()
+        shm, _ = self._alloc_file_segment(e.size)
+        name = shm.name
+        with open(e.spilled_path, "rb") as f:
+            shm.buf[: e.size] = f.read(e.size)
         shm.close()
         try:
             os.unlink(e.spilled_path)
@@ -403,11 +615,13 @@ class SharedObjectStore:
         logger.debug("restored %s from spill", object_id)
 
 
-def attach_object(name: str, size: int):
+def attach_object(name: str, size: int, readonly: bool = False):
     """Attach to a sealed object from any process on the node.
 
     `name` is either a /dev/shm segment name or "@<arena_path>:<offset>"
-    for objects living in the C++ shared arena.
+    for objects living in the C++ shared arena. With `readonly` the
+    mapping is PROT_READ, so every view (and numpy array over one) is
+    immutable — the aliasing contract for zero-copy get().
     """
     if name.startswith("@"):
         from ray_tpu.core.arena import attached_arena
@@ -417,4 +631,4 @@ def attach_object(name: str, size: int):
         if arena is None:
             raise FileNotFoundError(f"cannot attach arena {path}")
         return ArenaBuffer(arena.view(int(off), size), name, size)
-    return SharedBuffer(ShmSegment(name, size), size)
+    return SharedBuffer(ShmSegment(name, size, readonly=readonly), size)

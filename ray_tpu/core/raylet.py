@@ -196,8 +196,13 @@ class Raylet:
         self._bcast_seen_seq: Optional[int] = None
         self._catchup_inflight = False
 
-        # object pulls in flight: object_id -> list[(conn, req_id)] waiting
+        # object pulls in flight: object_id -> list[(conn, req_id, pin)]
         self._pending_pulls: Dict[ObjectID, List[Tuple]] = {}
+        # zero-copy reader pins per server connection (id(conn) -> {oid:
+        # count}): a reader worker that dies without unpinning has its
+        # pins reaped when its connection drops — the cross-process half
+        # of the pin lifecycle (finalizers cover the in-process half)
+        self._conn_pins: Dict[int, Dict[ObjectID, int]] = {}
         # admission control for chunked pulls (reference pull_manager.h:52):
         # bounds the total bytes of concurrently-materializing inbound objects
         self._pull_budget = _PullBudget(cfg.pull_admission_max_bytes)
@@ -2076,20 +2081,87 @@ class Raylet:
 
     # ------------------------------------------------------------ object plane
     def rpc_obj_create(self, conn, req_id, payload):
-        """Worker asks to allocate a segment it will write directly."""
+        """Worker asks to allocate a segment it will write directly
+        (file segments via writev — see _put_to_store; the reply's
+        `recycled` flag reports whether the reuse pool served it, mostly
+        for tests/diagnostics: a recycled segment's hot pages make the
+        write run at memory bandwidth)."""
         object_id, size = payload["object_id"], payload["size"]
+        info: dict = {}
         try:
-            shm = self.store.create(object_id, size)
+            shm = self.store.create(object_id, size, info=info)
             name = shm.name
             shm.close()
-            return {"ok": True, "name": name}
+            return {"ok": True, "name": name,
+                    "recycled": info.get("recycled", False)}
         except FileExistsError:
             return {"ok": False, "exists": True}
 
     def rpc_obj_seal(self, conn, req_id, payload):
+        """Fire-and-forget on the put hot path (the single-writer seal
+        piggybacks on the same ordered connection as obj_create, so a
+        blocking round-trip buys nothing)."""
         self.store.seal(payload["object_id"])
         self._resolve_pulls(payload["object_id"])
         return True
+
+    def rpc_obj_pin(self, conn, req_id, payload):
+        """Pin a local sealed object for a zero-copy reader; reply is the
+        authoritative (segment_name, size) or None. Issued as a CALL
+        pipelined with the reader's optimistic attach: the reader only
+        trusts its views once this reply confirms the name it attached —
+        which makes segment recycling safe (a recycled inode can't match).
+        Pins are tracked per connection and reaped if the reader dies."""
+        loc = self.store.pin(payload["object_id"])
+        if loc is not None:
+            self._track_pin(conn, payload["object_id"])
+        return loc
+
+    def rpc_obj_unpin(self, conn, req_id, payload):
+        """Notify: a reader's last view over the segment was GC'd (or its
+        optimistic attach failed and this is the compensating release)."""
+        oid = payload["object_id"]
+        key = id(conn) if conn is not None else None
+        with self._lock:
+            m = self._conn_pins.get(key)
+            if m is None or oid not in m:
+                return True  # pin never landed (or already reaped): no-op
+            m[oid] -= 1
+            if m[oid] <= 0:
+                m.pop(oid, None)
+        self.store.unpin(oid)
+        return True
+
+    def _track_pin(self, conn, oid) -> None:
+        key = id(conn) if conn is not None else None
+        with self._lock:
+            m = self._conn_pins.get(key)
+            if m is None:
+                m = self._conn_pins[key] = {}
+                if conn is not None:
+                    conn.on_close.append(
+                        lambda c, k=key: self._reap_conn_pins(k))
+            m[oid] = m.get(oid, 0) + 1
+        if conn is not None and not getattr(conn, "alive", True):
+            # the connection may have closed BEFORE our on_close append —
+            # its callbacks already ran and will never fire again (a pin
+            # taken for a deferred pull reply whose requester crashed
+            # mid-pull). Reap now; _reap_conn_pins pops the map under the
+            # lock, so racing with a late callback is idempotent.
+            self._reap_conn_pins(key)
+
+    def _reap_conn_pins(self, key: int) -> None:
+        """A pinning reader's connection died: release everything it held
+        (reference: plasma client disconnect releases its refs)."""
+        with self._lock:
+            m = self._conn_pins.pop(key, None)
+        if not m:
+            return
+        for oid, count in m.items():
+            for _ in range(count):
+                self.store.unpin(oid)
+        logger.debug("reaped %d pins from dead reader connection",
+                     sum(m.values()))
 
     def rpc_obj_put_bytes(self, conn, req_id, payload):
         object_id = payload["object_id"]
@@ -2105,6 +2177,8 @@ class Raylet:
 
     def rpc_obj_delete(self, conn, req_id, payload):
         self.store.delete(payload["object_id"])
+        # a pull parked on the (now unreachable) seal must not hang
+        self._resolve_pulls(payload["object_id"], "object deleted")
         return True
 
     def rpc_obj_stats(self, conn, req_id, payload):
@@ -2112,7 +2186,9 @@ class Raylet:
 
     def rpc_fetch_object(self, conn, req_id, payload):
         """Peer raylet requests the object bytes (single-shot transfer;
-        small-object fast path — big objects go through the chunk RPCs)."""
+        small-object fast path — big objects go through the chunk RPCs).
+        The copy into the reply frame is the wire's — read_bytes rides a
+        pinned view, no extra staging."""
         data = self.store.read_bytes(payload["object_id"])
         return data  # None if not here
 
@@ -2131,16 +2207,15 @@ class Raylet:
     def rpc_fetch_object_chunk(self, conn, req_id, payload):
         """Serve one bounded slice of a sealed object, read straight out of
         the shm segment — the sender never materializes the whole object
-        (reference ObjectBufferPool chunk reads, object_manager.proto:61)."""
-        buf = self.store.get_buffer(payload["object_id"])
-        if buf is None:
-            return None
-        try:
+        (reference ObjectBufferPool chunk reads, object_manager.proto:61).
+        Pinned for the read so memory pressure can't spill the segment
+        between a peer's chunks (each spill would cost a full restore)."""
+        with self.store.pinned_view(payload["object_id"]) as buf:
+            if buf is None:
+                return None
             off = payload["offset"]
             ln = payload["length"]
             return bytes(buf.view[off:off + ln])
-        finally:
-            buf.close()
 
     def rpc_pull_object(self, conn, req_id, payload):
         """Worker asks: make object local, reply (name,size) when done.
@@ -2149,12 +2224,19 @@ class Raylet:
         owner's location table, cf. OwnershipBasedObjectDirectory).
         """
         object_id: ObjectID = payload["object_id"]
-        loc = self.store.lookup(object_id)
-        if loc is not None:
-            return loc
+        pin = bool(payload.get("pin"))
+        if pin:
+            loc = self.store.pin(object_id)
+            if loc is not None:
+                self._track_pin(conn, object_id)
+                return loc
+        else:
+            loc = self.store.lookup(object_id)
+            if loc is not None:
+                return loc
         with self._lock:
             waiters = self._pending_pulls.setdefault(object_id, [])
-            waiters.append((conn, req_id))
+            waiters.append((conn, req_id, pin))
             first = len(waiters) == 1
         if first:
             t = threading.Thread(
@@ -2174,7 +2256,7 @@ class Raylet:
                                  timeout=30)
                 if meta is None:
                     err = f"object {object_id} not found at {source}"
-                elif self._try_adopt_local(object_id, meta):
+                elif self._try_adopt_local(object_id, meta, peer):
                     pass  # same-host kernel-side copy succeeded
                 elif meta["size"] <= chunk:
                     # small objects NEVER wait on the pull budget: a 2 MiB
@@ -2193,12 +2275,33 @@ class Raylet:
                     err = self._pull_chunked(peer, object_id, meta["size"],
                                              meta.get("data_addr"))
             else:
-                err = f"no source for object {object_id}"
+                # source is THIS raylet (or unknown) and lookup missed: a
+                # local producer may have created-but-not-yet-sealed the
+                # segment (seal is a fire-and-forget notify on the put fast
+                # path) — wait for the seal, BOUNDED so a writer that died
+                # mid-put can't park the waiters forever.
+                if self.store.status(object_id) == "unsealed":
+                    deadline = (time.monotonic()
+                                + get_config().object_transfer_chunk_timeout_s)
+                    while time.monotonic() < deadline:
+                        if self.store.status(object_id) != "unsealed":
+                            break
+                        with self._lock:
+                            if object_id not in self._pending_pulls:
+                                return  # seal/delete already resolved them
+                        time.sleep(0.05)
+                    if self.store.contains(object_id):
+                        self._resolve_pulls(object_id)
+                        return
+                    err = f"object {object_id} was created but never sealed"
+                else:
+                    err = f"no source for object {object_id}"
         except Exception as e:
             err = f"pull failed: {e}"
         self._resolve_pulls(object_id, err)
 
-    def _try_adopt_local(self, object_id: ObjectID, meta: dict) -> bool:
+    def _try_adopt_local(self, object_id: ObjectID, meta: dict,
+                         peer: rpc.RpcClient) -> bool:
         """Same-host fast path: the source raylet shares this machine's
         /dev/shm, so 'transfer' is a kernel-side copy_file_range of the
         segment file (no sockets, no fault-zeroing). False → fall through
@@ -2214,7 +2317,16 @@ class Raylet:
         if gate:
             self._pull_budget.acquire(size)
         try:
-            return self.store.adopt_local_copy(object_id, seg, size)
+            ok = self.store.adopt_local_copy(object_id, seg, size)
+            if ok and not self._adopt_source_stable(peer, object_id, seg):
+                # the source store may RECYCLE a deleted segment's inode
+                # (reuse pool) — an adopt that raced the delete could have
+                # copied overwritten bytes. The source re-confirming the
+                # same (object, segment) AFTER our copy proves the entry
+                # was live for the whole window; otherwise discard.
+                self.store.delete(object_id)
+                return False
+            return ok
         except FileExistsError:
             return False  # concurrent materialization: chunked path waits on it
         except Exception:
@@ -2224,6 +2336,20 @@ class Raylet:
         finally:
             if gate:
                 self._pull_budget.release(size)
+
+    @staticmethod
+    def _adopt_source_stable(peer: rpc.RpcClient, object_id: ObjectID,
+                             seg: str) -> bool:
+        """Post-copy verification for the same-host adopt fast path: the
+        source still holds `object_id` in the SAME segment AFTER our
+        kernel-side copy. True means no delete (and so no inode recycle)
+        could have raced the copy window."""
+        try:
+            meta = peer.call("fetch_object_meta", {"object_id": object_id},
+                             timeout=10)
+        except Exception:
+            return False
+        return meta is not None and meta.get("segment") == seg
 
     def _pull_chunked(self, peer: rpc.RpcClient, object_id: ObjectID,
                       size: int, data_addr: Optional[str] = None) -> Optional[str]:
@@ -2353,12 +2479,13 @@ class Raylet:
 
     def _push_to_targets(self, object_id: ObjectID, targets: List[str],
                          owner: str) -> None:
-        buf = self.store.get_buffer(object_id)
-        if buf is None:
-            logger.warning("push of %s requested but object not local",
-                           object_id)
-            return
-        try:
+        # pinned for the whole fan-out: a spill mid-push would unlink the
+        # segment under N concurrent streams
+        with self.store.pinned_view(object_id) as buf:
+            if buf is None:
+                logger.warning("push of %s requested but object not local",
+                               object_id)
+                return
             src = memoryview(buf.view)
 
             def push_one(target: str) -> None:
@@ -2397,8 +2524,6 @@ class Raylet:
 
             fan_out([lambda t=t: push_one(t) for t in targets],
                     timeout=get_config().object_transfer_chunk_timeout_s * 4)
-        finally:
-            buf.close()
 
     def _resolve_pulls(self, object_id: ObjectID, err: Optional[str] = None) -> None:
         with self._lock:
@@ -2406,8 +2531,21 @@ class Raylet:
         if not waiters:
             return
         loc = self.store.lookup(object_id)
-        for conn, req_id in waiters:
-            if loc is not None:
+        for conn, req_id, pin in waiters:
+            if pin:
+                # pin BEFORE the reply so the object can't evict (or its
+                # segment recycle) in the reply->attach window — cross-node
+                # pulls land sealed-and-pinnable. A pin that misses means
+                # the object vanished again: error, the reader re-pulls.
+                pinned = self.store.pin(object_id)
+                if pinned is not None:
+                    self._track_pin(conn, object_id)
+                    conn.reply(req_id, pinned)
+                else:
+                    conn.reply(req_id,
+                               err or f"object {object_id} unavailable",
+                               is_error=True)
+            elif loc is not None:
                 conn.reply(req_id, loc)
             else:
                 conn.reply(req_id, err or f"object {object_id} unavailable", is_error=True)

@@ -27,6 +27,7 @@ import random
 import threading
 import time
 import traceback
+import weakref
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
@@ -69,6 +70,19 @@ def set_current_worker(w: Optional["CoreWorker"]) -> None:
         _current_worker = w
 
 
+def _send_unpin(worker_ref, oid) -> None:
+    """weakref.finalize target for zero-copy reader views: module-level so
+    the finalizer holds no strong reference to the worker — a leaked view
+    must never keep a shut-down CoreWorker (and its sockets) alive."""
+    w = worker_ref()
+    if w is None or w._shutdown.is_set():
+        return  # raylet-side conn-close reaping covers this case
+    try:
+        w.raylet.notify("obj_unpin", {"object_id": oid})
+    except Exception:
+        pass  # raylet gone: its store died with it
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -81,6 +95,10 @@ class _ObjectState:
     location: Optional[str] = None  # raylet address holding the primary copy
     extra_locations: List[str] = field(default_factory=list)  # pulled copies
     size: int = 0
+    # (segment_name, attach_size) of the primary copy at `location`: lets a
+    # co-located reader attach the shm segment directly — no pull_object
+    # round-trip (stale after spill/restore; readers fall back and re-learn)
+    segment: Optional[Tuple[str, int]] = None
     local_refs: int = 0
     borrowers: int = 0
     submitted_task_deps: int = 0    # in-flight tasks depending on this object
@@ -242,6 +260,21 @@ class CoreWorker:
 
         self._registered_copies: "OrderedDict[ObjectID, bool]" = OrderedDict()
         self._registered_copies_lock = threading.Lock()
+        # zero-copy object plane: worker-side location cache of local
+        # (segment_name, attach_size) per object — repeat gets of a hot
+        # object skip owner resolution AND pull_object entirely (validated
+        # by the pin-confirm protocol, so a stale entry can only cost a
+        # fallback, never wrong data)
+        self._seg_cache: "OrderedDict[ObjectID, Tuple[str, int]]" = OrderedDict()
+        self._seg_cache_lock = threading.Lock()
+        # writer-side mapping cache: segment name -> persistent writable
+        # mmap. The store's reuse pool hands the same segments back to hot
+        # writers; writing through a mapping whose page tables are already
+        # populated runs at memory bandwidth (~2x the writev path, ~10x a
+        # fresh mapping's zero-fault+copy). Bounded LRU (entries + bytes).
+        self._write_maps: "OrderedDict[str, Any]" = OrderedDict()
+        self._write_maps_bytes = 0
+        self._write_maps_lock = threading.Lock()
         # shared outstanding wait-futures: (owner, oid) -> Future (LRU-capped)
         self._wait_futures: "OrderedDict[tuple, Any]" = OrderedDict()
         self._wait_futures_lock = threading.Lock()
@@ -668,11 +701,12 @@ class CoreWorker:
                 st.size = len(blob)
                 self._obj_cv.notify_all()
         else:
-            self._put_to_store(oid, s)
+            seg = self._put_to_store(oid, s)
             with self._obj_lock:
                 st.state = "plasma"
                 st.location = self.raylet_address
                 st.size = s.total_bytes
+                st.segment = seg
                 self._obj_cv.notify_all()
         # Refs nested in the stored value: shipping them into the store means
         # borrows can materialize later from any reader. Owned inner objects
@@ -768,18 +802,62 @@ class CoreWorker:
                 return None, None
             return st.state, st.inline_blob
 
-    def _put_to_store(self, oid: ObjectID, s: SerializedObject) -> None:
-        """Write a serialized object into the node store (zero-copy write)."""
-        size = s.total_bytes + 12 + 8 * len(s.buffers)
+    def _put_to_store(self, oid: ObjectID,
+                      s: SerializedObject) -> Optional[Tuple[str, int]]:
+        """Write a serialized object into the node store and seal it.
+
+        One control round-trip total: obj_create is the only CALL (the
+        allocation decision must come back); the seal rides the same
+        ordered connection as a fire-and-forget notify. The write itself
+        picks the cheapest memory path: a recycled segment's pages are
+        already faulted, so memcpy through a mapping runs at memory
+        bandwidth; a fresh file takes os.writev, which populates tmpfs
+        pages directly instead of zero-faulting a fresh mapping first
+        (the buffer-protocol put fast path — numpy/JAX host array buffers
+        go straight from the array to the segment, no flatten).
+
+        Returns (segment_name, attach_size), or None if the object
+        already existed."""
+        size = s.framed_size
         r = self.raylet.call("obj_create", {"object_id": oid, "size": size})
         if not r.get("ok"):
-            return  # already exists
-        buf = attach_object(r["name"], size)
-        try:
-            s.write_into(buf.view)
-        finally:
-            buf.close()
-        self.raylet.call("obj_seal", {"object_id": oid})
+            return None  # already exists
+        name = r["name"]
+        if name.startswith("@"):
+            buf = attach_object(name, size)  # arena slot: write in place
+            try:
+                s.write_into(buf.view)
+            finally:
+                buf.close()
+        else:
+            dst = self._writer_map_view(name, size)
+            if dst is not None:
+                # hottest path: a recycled segment THIS process has written
+                # before — page tables already populated, pure memcpy
+                try:
+                    s.write_into(dst)
+                finally:
+                    dst.release()
+            else:
+                # writev, never a fresh writer-side mapping: a fresh
+                # mapping zero-faults every page before the copy, and even
+                # on a recycled (hot) segment populating the page table
+                # costs ~5x the fd write path. Cache a mapping for the
+                # segment's NEXT reuse by this process.
+                from ray_tpu.core.object_store import _SHM_DIR
+
+                fd = os.open(os.path.join(_SHM_DIR, name), os.O_WRONLY)
+                try:
+                    s.write_to_fd(fd)
+                finally:
+                    os.close(fd)
+                self._writer_map_add(name)
+        self.raylet.notify("obj_seal", {"object_id": oid})
+        seg = None
+        if not name.startswith("@"):
+            seg = (name, size)
+            self._seg_cache_put(oid, name, size)
+        return seg
 
     # ------------------------------------------------------------------ get
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
@@ -885,7 +963,12 @@ class CoreWorker:
                     return {"kind": "inline", "data": st.inline_blob}
                 if st.state == "error":
                     return {"kind": "error", "data": st.inline_blob}
-                return {"kind": "plasma", "raylet": st.location, "size": st.size}
+                info = {"kind": "plasma", "raylet": st.location,
+                        "size": st.size}
+                if st.segment is not None:
+                    info["segment"] = st.segment
+                    info["segment_at"] = st.location
+                return info
         # borrowed: ask the owner
         timeout = None if deadline is None else max(deadline - time.monotonic(), 0.01)
         try:
@@ -902,13 +985,40 @@ class CoreWorker:
         return info
 
     def _fetch_plasma(self, ref: ObjectRef, info: dict, deadline: Optional[float]) -> Any:
+        """Materialize a plasma object's value.
+
+        Same-node fast path (zero-copy): when the segment name is known —
+        from the worker-side location cache or the owner's reply — attach
+        it and deserialize IN PLACE, pipelined with an authoritative
+        obj_pin round-trip; the returned value's large buffers are
+        read-only views into shared memory, pinned on the raylet until the
+        reader's last view is GC'd. Fallback: pull_object (which pins
+        before replying), then attach; only arena-resident objects (and
+        zero-copy-disabled configs) pay a copy out of the segment."""
         source = info["raylet"]
-        last_err: Exception | None = None
+        zc = get_config().object_zero_copy_enabled
+        if zc:
+            cached = self._seg_cache_get(ref.id)
+            if cached is None and info.get("segment") is not None \
+                    and info.get("segment_at") == self.raylet_address:
+                cached = tuple(info["segment"])
+            if cached is not None and not cached[0].startswith("@"):
+                value, ok = self._pinned_load(ref.id, cached[0], cached[1])
+                if ok:
+                    return value
+        last_err: object = None
         for _ in range(3):
             timeout = None if deadline is None else max(deadline - time.monotonic(), 0.01)
             try:
+                # ALWAYS pin the pull — even on the copy path. The store's
+                # segment-reuse pool means an unpinned segment deleted
+                # mid-copy could be recycled and overwritten under the
+                # reader (pre-pool, the open mapping kept the dead inode's
+                # bytes stable); the pin blocks the delete until the copy
+                # (or the zero-copy reader's last view) releases it.
                 loc = self.raylet.call(
-                    "pull_object", {"object_id": ref.id, "source": source},
+                    "pull_object",
+                    {"object_id": ref.id, "source": source, "pin": True},
                     timeout=timeout)
             except TimeoutError:
                 raise GetTimeoutError(
@@ -920,19 +1030,187 @@ class CoreWorker:
                     f"object {ref.id} could not be pulled from {source}: {e}"
                 ) from None
             name, size = loc
+            if zc and not name.startswith("@"):
+                value, ok = self._pinned_load(ref.id, name, size,
+                                              pre_pinned=True)
+                if ok:
+                    return value
+                last_err = "pinned segment vanished"
+                continue
+            # copy path: arena-resident objects (their slots recycle on
+            # free, so views may only alias shm UNDER a pin — the pull
+            # reply's pin covers exactly this copy window) or zc disabled
             try:
                 buf = attach_object(name, size)
             except FileNotFoundError as e:
                 # Segment was spilled/evicted between lookup and attach; the
                 # next pull_object restores it from spill.
+                self._unpin_notify(ref.id)
                 last_err = e
                 continue
             try:
                 data = bytes(buf.view)  # one copy out of shm: values own their memory
             finally:
                 buf.close()
+                self._unpin_notify(ref.id)
             return serialization.loads(data)
         raise ObjectLostError(f"object {ref.id} vanished during fetch: {last_err}")
+
+    # ------------------------------------------------ zero-copy pin plumbing
+    def _pinned_load(self, oid: ObjectID, name: str, size: int,
+                     pre_pinned: bool = False):
+        """Attach a local segment and deserialize in place, returning
+        (value, ok). The attach + deserialize run OPTIMISTICALLY, pipelined
+        with the obj_pin round-trip; the value is only trusted once the pin
+        reply confirms the exact segment we attached (which is what makes
+        the store's segment recycling safe — a recycled inode can never
+        confirm). With `pre_pinned` the pin is already held (pull_object
+        reply / a mismatch retry), so no confirmation round-trip is needed.
+        On ok=True an unpin finalizer is armed on the mapping: it fires
+        when the reader's LAST view over the segment is GC'd."""
+        fut = None
+        if not pre_pinned:
+            try:
+                fut = self.raylet.call_future("obj_pin", {"object_id": oid})
+            except Exception:
+                return None, False
+        attached = None
+        value = None
+        err = None
+        try:
+            attached = attach_object(name, size, readonly=True)
+            value = serialization.loads_view(attached.view)
+        except Exception as e:
+            # garbage from a recycled segment can fail to unpickle; a
+            # vanished one fails to open — either way the pin reply decides
+            err = e
+        if fut is not None:
+            try:
+                loc = fut.result(
+                    timeout=get_config().rpc_connect_timeout_s)
+            except Exception:
+                # reply lost/timed out — but the pin REQUEST may still be
+                # in flight and land later. The compensating unpin rides
+                # the same ordered connection, so it is processed after
+                # the pin if it landed (and is a tracked-map no-op if it
+                # didn't) — without this, a slow raylet leaks a pin that
+                # blocks reclaim for the connection's lifetime.
+                self._unpin_notify(oid)
+                self._seg_cache_drop(oid)
+                return None, False
+            if loc is None:
+                # pin missed: the object is gone here (deleted, or spilled
+                # and not restorable) — nothing to release, fall back
+                self._seg_cache_drop(oid)
+                return None, False
+            if tuple(loc) != (name, size):
+                self._seg_cache_drop(oid)
+                if loc[0].startswith("@"):
+                    # the object now lives in the ARENA (deleted + re-put
+                    # by lineage re-execution): arena slots are not
+                    # zero-copy eligible — release the pin and let the
+                    # pull path's pinned copy handle it
+                    self._unpin_notify(oid)
+                    return None, False
+                # pinned, but the segment moved (spill+restore): retry on
+                # the authoritative location with the pin already held
+                return self._pinned_load(oid, loc[0], loc[1],
+                                         pre_pinned=True)
+        if err is not None:
+            # the pin IS held (confirmed or pre-held) but the local attach/
+            # decode failed: release it and fall back to the pull path
+            self._unpin_notify(oid)
+            self._seg_cache_drop(oid)
+            return None, False
+        self._seg_cache_put(oid, name, size)
+        self._arm_unpin_finalizer(oid, attached)
+        return value, True
+
+    def _arm_unpin_finalizer(self, oid: ObjectID, attached) -> None:
+        """Tie the raylet-side pin to the mapping's lifetime: every view
+        handed out by loads_view keeps the mmap alive (buffer-protocol
+        exporter chain), so the finalizer fires exactly when the reader's
+        last view dies — including 'immediately', for values that kept no
+        buffer (pure-payload pickles)."""
+        weakref.finalize(attached._shm._mmap, _send_unpin,
+                         weakref.ref(self), oid)
+
+    def _unpin_notify(self, oid: ObjectID) -> None:
+        try:
+            self.raylet.notify("obj_unpin", {"object_id": oid})
+        except Exception:
+            logger.debug("obj_unpin for %s lost", oid, exc_info=True)
+
+    def _seg_cache_put(self, oid: ObjectID, name: str, size: int) -> None:
+        with self._seg_cache_lock:
+            self._seg_cache[oid] = (name, size)
+            self._seg_cache.move_to_end(oid)
+            cap = get_config().object_location_cache_entries
+            while len(self._seg_cache) > cap:
+                self._seg_cache.popitem(last=False)
+
+    def _seg_cache_get(self, oid: ObjectID) -> Optional[Tuple[str, int]]:
+        with self._seg_cache_lock:
+            e = self._seg_cache.get(oid)
+            if e is not None:
+                self._seg_cache.move_to_end(oid)
+            return e
+
+    def _seg_cache_drop(self, oid: ObjectID) -> None:
+        with self._seg_cache_lock:
+            self._seg_cache.pop(oid, None)
+
+    _WRITE_MAPS_MAX = 16
+
+    def _writer_map_view(self, name: str, size: int):
+        """Writable view over the cached mapping of a segment obj_create
+        just granted us (create grants exclusive write ownership until
+        seal, so writing through a retained mapping is safe — stale
+        entries for names the store has moved on from are never handed
+        back by create). The view is exported UNDER the lock: a racing
+        LRU eviction's close() then raises BufferError and is skipped,
+        so a concurrent put can never be handed a closed mapping."""
+        with self._write_maps_lock:
+            m = self._write_maps.get(name)
+            if m is None or len(m) < size:
+                return None
+            self._write_maps.move_to_end(name)
+            return memoryview(m)[:size]
+
+    def _writer_map_add(self, name: str) -> None:
+        import mmap as _mmap
+
+        from ray_tpu.core.object_store import _SHM_DIR
+
+        path = os.path.join(_SHM_DIR, name)
+        try:
+            fd = os.open(path, os.O_RDWR)
+            try:
+                m = _mmap.mmap(fd, os.fstat(fd).st_size)
+            finally:
+                os.close(fd)
+        except (OSError, ValueError):
+            return
+        evicted = []
+        with self._write_maps_lock:
+            old = self._write_maps.pop(name, None)
+            if old is not None:
+                self._write_maps_bytes -= len(old)
+                evicted.append(old)
+            self._write_maps[name] = m
+            self._write_maps_bytes += len(m)
+            cap_bytes = get_config().object_segment_pool_bytes
+            while self._write_maps and (
+                    len(self._write_maps) > self._WRITE_MAPS_MAX
+                    or self._write_maps_bytes > cap_bytes):
+                _, old = self._write_maps.popitem(last=False)
+                self._write_maps_bytes -= len(old)
+                evicted.append(old)
+        for old in evicted:
+            try:
+                old.close()
+            except (BufferError, ValueError):
+                pass  # transient exported view; GC unmaps
 
     def _note_pulled_copy(self, ref: ObjectRef) -> None:
         """A successful pull materialized a copy on OUR raylet: register it
@@ -1040,6 +1318,8 @@ class CoreWorker:
             with self._obj_lock:
                 st0 = self._objects.get(oid)
                 if st0 is not None and st0.state == "plasma":
+                    if live != st0.location:
+                        st0.segment = None  # name was the OLD primary's
                     st0.location = live
                     st0.extra_locations = []  # dead copies re-register on pull
             return True
@@ -1240,10 +1520,16 @@ class CoreWorker:
         # Location spreading (reference OwnershipBasedObjectDirectory with
         # multiple locations): readers that pulled a copy register it, and
         # later readers are pointed at a random holder — a 1 GiB broadcast
-        # fans out across copies instead of hammering the primary.
+        # fans out across copies instead of hammering the primary. The
+        # primary's segment name rides along so a reader CO-LOCATED with it
+        # attaches directly, skipping the pull_object round-trip.
         locs = [st.location] + st.extra_locations
-        return {"kind": "plasma", "raylet": random.choice(locs),
+        info = {"kind": "plasma", "raylet": random.choice(locs),
                 "size": st.size}
+        if st.segment is not None:
+            info["segment"] = st.segment
+            info["segment_at"] = st.location
+        return info
 
     def rpc_add_object_location(self, conn, req_id, payload):
         """A reader materialized a copy of our object on its raylet."""
@@ -1406,6 +1692,7 @@ class CoreWorker:
                     st.extra_locations = []  # stale copies died with the old run
                     st.size = entry[3]
                     contained = entry[4] if len(entry) > 4 else ()
+                    st.segment = entry[5] if len(entry) > 5 else None
                 elif kind == "error":
                     st.state = "error"
                     st.inline_blob = entry[2]
@@ -1451,6 +1738,7 @@ class CoreWorker:
                 st.extra_locations = []
                 st.size = entry[3]
                 contained = entry[4] if len(entry) > 4 else ()
+                st.segment = entry[5] if len(entry) > 5 else None
             with self._pending_lock:
                 pend = self._pending_tasks.get(task_id)
                 spec = pend[0] if pend else None
@@ -2966,8 +3254,11 @@ class CoreWorker:
                           for r in (s.contained_refs or ())})
         if s.total_bytes <= get_config().max_direct_call_object_size:
             return ("inline", oid, s.to_bytes(), contained)
-        self._put_to_store(oid, s)
-        return ("plasma", oid, self.raylet_address, s.total_bytes, contained)
+        seg = self._put_to_store(oid, s)
+        # the segment name rides the result entry so a CO-LOCATED owner can
+        # zero-copy attach its task results without a pull round-trip
+        return ("plasma", oid, self.raylet_address, s.total_bytes, contained,
+                seg)
 
     def _deserialize_args(self, args: List[Tuple], kwargs_blob: Optional[bytes]):
         out = []

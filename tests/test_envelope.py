@@ -25,6 +25,46 @@ _SLACK = 0.5
 # a cold spawn on a loaded CI box.
 _R06_ACTORS_TO_FIRST_PING_S = 49.21
 
+# Committed OBJPLANE_r14.json values (zero-copy object plane: pinned shm
+# views on get(), collapsed per-object RPCs, segment recycling). The rows
+# run at FULL sizes in every profile, so the floors compare like with
+# like; 0.5x slack per the r05/r06 discipline — they catch the fast path
+# silently dropping out (a copy sneaking back into same-node get, the
+# seal turning back into a round-trip), not scheduler-noise drift.
+_R14 = {
+    "put_get_10mb_bytes": 7_364_988_504.1,   # bytes/s (5.63x the r10 run)
+    "np_roundtrip_100mb": 13_679_092_820.0,  # bytes/s
+    "arg_1mb_fanout": 302.7,                 # tasks/s through one shared ref
+}
+# The byte-rate rows are dominated by ONE memory pass per cycle, so the
+# committed numbers encode the committing box's memory bandwidth. On a
+# slower machine the binding floor is a FRACTION of that machine's own
+# measured copy bandwidth instead (the effective floor takes the min):
+# the pre-PR copy-per-get path ran at ~0.09x memcpy bandwidth, so these
+# ratios still catch a collapse anywhere while never demanding more than
+# the hardware can move.
+_R14_MEMBW_RATIO = {
+    "put_get_10mb_bytes": 0.30,
+    "np_roundtrip_100mb": 0.45,
+}
+
+
+def _memcpy_bytes_per_s() -> float:
+    """This machine's large-copy bandwidth (the unit the byte-rate floors
+    are denominated in)."""
+    import time
+
+    import numpy as np
+
+    src = np.zeros(64 << 20, dtype=np.uint8)
+    dst = np.empty_like(src)
+    np.copyto(dst, src)  # warm both buffers
+    t0 = time.perf_counter()
+    reps = 5
+    for _ in range(reps):
+        np.copyto(dst, src)
+    return reps * src.nbytes / (time.perf_counter() - t0)
+
 
 def test_envelope_smoke(tmp_path):
     from ray_tpu.envelope import run_envelope
@@ -63,6 +103,20 @@ def test_envelope_smoke(tmp_path):
         f"the {budget:.1f}s budget ({_SLACK}x r06's "
         f"{_R06_ACTORS_TO_FIRST_PING_S}s for 100x the actors): the warm "
         f"worker pool has collapsed back to cold-spawn behavior")
+    # --- object-plane regression floors vs OBJPLANE_r14.json (PR 14) ---
+    membw = _memcpy_bytes_per_s()
+    for row, floor_src in _R14.items():
+        floor = _SLACK * floor_src
+        ratio = _R14_MEMBW_RATIO.get(row)
+        if ratio is not None:
+            floor = min(floor, ratio * membw)
+        assert rates[row] >= floor, (
+            f"{row} {rates[row]} fell below the r14 object-plane floor "
+            f"{floor:.3g} (min of {_SLACK}x artifact {floor_src} and "
+            f"{ratio}x this machine's {membw:.3g} B/s memcpy): the "
+            f"zero-copy pin path has collapsed back to copy-per-get "
+            f"behavior")
+
     # the burst must ride the warm pool on fork-capable platforms: a
     # silent fall-through to all-cold spawns is a regression even when
     # it happens to fit the time budget. Leases served by ALREADY-IDLE

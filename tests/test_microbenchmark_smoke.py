@@ -12,7 +12,8 @@ def test_microbenchmark_quick_mode(ray_start_regular):
     by_name = {r["benchmark"]: r for r in rows}
     expected = {"tasks_sync_batch", "task_roundtrip", "tasks_1kb_arg_batch",
                 "actor_calls_sync_batch", "actor_call_roundtrip",
-                "actor_echo_1kb_batch", "put_1kb", "put_get_1mb_bytes",
+                "actor_echo_1kb_batch", "put_1kb", "put_get_10mb_bytes",
+                "np_roundtrip_100mb", "arg_1mb_fanout",
                 "task_submit_p50", "task_wire_bytes_first",
                 "task_wire_bytes_steady", "task_e2e_p50",
                 "task_completions_per_s"}

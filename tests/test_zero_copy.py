@@ -1,0 +1,225 @@
+"""Zero-copy object plane: pin lifecycle + aliasing contract.
+
+Covers the acceptance tests of the pin protocol (PR 14): same-node get()
+returns read-only views that ALIAS the shm segment (no heap copy); pinned
+segments survive eviction pressure, spill, and owner-side delete until the
+last reader view is GC'd; the unpin fires via finalizer; and the raylet
+reaps the pins of a reader worker that dies without releasing them.
+"""
+
+import gc
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.core.ids import ObjectID, TaskID
+from ray_tpu.core.object_store import _SHM_DIR, SharedObjectStore
+
+
+def _oid(i):
+    return ObjectID.for_task_return(TaskID(b"z" * 16), i + 1)
+
+
+def _store_stats(w):
+    return w.raylet.call("obj_stats", {}, timeout=10)
+
+
+def _await(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        gc.collect()
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# --------------------------------------------------------------- store-level
+
+
+def test_pin_blocks_spill_and_eviction(tmp_path):
+    store = SharedObjectStore(capacity=16 << 20, spill_dir=str(tmp_path))
+    try:
+        store.arena_threshold = 0  # force the file path
+        store._pool_cap = 0        # no recycling: unlinks are observable
+        pinned_oid = _oid(0)
+        store.put_bytes(pinned_oid, b"p" * (2 << 20))
+        loc = store.pin(pinned_oid)
+        assert loc is not None
+        # enough pressure that everything unpinned spills
+        for i in range(1, 10):
+            store.put_bytes(_oid(i), b"x" * (2 << 20))
+        assert store._entries[pinned_oid].spilled_path is None, \
+            "pinned segment must not spill under pressure"
+        assert os.path.exists(os.path.join(_SHM_DIR, loc[0]))
+        assert store.stats()["num_spilled"] > 0  # pressure was real
+        store.unpin(pinned_oid)
+        # unpinned now: further pressure may spill it like any other entry
+        for i in range(10, 17):
+            store.put_bytes(_oid(i), b"y" * (2 << 20))
+        assert store._entries[pinned_oid].spilled_path is not None
+    finally:
+        store.shutdown()
+
+
+def test_delete_deferred_until_last_unpin(tmp_path):
+    store = SharedObjectStore(capacity=64 << 20, spill_dir=str(tmp_path))
+    try:
+        store.arena_threshold = 0
+        store._pool_cap = 0
+        oid = _oid(0)
+        store.put_bytes(oid, b"d" * (2 << 20))
+        name, size = store.pin(oid)
+        store.pin(oid)  # second reader
+        path = os.path.join(_SHM_DIR, name)
+        store.delete(oid)
+        # hidden from lookups, but the segment must survive the readers
+        assert store.lookup(oid) is None
+        assert not store.contains(oid)
+        assert os.path.exists(path)
+        store.unpin(oid)
+        assert os.path.exists(path), "first unpin must not reclaim"
+        store.unpin(oid)
+        assert not os.path.exists(path), "last unpin reclaims the segment"
+        assert store.stats()["num_objects"] == 0
+    finally:
+        store.shutdown()
+
+
+def test_recycled_segment_never_confirms_stale_pin(tmp_path):
+    """The recycling-safety invariant: once an object is deleted, a pin of
+    its id misses — so a reader holding a stale (name, size) can never have
+    a recycled inode confirmed under the old object's identity."""
+    store = SharedObjectStore(capacity=64 << 20, spill_dir=str(tmp_path))
+    try:
+        store.arena_threshold = 0
+        a = _oid(0)
+        store.put_bytes(a, b"a" * (1 << 20))
+        name_a, _ = store.lookup(a)
+        store.delete(a)  # unpinned: parks in the reuse pool
+        info = {}
+        b = _oid(1)
+        shm = store.create(b, 1 << 20, info=info)
+        shm.close()
+        assert info.get("recycled"), "pool should have served the create"
+        store.seal(b)
+        assert store.lookup(b)[0] == name_a  # same inode, new identity
+        assert store.pin(a) is None, \
+            "a deleted object's pin must miss even though its old segment " \
+            "name is live again under a new identity"
+    finally:
+        store.shutdown()
+
+
+# -------------------------------------------------------------- worker-level
+
+
+def test_get_returns_readonly_alias(ray_start_regular):
+    ray_tpu = ray_start_regular
+    from ray_tpu.core import api
+
+    w = api._global_worker()
+    a = np.arange(2 << 18, dtype=np.float64)  # 2 MiB: plasma, file segment
+    ref = ray_tpu.put(a)
+    out = ray_tpu.get(ref)
+    assert np.array_equal(out, a)
+    assert not out.flags.writeable
+    with pytest.raises((ValueError, TypeError)):
+        out[0] = 1.0
+    # aliasing proof: poke the shm segment through a writable attach and
+    # observe the change through the already-returned array — no heap copy
+    # can behave this way
+    name, size = w._seg_cache_get(ref.id)
+    from ray_tpu.core.object_store import attach_object
+
+    buf = attach_object(name, size)
+    try:
+        # the array's buffer is 64-byte aligned at the segment tail
+        view = np.frombuffer(buf.view, dtype=np.float64,
+                             offset=size - a.nbytes)
+        assert view[-1] == a[-1]
+        orig = a[-1]
+        view[-1] = -12345.0
+        assert out[-1] == -12345.0, "returned array must alias the segment"
+        view[-1] = orig
+    finally:
+        buf.close()
+
+
+def test_unpin_fires_via_finalizer(ray_start_regular):
+    ray_tpu = ray_start_regular
+    from ray_tpu.core import api
+
+    w = api._global_worker()
+    ref = ray_tpu.put(np.zeros(1 << 19))  # 4 MiB
+    out = ray_tpu.get(ref)
+    assert _store_stats(w)["pinned_refs"] >= 1
+    del out
+    _await(lambda: _store_stats(w)["pinned_refs"] == 0,
+           msg="finalizer-driven unpin")
+    # the object itself is still alive and fetchable (ref held)
+    assert ray_tpu.get(ref).nbytes == (1 << 19) * 8
+
+
+def test_owner_delete_defers_while_reader_views_alive(ray_start_regular):
+    ray_tpu = ray_start_regular
+    from ray_tpu.core import api
+
+    w = api._global_worker()
+    ref = ray_tpu.put(np.arange(1 << 19, dtype=np.float64))
+    out = ray_tpu.get(ref)
+    del ref  # owner frees -> obj_delete reaches the store
+    _await(lambda: _store_stats(w)["num_objects"] <= 1,
+           msg="owner-side delete")
+    gc.collect()
+    # the reader's views stay valid and correct after the delete
+    assert out[12345] == 12345.0
+    assert out[-1] == float((1 << 19) - 1)
+    del out
+    _await(lambda: _store_stats(w)["num_objects"] == 0
+           and _store_stats(w)["pinned_refs"] == 0,
+           msg="deferred reclaim after last view died")
+
+
+def test_dead_reader_worker_pins_reaped(ray_start_regular):
+    ray_tpu = ray_start_regular
+    from ray_tpu.core import api
+
+    w = api._global_worker()
+    ref = ray_tpu.put(np.zeros(1 << 19))
+
+    @ray_tpu.remote
+    def hold_and_report(x):
+        # the arg arrives as zero-copy views pinned by THIS worker; the
+        # global keeps them alive past the task so only worker death (and
+        # the raylet's conn-close reaping) can release the pin
+        global _held
+        _held = x
+        return os.getpid()
+
+    pid = ray_tpu.get(hold_and_report.remote(ref))
+    assert _store_stats(w)["pinned_refs"] >= 1
+    os.kill(pid, signal.SIGKILL)
+    _await(lambda: _store_stats(w)["pinned_refs"] == 0, timeout=20,
+           msg="raylet reaping a dead reader's pins")
+
+
+def test_zero_copy_disabled_copies(ray_start_regular):
+    ray_tpu = ray_start_regular
+    from ray_tpu.core import api
+    from ray_tpu.core.config import get_config
+
+    w = api._global_worker()
+    cfg = get_config()
+    old = cfg.object_zero_copy_enabled
+    cfg.object_zero_copy_enabled = False
+    try:
+        out = ray_tpu.get(ray_tpu.put(np.arange(1 << 19, dtype=np.float64)))
+        # the value owns heap memory: nothing stays pinned while it lives
+        assert out[42] == 42.0
+        assert _store_stats(w)["pinned_refs"] == 0
+    finally:
+        cfg.object_zero_copy_enabled = old
