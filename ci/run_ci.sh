@@ -12,6 +12,7 @@
 #   ci/run_ci.sh --failover # standby-head kill-and-promote storm only
 #   ci/run_ci.sh --node-chaos # multi-node kill storm only
 #   ci/run_ci.sh --partition  # partition-heal storm only
+#   ci/run_ci.sh --servebench # serving decode/prefill perf smoke only
 #
 # Stages:
 #   1. native      : arena + scheduler + token-loader compiled whole-program
@@ -56,13 +57,18 @@
 #                    minority cycle starves the lease and the standby
 #                    promotes. Fails on any hung call, duplicate named-
 #                    actor answer, or autoscaler double replacement.
+#  10. servebench  : serving perf smoke (quick profile): fused-decode
+#                    tokens/s + slot sweep + w8a16 parity + batched prefill
+#                    + p50/p99 under the storm load generator; fails on any
+#                    missing artifact row (regression FLOORS live in
+#                    tests/test_envelope.py, machine-calibrated).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGE="${1:-all}"
 
 run_native() {
-  echo "=== [1/9] native modules under ASan/UBSan ==="
+  echo "=== [1/10] native modules under ASan/UBSan ==="
   mkdir -p build
   g++ -std=c++17 -O1 -g -fsanitize=address,undefined \
       -fno-omit-frame-pointer -o build/sanitize_native \
@@ -74,7 +80,7 @@ run_native() {
 }
 
 run_fast() {
-  echo "=== [2/9] fast test tier ==="
+  echo "=== [2/10] fast test tier ==="
   python -m pytest tests/ -q
   # core-primitives smoke: the submission AND completion hot paths
   # (function table, event batching, batched result delivery, put/get)
@@ -90,7 +96,9 @@ rows = {r["benchmark"] for r in
 need = {"task_submit_p50", "task_e2e_p50", "task_completions_per_s",
         # zero-copy object plane (OBJPLANE_r14): the data-plane rows must
         # be present so the pin-protocol fast path can't silently drop out
-        "put_get_10mb_bytes", "np_roundtrip_100mb", "arg_1mb_fanout"}
+        "put_get_10mb_bytes", "np_roundtrip_100mb", "arg_1mb_fanout",
+        # raw-bytes out-of-band lane (PR 16): serve payloads/rollout blobs
+        "put_get_32mb_raw_bytes"}
 missing = need - rows
 assert not missing, f"microbenchmark smoke missing rows: {missing}"
 print("microbenchmark rows ok:", ", ".join(sorted(need)))
@@ -99,7 +107,7 @@ EOF
 }
 
 run_stress() {
-  echo "=== [3/9] actor ordering stress x20 ==="
+  echo "=== [3/10] actor ordering stress x20 ==="
   for i in $(seq 1 20); do
     python -m pytest tests/test_actor_ordering_stress.py -q -x \
       || { echo "ordering stress failed on iteration $i"; exit 1; }
@@ -107,7 +115,7 @@ run_stress() {
 }
 
 run_chaos() {
-  echo "=== [4/9] control-plane HA chaos suite ==="
+  echo "=== [4/10] control-plane HA chaos suite ==="
   # Deterministic fault injection: pin + print the seed so a red run
   # replays the same chaos schedule (override by exporting the variable;
   # timing-dependent counters can still drift between runs).
@@ -124,7 +132,7 @@ run_chaos() {
 }
 
 run_serve_storm() {
-  echo "=== [5/9] serve traffic-storm chaos ==="
+  echo "=== [5/10] serve traffic-storm chaos ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -140,7 +148,7 @@ run_serve_storm() {
 }
 
 run_burst() {
-  echo "=== [6/9] warm-pool elasticity burst ==="
+  echo "=== [6/10] warm-pool elasticity burst ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "burst seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -165,7 +173,7 @@ run_burst() {
 }
 
 run_head_failover() {
-  echo "=== [7/9] standby-head kill-and-promote storm ==="
+  echo "=== [7/10] standby-head kill-and-promote storm ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -184,7 +192,7 @@ run_head_failover() {
 }
 
 run_node_chaos() {
-  echo "=== [8/9] multi-node kill storm (node failure domain) ==="
+  echo "=== [8/10] multi-node kill storm (node failure domain) ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "node storm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -204,7 +212,7 @@ run_node_chaos() {
 }
 
 run_partition_storm() {
-  echo "=== [9/9] partition-heal storm (partition failure domain) ==="
+  echo "=== [9/10] partition-heal storm (partition failure domain) ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "partition storm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -225,6 +233,20 @@ run_partition_storm() {
          exit 1; }
 }
 
+run_servebench() {
+  echo "=== [10/10] serving perf smoke (servebench quick) ==="
+  # Quick profile of python -m ray_tpu.models.servebench: fused-decode
+  # tokens/s + the 1/4/8 slot sweep table, w8a16 logits-parity row,
+  # batched bucketed prefill, and p50/p99 request latency under the storm
+  # harness's load generator against a real LLMDeployment replica. The
+  # bench exits nonzero if any required artifact row is missing; the
+  # throughput regression FLOORS are pinned (machine-calibrated, 0.5x
+  # slack) in tests/test_envelope.py.
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python -m ray_tpu.models.servebench \
+    --json /tmp/ray_tpu_servebench_ci.json \
+    || { echo "servebench failed"; exit 1; }
+}
+
 case "$STAGE" in
   --native)     run_native ;;
   --fast)       run_fast ;;
@@ -235,11 +257,12 @@ case "$STAGE" in
   --failover)   run_head_failover ;;
   --node-chaos) run_node_chaos ;;
   --partition)  run_partition_storm ;;
+  --servebench) run_servebench ;;
   all)        run_native; run_fast; run_stress; run_chaos; run_serve_storm
               run_burst; run_head_failover; run_node_chaos
-              run_partition_storm ;;
+              run_partition_storm; run_servebench ;;
   *) echo "unknown stage: $STAGE" \
-     "(use --native|--fast|--stress|--chaos|--storm|--burst|--failover|--node-chaos|--partition)" >&2
+     "(use --native|--fast|--stress|--chaos|--storm|--burst|--failover|--node-chaos|--partition|--servebench)" >&2
      exit 2 ;;
 esac
 echo "CI green"

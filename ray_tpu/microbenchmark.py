@@ -210,6 +210,19 @@ def _object_plane_metrics(record, rate, batch: int, quick: bool) -> None:
     record("np_roundtrip_100mb", rate(np_roundtrip), unit="bytes/s")
     del huge
 
+    # 32 MB raw-bytes roundtrip: serve payloads / rollout blobs are plain
+    # `bytes`, not numpy — the serializer's out-of-band blob lane (PR 16)
+    # must put them on the same zero-copy plane (in-band pickle costs two
+    # extra full-memory passes per cycle: one into the pickle stream, one
+    # into the frame)
+    blob = b"\x00" * (32 * 1024 * 1024)
+    def bytes_roundtrip():
+        out = ray_tpu.get(ray_tpu.put(blob))
+        assert len(out) == len(blob)
+        return len(blob)
+    record("put_get_32mb_raw_bytes", rate(bytes_roundtrip), unit="bytes/s")
+    del blob
+
     # 1 MB arg fanned out to a batch of tasks through ONE shared ref: every
     # executor materializes the arg (and its 1 MB echo) through the
     # object plane — tasks/s, the RLAX rollout-traffic shape

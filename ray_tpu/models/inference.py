@@ -41,6 +41,33 @@ def _mlp(cfg: ModelConfig, p, h):
     return swiglu(h @ p["w_gate"], h @ p["w_up"]) @ p["w_down"]
 
 
+def _gqa_decode_attention(q, k_cache, v_cache, k_cur, v_cur, mask):
+    """Single-token grouped-query attention over a cache window plus the
+    current token's (not-yet-written) K/V row.
+
+    q [b,h,1,hd]; k_cache/v_cache [b,kvh,Lw,hd] (a prefix window of the
+    slot cache); k_cur/v_cur [b,kvh,hd]; mask [b,Lw] with True = attend
+    (STRICT: the current position is not in the cache — it contributes via
+    the separate k_cur/v_cur term). Unlike `_masked_attention` this never
+    materializes GQA-repeated K/V (those copies are cache-sized, per layer,
+    per step): queries are grouped [b,kvh,rep,hd] and contracted against
+    the shared K/V heads directly.
+    """
+    b, h, _, hd = q.shape
+    kvh = k_cache.shape[1]
+    qg = q[:, :, 0].reshape(b, kvh, h // kvh, hd)
+    scale = hd ** -0.5
+    lg = jnp.einsum("bgrd,bgld->bgrl", qg, k_cache).astype(jnp.float32) * scale
+    lg = jnp.where(mask[:, None, None, :], lg, -1e30)
+    self_lg = jnp.einsum("bgrd,bgd->bgr", qg, k_cur).astype(jnp.float32) * scale
+    lg = jnp.concatenate([lg, self_lg[..., None]], axis=-1)
+    probs = jax.nn.softmax(lg, axis=-1).astype(q.dtype)
+    win = k_cache.shape[2]
+    attn = jnp.einsum("bgrl,bgld->bgrd", probs[..., :win], v_cache) \
+        + probs[..., win:] * v_cur[:, :, None]
+    return attn.reshape(b, h, hd)
+
+
 def _masked_attention(q, k, v, mask):
     """q [b,h,sq,hd] over cached k/v [b,kvh,L,hd] with bool mask [sq,L]."""
     n_rep = q.shape[1] // k.shape[1]

@@ -8,29 +8,61 @@ between steps without recompiling, the continuous-batching idea of Orca /
 vLLM re-built TPU-first (static shapes for XLA, per-row positions instead
 of dynamic batch).
 
-Engine = pure-JAX step functions + a host-side slot manager. Serve wires it
-through `LLMDeployment` (serve replicas each host an engine; Serve's p2c
-router spreads requests across replicas).
+Engine = pure-JAX step functions + a host-side slot manager. The decode
+loop is built to run at device speed:
+
+  * `decode_step_fused` donates the K/V/length buffers (the cache update
+    is in-place — no per-step reallocation of [L, slots, kvh, max_len, hd])
+    and fuses greedy sampling on-device, so only a [slots] int32 token
+    array ever crosses to the host;
+  * attention reads a power-of-2 *bucket* of the cache (compiled once per
+    bucket) instead of all max_len rows, so short sequences pay for the
+    cache they use;
+  * `step()` runs one step of *lookahead*: it dispatches step N+1 before
+    syncing step N's tokens, so host bookkeeping (EOS/finish/admit, slot
+    accounting) overlaps device compute — at the cost of one junk slot-step
+    per retiring request (its slot computes garbage once before the host
+    notices the EOS);
+  * admission is batched: all same-bucket waiting requests prefill in ONE
+    `prefill_slots` call and their prefix KV is scattered straight into the
+    donated slot cache (`_write_slots`), first tokens sampled on device.
+
+Device waits happen OUTSIDE the bookkeeping lock: `submit()`, `progress()`
+and `result()` stay responsive while a step is in flight (`_step_lock`
+serializes steppers; `_lock` only guards host-side state).
+
+Serve wires it through `LLMDeployment` (serve replicas each host an
+engine; the replica lifecycle hooks `__serve_start__`/`__serve_stop__`
+start and stop a background driver thread so the engine steps itself and
+callers just wait on their request).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ray_tpu.models.inference import _masked_attention, _mlp, _project_qkv
+from ray_tpu.models.inference import (_gqa_decode_attention, _masked_attention,
+                                      _mlp, _project_qkv)
 from ray_tpu.models.transformer import (ModelConfig, _deq_tree,
                                         _embed_lookup, lm_head_weights)
 from ray_tpu.ops.layers import rms_norm, rotary_embedding
 
+logger = logging.getLogger(__name__)
 
 _QUANT_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+# attention never reads fewer cache rows than this — keeps the number of
+# compiled bucket variants small (64, 128, 256, ... max_len)
+_ATTN_BUCKET_MIN = 64
 
 
 def quantize_model_params(params: Dict, cfg: ModelConfig) -> Dict:
@@ -66,12 +98,45 @@ def prefill_kv(params: Dict, tokens: jax.Array, true_len: jax.Array,
 
     Prompts are padded to bucket lengths before this call so XLA compiles
     once per bucket, not once per prompt length; the causal mask makes
-    positions < true_len independent of the padding."""
+    positions < true_len independent of the padding. The engine's admission
+    path uses the batched `prefill_slots` instead; this stays as the
+    single-request entry point."""
     from ray_tpu.models.inference import prefill
 
     logits, cache = prefill(params, tokens, cfg, max_len,
                             logits_index=true_len[None] - 1)
     return logits[0], cache["k"][:, 0], cache["v"][:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_len"))
+def prefill_slots(params: Dict, tokens: jax.Array, true_len: jax.Array,
+                  cfg: ModelConfig, max_len: int):
+    """Batched prompt pass over one admission bucket [nb, s_bucket]: every
+    same-bucket waiting request prefills in a single compiled call. Returns
+    (first greedy tokens [nb] — sampled ON DEVICE, no logits cross to the
+    host — and the prefix caches k/v [L, nb, kvh, max_len, hd])."""
+    from ray_tpu.models.inference import prefill
+
+    logits, cache = prefill(params, tokens, cfg, max_len,
+                            logits_index=true_len - 1)
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return first, cache["k"], cache["v"]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _write_slots(k_all: jax.Array, v_all: jax.Array, lengths: jax.Array,
+                 tokens: jax.Array, slots: jax.Array, k_rows: jax.Array,
+                 v_rows: jax.Array, true_len: jax.Array, first: jax.Array):
+    """Admission scatter: write a prefill bucket's KV rows straight into
+    the DONATED slot cache (in-place update — the cache is never cloned to
+    admit). `slots` entries equal to num_slots are batch padding and are
+    dropped by the out-of-bounds scatter mode. `tokens` is deliberately NOT
+    donated: the in-flight decode step still reads the previous buffer."""
+    k_all = k_all.at[:, slots].set(k_rows, mode="drop")
+    v_all = v_all.at[:, slots].set(v_rows, mode="drop")
+    lengths = lengths.at[slots].set(true_len, mode="drop")
+    tokens = tokens.at[slots].set(first, mode="drop")
+    return k_all, v_all, lengths, tokens
 
 
 def _bucket_len(n: int, max_len: int) -> int:
@@ -81,10 +146,21 @@ def _bucket_len(n: int, max_len: int) -> int:
     return min(b, max_len - 1)
 
 
+def _attn_bucket(pos: int, max_len: int) -> int:
+    """Power-of-2 attention window >= the deepest active position (strict
+    mask: position pos attends cache rows [0, pos))."""
+    b = min(_ATTN_BUCKET_MIN, max_len)
+    while b < pos:
+        b *= 2
+    return min(b, max_len)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def decode_slots(params: Dict, k_all: jax.Array, v_all: jax.Array,
                  lengths: jax.Array, tokens: jax.Array, cfg: ModelConfig):
-    """One decode step over all slots with per-slot positions.
+    """One decode step over all slots with per-slot positions (legacy
+    entry: returns host-visible logits and NON-donated caches — the engine
+    uses `decode_step_fused`; this stays for callers that need logits).
 
     k_all/v_all: [L, B, kvh, max_len, hd]; lengths [B] (current position per
     slot); tokens [B] (last sampled token per slot). Returns (logits [B, V],
@@ -126,6 +202,80 @@ def decode_slots(params: Dict, k_all: jax.Array, v_all: jax.Array,
     return logits, k_new, v_new
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "attn_len"),
+                   donate_argnums=(1, 2, 3))
+def decode_step_fused(params: Dict, k_all: jax.Array, v_all: jax.Array,
+                      lengths: jax.Array, tokens: jax.Array,
+                      cfg: ModelConfig, attn_len: int):
+    """The hot decode step: one token for every slot, greedy sampling fused
+    on device, K/V/length buffers DONATED so the cache row-write is a true
+    in-place scatter (no [L, B, kvh, max_len, hd] reallocation per step).
+
+    Structure matters for the donation to be real: the caches enter the
+    layer scan as READ-ONLY xs — a scan that carries the cache through its
+    ys gets double-buffered by XLA even when the final output aliases the
+    input. Attention therefore splits into (cache window) + (current
+    token's own K/V, which is not written yet — STRICT mask `< lengths`),
+    and the per-layer K/V rows are written afterwards in one donated
+    scatter outside the scan.
+
+    `attn_len` is the static attention window (a power-of-2 bucket >= every
+    active position): XLA compiles one executable per bucket and short
+    sequences stop paying O(max_len) attention.
+
+    Returns (k_all, v_all, lengths+1, next_tokens [B] int32) — the caller
+    keeps everything on device; only `next_tokens` is ever synced, one
+    step late. `tokens` is NOT donated (the lookahead pipeline reads step
+    N's token buffer after step N+1 is dispatched).
+    """
+    B = tokens.shape[0]
+    hd = cfg.head_dim
+    rep = cfg.n_heads // cfg.n_kv_heads
+    cos, sin = rotary_embedding(lengths[:, None], hd, cfg.rope_theta)
+    x = _embed_lookup(params["embed"], tokens[:, None], cfg.dtype)  # [B,1,d]
+    mask = jnp.arange(attn_len)[None, :] < lengths[:, None]  # [B, attn_len]
+
+    def body(x, inputs):
+        lp, k_cache, v_cache = inputs  # read-only [B, kvh, max_len, hd]
+        lp = _deq_tree(lp, cfg.dtype)
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(cfg, lp, h, cos, sin)
+        q = q.transpose(0, 2, 1, 3)  # [B, h, 1, hd]
+        k_cur = k.transpose(0, 2, 1, 3)[:, :, 0].astype(cfg.dtype)  # [B,kvh,hd]
+        v_cur = v.transpose(0, 2, 1, 3)[:, :, 0].astype(cfg.dtype)
+        attn = _gqa_decode_attention(
+            q, k_cache[:, :, :attn_len], v_cache[:, :, :attn_len],
+            k_cur, v_cur, mask)
+        attn = attn.reshape(B, 1, cfg.n_heads * hd)
+        x = x + (attn @ lp["wo"]).astype(x.dtype)
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(cfg, lp, h2).astype(x.dtype)
+        return x, (k_cur, v_cur)
+
+    x, (k_cur, v_cur) = jax.lax.scan(body, x, (params["layers"], k_all, v_all))
+    # k_cur/v_cur [L, B, kvh, hd] -> one donated row-scatter per cache
+    def write_row(cache, new, pos):
+        # cache [max_len, hd] <- new [1, hd] at row pos
+        return jax.lax.dynamic_update_slice(cache, new, (pos, 0))
+
+    wr = jax.vmap(jax.vmap(jax.vmap(write_row, in_axes=(0, 0, None)),  # kvh
+                           in_axes=(0, 0, 0)),                         # B
+                  in_axes=(0, 0, None))                                # L
+    k_all = wr(k_all, k_cur[:, :, :, None], lengths)
+    v_all = wr(v_all, v_cur[:, :, :, None], lengths)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ lm_head_weights(params, cfg)).astype(jnp.float32)
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return k_all, v_all, lengths + 1, nxt
+
+
+def _pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
 @dataclasses.dataclass
 class _Request:
     request_id: int
@@ -137,7 +287,14 @@ class _Request:
 
 
 class ContinuousBatchingEngine:
-    """Host-side slot manager over the jitted prefill/decode kernels."""
+    """Host-side slot manager over the jitted prefill/decode kernels.
+
+    Locking: `_step_lock` serializes steppers (at most one step pipeline in
+    flight); `_lock` guards only host bookkeeping and is NEVER held across
+    a device wait — streaming `progress()` reads and `submit()` complete
+    while a step is blocked on the device. `_cv` (on `_lock`) wakes waiters
+    when tokens land and wakes the driver thread when work arrives.
+    """
 
     def __init__(self, params: Dict, cfg: ModelConfig, *, num_slots: int = 4,
                  max_len: int = 512, eos_token: Optional[int] = None,
@@ -159,7 +316,21 @@ class ContinuousBatchingEngine:
         self._waiting: List[_Request] = []
         self._finished: Dict[int, _Request] = {}
         self._next_id = 0
+        # host shadow of each slot's position: lets the dispatcher pick the
+        # attention bucket without ever syncing `lengths` off the device
+        self._slot_pos = [0] * num_slots
+        # in-flight decode: (device tokens [B], {slot: request} captured at
+        # dispatch time — attribution survives the slot being freed/reused)
+        self._pending: Optional[Tuple[jax.Array, Dict[int, _Request]]] = None
+        # admissions whose on-device first token hasn't been synced yet:
+        # [(device first-tokens [nb_pad], [(row, request), ...])]
+        self._pending_first: List[Tuple[jax.Array, List[Tuple[int, _Request]]]] = []
         self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._step_lock = threading.Lock()
+        self._driver: Optional[threading.Thread] = None
+        self._driver_stop = False
+        self._driver_error: Optional[BaseException] = None
 
     # ------------------------------------------------------------- requests
     def submit(self, prompt: List[int], *, max_new_tokens: int = 32) -> int:
@@ -173,26 +344,8 @@ class ContinuousBatchingEngine:
             req = _Request(self._next_id, list(prompt), max_new_tokens)
             self._next_id += 1
             self._waiting.append(req)
+            self._cv.notify_all()
             return req.request_id
-
-    def _admit(self) -> None:
-        while self._waiting and self._free:
-            req = self._waiting.pop(0)
-            slot = self._free.pop()
-            req.slot = slot
-            n = len(req.prompt)
-            padded = req.prompt + [0] * (_bucket_len(n, self.max_len) - n)
-            logits, k_rows, v_rows = prefill_kv(
-                self.params, jnp.asarray([padded], jnp.int32),
-                jnp.asarray(n, jnp.int32), self.cfg, self.max_len)
-            first = int(jnp.argmax(logits))
-            req.generated.append(first)
-            self.k = self.k.at[:, slot].set(k_rows)
-            self.v = self.v.at[:, slot].set(v_rows)
-            self.lengths = self.lengths.at[slot].set(len(req.prompt))
-            self.tokens = self.tokens.at[slot].set(first)
-            self._active[slot] = req
-            self._maybe_finish(req)
 
     def _maybe_finish(self, req: _Request) -> None:
         hit_eos = self.eos_token is not None and req.generated and \
@@ -203,67 +356,233 @@ class ContinuousBatchingEngine:
             if req.slot >= 0:
                 self._active.pop(req.slot, None)
                 self._free.append(req.slot)
+                self._slot_pos[req.slot] = 0
                 req.slot = -1
             self._finished[req.request_id] = req
 
     # ----------------------------------------------------------------- step
+    @staticmethod
+    def _to_host(arr: jax.Array) -> np.ndarray:
+        """THE host sync point (device wait). Routed through one method so
+        tests can instrument it; always called WITHOUT `_lock` held."""
+        return np.asarray(arr)
+
     def step(self) -> int:
-        """Admit waiting requests, run one decode step; returns number of
-        sequences still active."""
+        """Admit waiting requests (batched, bucketed), dispatch the next
+        decode step, then sync + bookkeep the PREVIOUS step's tokens while
+        the new one runs on device. Returns sequences still active."""
+        with self._step_lock:
+            return self._step_inner()
+
+    def _step_inner(self) -> int:
         with self._lock:
-            self._admit()
-            if not self._active:
-                return 0
-            logits, self.k, self.v = decode_slots(
-                self.params, self.k, self.v, self.lengths, self.tokens,
-                self.cfg)
-            nxt = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
-            self.lengths = self.lengths + 1  # every slot advanced (inactive: junk)
-            new_tokens = np.array(self.tokens)  # writable copy
-            for slot, req in list(self._active.items()):
-                tok = int(nxt[slot])
-                req.generated.append(tok)
-                new_tokens[slot] = tok
-                self._maybe_finish(req)
-            self.tokens = jnp.asarray(new_tokens)
+            admissions = self._collect_admissions()
+        for bucket, reqs in admissions:
+            self._dispatch_prefill(bucket, reqs)      # device enqueue only
+        with self._lock:
+            prev = self._pending
+            self._pending = self._dispatch_decode()   # device enqueue only
+        self._drain_pending_first()                   # device wait, no _lock
+        self._reap(prev)                              # device wait, no _lock
+        with self._lock:
             return len(self._active) + len(self._waiting)
+
+    def _collect_admissions(self):
+        """Pop waiting requests into free slots, grouped by prompt bucket
+        (one batched prefill per bucket). Caller holds `_lock`."""
+        by_bucket: Dict[int, List[_Request]] = {}
+        while self._waiting and self._free:
+            req = self._waiting.pop(0)
+            slot = self._free.pop()
+            req.slot = slot
+            self._active[slot] = req
+            self._slot_pos[slot] = len(req.prompt)
+            bucket = _bucket_len(len(req.prompt), self.max_len)
+            by_bucket.setdefault(bucket, []).append(req)
+        return sorted(by_bucket.items())
+
+    def _dispatch_prefill(self, bucket: int, reqs: List[_Request]) -> None:
+        """ONE `prefill_slots` call for every same-bucket admission; the
+        prefix KV goes straight into the donated slot cache. The batch is
+        padded to a power of 2 (padding rows scatter to an out-of-range
+        slot and are dropped) so XLA compiles per (nb, bucket), not per
+        admission count. First tokens stay on device until bookkeeping."""
+        rows = [r.prompt + [0] * (bucket - len(r.prompt)) for r in reqs]
+        lens = [len(r.prompt) for r in reqs]
+        slots = [r.slot for r in reqs]
+        for _ in range(_pow2(len(reqs)) - len(reqs)):
+            rows.append([0] * bucket)
+            lens.append(1)
+            slots.append(self.num_slots)  # out of range -> dropped
+        first, k_rows, v_rows = prefill_slots(
+            self.params, jnp.asarray(rows, jnp.int32),
+            jnp.asarray(lens, jnp.int32), self.cfg, self.max_len)
+        self.k, self.v, self.lengths, self.tokens = _write_slots(
+            self.k, self.v, self.lengths, self.tokens,
+            jnp.asarray(slots, jnp.int32), k_rows, v_rows,
+            jnp.asarray(lens, jnp.int32), first)
+        self._pending_first.append(
+            (first, [(i, r) for i, r in enumerate(reqs)]))
+
+    def _dispatch_decode(self):
+        """Dispatch one fused decode step (no device wait). Captures the
+        dispatch-time active set so tokens are attributed correctly even if
+        a slot retires and is re-admitted before the sync. Caller holds
+        `_lock`."""
+        if not self._active:
+            return None
+        attn_len = _attn_bucket(
+            max(self._slot_pos[s] for s in self._active), self.max_len)
+        slot_map = dict(self._active)
+        self.k, self.v, self.lengths, tokens_out = decode_step_fused(
+            self.params, self.k, self.v, self.lengths, self.tokens,
+            self.cfg, attn_len)
+        self.tokens = tokens_out
+        for s in slot_map:
+            self._slot_pos[s] += 1
+        return tokens_out, slot_map
+
+    def _drain_pending_first(self) -> None:
+        """Sync admissions' on-device first tokens (deferred from dispatch
+        so prefill overlaps the decode step queued behind it)."""
+        if not self._pending_first:
+            return
+        batches, self._pending_first = self._pending_first, []
+        for first_dev, entries in batches:
+            first = self._to_host(first_dev)  # device wait — no _lock held
+            with self._lock:
+                for row, req in entries:
+                    req.generated.append(int(first[row]))
+                    self._maybe_finish(req)
+                self._cv.notify_all()
+
+    def _reap(self, prev) -> None:
+        """Sync + bookkeep a previously dispatched step's tokens. Runs
+        while the NEXT step computes on device (one-step lookahead)."""
+        if prev is None:
+            return
+        tokens_dev, slot_map = prev
+        nxt = self._to_host(tokens_dev)  # device wait — no _lock held
+        with self._lock:
+            for slot, req in slot_map.items():
+                if req.done:
+                    continue  # finished at dispatch+1; this token is junk
+                req.generated.append(int(nxt[slot]))
+                self._maybe_finish(req)
+            self._cv.notify_all()
 
     def run_until_done(self, max_steps: int = 10_000) -> None:
         for _ in range(max_steps):
             if self.step() == 0 and not self._waiting:
                 return
 
+    # ------------------------------------------------------- driver thread
+    def start_driver(self) -> None:
+        """Background thread that steps the engine whenever there is work:
+        callers then just `submit()` and `wait()`/stream. Used by serve
+        replicas via the `__serve_start__` lifecycle hook."""
+        with self._lock:
+            if self._driver is not None:
+                return
+            self._driver_stop = False
+            self._driver_error = None
+            self._driver = threading.Thread(
+                target=self._drive, name="engine-driver", daemon=True)
+            self._driver.start()
+
+    def stop_driver(self, timeout: float = 5.0) -> None:
+        with self._lock:
+            t = self._driver
+            if t is None:
+                return
+            self._driver_stop = True
+            self._cv.notify_all()
+        t.join(timeout)
+        with self._lock:
+            self._driver = None
+
+    def _has_work(self) -> bool:
+        return bool(self._waiting or self._active or self._pending
+                    or self._pending_first)
+
+    def _drive(self) -> None:
+        while True:
+            with self._lock:
+                while not self._driver_stop and not self._has_work():
+                    self._cv.wait(0.1)
+                if self._driver_stop:
+                    return
+            try:
+                self.step()
+            except Exception as e:  # surface to waiters instead of hanging
+                logger.exception("engine driver thread died")
+                with self._lock:
+                    self._driver_error = e
+                    self._driver = None
+                    self._cv.notify_all()
+                return
+
     # -------------------------------------------------------------- results
+    def _result_locked(self, req: _Request) -> List[int]:
+        toks = req.prompt + req.generated
+        if self.eos_token is not None and toks and toks[-1] == self.eos_token:
+            toks = toks[:-1]
+        return toks
+
     def result(self, request_id: int) -> Optional[List[int]]:
         with self._lock:
             req = self._finished.get(request_id)
             if req is None:
                 return None
-            toks = req.prompt + req.generated
-            if self.eos_token is not None and toks and toks[-1] == self.eos_token:
-                toks = toks[:-1]
-            return toks
+            return self._result_locked(req)
+
+    def wait(self, request_id: int,
+             timeout: Optional[float] = None) -> List[int]:
+        """Block until `request_id` finishes (driver mode). Raises if the
+        driver died or the timeout expires."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while request_id not in self._finished:
+                if self._driver_error is not None:
+                    raise self._driver_error
+                if self._driver is None and not self._has_work():
+                    raise RuntimeError(
+                        "engine has no driver and no work in flight; "
+                        "call step() or start_driver()")
+                remaining = 0.1 if deadline is None else \
+                    min(0.1, deadline - time.monotonic())
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"request {request_id} not done within {timeout}s")
+                self._cv.wait(remaining)
+            return self._result_locked(self._finished[request_id])
+
+    def _progress_locked(self, request_id: int):
+        req = self._finished.get(request_id)
+        if req is not None:
+            toks = list(req.generated)
+            if (self.eos_token is not None and toks
+                    and toks[-1] == self.eos_token):
+                toks.pop()
+            return toks, True
+        for req in list(self._active.values()) + self._waiting:
+            if req.request_id == request_id:
+                return list(req.generated), req.done
+        return [], True  # unknown id
 
     def progress(self, request_id: int):
         """(tokens generated so far, done) — readable while decoding, for
         token streaming. Mirrors result(): a trailing EOS is stripped, so
-        streamed output always equals the non-streamed suffix."""
+        streamed output always equals the non-streamed suffix. Takes only
+        the bookkeeping lock: never blocks behind a device wait."""
         with self._lock:
-            req = self._finished.get(request_id)
-            if req is not None:
-                toks = list(req.generated)
-                if (self.eos_token is not None and toks
-                        and toks[-1] == self.eos_token):
-                    toks.pop()
-                return toks, True
-            for req in list(self._active.values()) + self._waiting:
-                if req.request_id == request_id:
-                    return list(req.generated), req.done
-        return [], True  # unknown id
+            return self._progress_locked(request_id)
 
-    def generate(self, prompt: List[int], *, max_new_tokens: int = 32
-                 ) -> List[int]:
+    def generate(self, prompt: List[int], *, max_new_tokens: int = 32,
+                 timeout: Optional[float] = None) -> List[int]:
         rid = self.submit(prompt, max_new_tokens=max_new_tokens)
+        if self._driver is not None:
+            return self.wait(rid, timeout=timeout)
         while self.result(rid) is None:
             if self.step() == 0 and self.result(rid) is None and \
                     not self._waiting:
@@ -277,6 +596,21 @@ class ContinuousBatchingEngine:
         Serve token streaming (reference vLLM-style streaming generate)."""
         rid = self.submit(prompt, max_new_tokens=max_new_tokens)
         emitted = 0
+        if self._driver is not None:
+            while True:
+                with self._lock:
+                    while True:
+                        toks, done = self._progress_locked(rid)
+                        if len(toks) > emitted or done:
+                            break
+                        if self._driver_error is not None:
+                            raise self._driver_error
+                        self._cv.wait(0.2)
+                while emitted < len(toks):  # yield OUTSIDE the lock
+                    yield int(toks[emitted])
+                    emitted += 1
+                if done:
+                    return
         while True:
             active = self.step()
             toks, done = self.progress(rid)
@@ -299,6 +633,11 @@ def LLMDeployment(params, cfg: ModelConfig, *, num_slots: int = 4,
         D = serve.deployment(LLMDeployment(params, cfg))
         handle = serve.run(D.bind())
         handle.remote({"prompt": [1, 2, 3], "max_new_tokens": 8})
+
+    Inside a replica the `__serve_start__` lifecycle hook starts the
+    engine's background driver thread, so concurrent requests all ride one
+    continuously-batched decode loop (each caller blocks only on its own
+    request); standalone (no hook) the engine self-steps in the caller.
     """
 
     class _LLM:
@@ -306,6 +645,12 @@ def LLMDeployment(params, cfg: ModelConfig, *, num_slots: int = 4,
             self.engine = ContinuousBatchingEngine(
                 params, cfg, num_slots=num_slots, max_len=max_len,
                 eos_token=eos_token, quantize_weights=quantize_weights)
+
+        def __serve_start__(self):
+            self.engine.start_driver()
+
+        def __serve_stop__(self):
+            self.engine.stop_driver()
 
         def __call__(self, payload):
             prompt = list(payload["prompt"])

@@ -244,9 +244,25 @@ class _ReplicaActor:
         # "current" and silently skipping their rollout (reference keeps
         # the version in DeploymentReplica state, deployment_state.py).
         self._def_version = def_version
+        # Replica lifecycle hook: deployments that run background machinery
+        # (e.g. LLMDeployment's engine driver thread) start it here, once
+        # the instance is fully constructed/reconfigured. A raising hook
+        # fails replica construction — the controller retries elsewhere.
+        start = getattr(self._callable, "__serve_start__", None)
+        if callable(start):
+            start()
 
     def def_version(self) -> int:
         return self._def_version
+
+    def prepare_stop(self) -> bool:
+        """Graceful-stop lifecycle hook (`__serve_stop__`), invoked
+        best-effort by the controller before a kill. Hard kills (crashes,
+        chaos) skip it — hooks must not be load-bearing for correctness."""
+        stop = getattr(self._callable, "__serve_stop__", None)
+        if callable(stop):
+            stop()
+        return True
 
     def reconfigure(self, user_config) -> bool:
         """Apply a new user_config in place (reference replica
@@ -667,6 +683,12 @@ class ServeController:
         self._replica_def_version.pop(_replica_key(r), None)
         self._version_queries.pop(_replica_key(r), None)
         self._evict_stats_client(r)
+        try:
+            # fire-and-forget graceful-stop hook; never waited on (a dead
+            # replica would stall the reconcile loop)
+            r.prepare_stop.remote()
+        except Exception:
+            pass
         try:
             ray_tpu.kill(r)
         except (OSError, RuntimeError, ValueError, KeyError):

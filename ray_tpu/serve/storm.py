@@ -95,6 +95,155 @@ class _Outcomes:
 from ray_tpu.util.stats import percentile as _percentile  # noqa: E402
 
 
+class LoadGenerator:
+    """Paced open-loop load generator against a serve handle (the storm's
+    submit/collect machinery, extracted so other benches can reuse it):
+    `threads` submitter threads offer `rps` total, a collector thread
+    classifies every resolution into typed outcome buckets with accepted-
+    request latencies, and `stop_and_drain()` blocks until every submitted
+    request resolves (result / typed shed / typed timeout) or the grace
+    expires — the remainder is `hung`, the contract violation.
+
+    The storm harness runs one of these with a kill loop + fault injector
+    underneath; servebench runs one clean for p50/p99 latency rows."""
+
+    def __init__(self, handle, *, rps: float, request_timeout_s: float,
+                 payload_fn=None, threads: int = 4,
+                 rng: Optional[random.Random] = None,
+                 resolve_grace_s: float = 10.0):
+        from ray_tpu.core.api import _global_worker
+
+        self.handle = handle
+        self.rps = rps
+        self.request_timeout_s = request_timeout_s
+        self.payload_fn = payload_fn or (lambda idx, i: (idx, i))
+        self.threads = threads
+        self.rng = rng or random.Random(0)
+        self.resolve_grace_s = resolve_grace_s
+        self.outcomes = _Outcomes()
+        self.elapsed_s = 0.0
+        self._worker = _global_worker()
+        self._lock = threading.Lock()
+        self._done_q: "queue.Queue" = queue.Queue()
+        self._outstanding = threading.Semaphore(0)  # one release/resolution
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._collector_t: Optional[threading.Thread] = None
+        self._t_start = 0.0
+
+    @staticmethod
+    def classify(err: Optional[BaseException]) -> str:
+        from ray_tpu.core.exceptions import (ActorDiedError,
+                                             BackPressureError,
+                                             GetTimeoutError,
+                                             RequestTimeoutError,
+                                             WorkerCrashedError)
+
+        if err is None:
+            return "accepted"
+        if isinstance(err, BackPressureError):
+            return "shed"
+        if isinstance(err, (RequestTimeoutError, GetTimeoutError)):
+            return "timeout"
+        if isinstance(err, (ActorDiedError, WorkerCrashedError,
+                            ConnectionError)):
+            return "replica_death"
+        return "other_error"
+
+    def _collector(self) -> None:
+        import ray_tpu
+
+        while True:
+            item = self._done_q.get()
+            if item is None:
+                return
+            ref, t0, t1 = item
+            err = None
+            try:
+                ray_tpu.get(ref, timeout=5)  # terminal: instant
+            except Exception as e:
+                err = e
+            kind = self.classify(err)
+            out = self.outcomes
+            with self._lock:
+                setattr(out, kind, getattr(out, kind) + 1)
+                if kind == "accepted":
+                    out.latencies_ms.append((t1 - t0) * 1e3)
+            self._outstanding.release()
+
+    def _submitter(self, idx: int) -> None:
+        from ray_tpu.core.exceptions import BackPressureError
+
+        out = self.outcomes
+        interval = self.threads / self.rps
+        next_t = time.perf_counter() + self.rng.random() * interval
+        i = 0
+        while not self._stop.is_set():
+            now = time.perf_counter()
+            if now < next_t:
+                time.sleep(min(interval, next_t - now))
+                continue
+            next_t += interval
+            i += 1
+            with self._lock:
+                out.submitted += 1
+            t0 = time.perf_counter()
+            try:
+                ref = self.handle.remote(self.payload_fn(idx, i),
+                                         _timeout_s=self.request_timeout_s)
+            except BackPressureError:
+                with self._lock:
+                    out.shed += 1
+                self._outstanding.release()
+                continue
+            except Exception:
+                with self._lock:
+                    out.other_error += 1
+                self._outstanding.release()
+                continue
+            self._worker.add_done_callback(
+                ref, lambda r=ref, t=t0: self._done_q.put(
+                    (r, t, time.perf_counter())))
+
+    def start(self) -> "LoadGenerator":
+        self._collector_t = threading.Thread(target=self._collector,
+                                             daemon=True)
+        self._collector_t.start()
+        self._threads = [
+            threading.Thread(target=self._submitter, args=(k,), daemon=True)
+            for k in range(self.threads)]
+        self._t_start = time.perf_counter()
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop_and_drain(self) -> _Outcomes:
+        """Stop offering load, then wait until every submitted request
+        resolves (deadline + grace); stragglers count as `hung`."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10)
+        self.elapsed_s = time.perf_counter() - self._t_start
+        deadline = time.monotonic() + self.request_timeout_s \
+            + self.resolve_grace_s
+        with self._lock:
+            submitted = self.outcomes.submitted
+        resolved = 0
+        while resolved < submitted and time.monotonic() < deadline:
+            if self._outstanding.acquire(timeout=0.25):
+                resolved += 1
+        self._done_q.put(None)
+        self._collector_t.join(timeout=10)
+        with self._lock:
+            self.outcomes.hung = submitted - resolved
+        return self.outcomes
+
+    def run(self, duration_s: float) -> _Outcomes:
+        self.start()
+        time.sleep(duration_s)
+        return self.stop_and_drain()
+
+
 def run_storm(profile: Optional[StormProfile] = None,
               out_path: Optional[str] = DEFAULT_ARTIFACT) -> Dict[str, Any]:
     """Run one storm against a fresh deployment on the CURRENT cluster
@@ -128,10 +277,6 @@ def _run_storm_inner(p: StormProfile, rng: random.Random, injector,
                      out_path: Optional[str]) -> Dict[str, Any]:
     import ray_tpu
     from ray_tpu import serve
-    from ray_tpu.core.exceptions import (ActorDiedError, BackPressureError,
-                                         GetTimeoutError,
-                                         RequestTimeoutError,
-                                         WorkerCrashedError)
 
     service_time_s = p.service_time_s
 
@@ -155,77 +300,13 @@ def _run_storm_inner(p: StormProfile, rng: random.Random, injector,
                 timeout=60)
     serve.reset_router_stats()
 
-    out = _Outcomes()
-    out_lock = threading.Lock()
-    done_q: "queue.Queue" = queue.Queue()
-    outstanding = threading.Semaphore(0)  # released once per resolution
     stop = threading.Event()
     kills = 0
 
-    from ray_tpu.core.api import _global_worker
-
-    w = _global_worker()
-
-    def classify(err: Optional[BaseException]) -> str:
-        if err is None:
-            return "accepted"
-        if isinstance(err, BackPressureError):
-            return "shed"
-        if isinstance(err, (RequestTimeoutError, GetTimeoutError)):
-            return "timeout"
-        if isinstance(err, (ActorDiedError, WorkerCrashedError,
-                            ConnectionError)):
-            return "replica_death"
-        return "other_error"
-
-    def collector() -> None:
-        while True:
-            item = done_q.get()
-            if item is None:
-                return
-            ref, t0, t1 = item
-            err = None
-            try:
-                ray_tpu.get(ref, timeout=5)  # terminal: instant
-            except Exception as e:
-                err = e
-            kind = classify(err)
-            with out_lock:
-                setattr(out, kind, getattr(out, kind) + 1)
-                if kind == "accepted":
-                    out.latencies_ms.append((t1 - t0) * 1e3)
-            outstanding.release()
-
-    def submitter(idx: int) -> None:
-        interval = p.submitter_threads / p.offered_rps
-        next_t = time.perf_counter() + rng.random() * interval
-        i = 0
-        while not stop.is_set():
-            now = time.perf_counter()
-            if now < next_t:
-                time.sleep(min(interval, next_t - now))
-                continue
-            next_t += interval
-            i += 1
-            with out_lock:
-                out.submitted += 1
-            t0 = time.perf_counter()
-            try:
-                ref = handle.remote((idx, i),
-                                    _timeout_s=p.request_timeout_s)
-            except BackPressureError:
-                with out_lock:
-                    out.shed += 1
-                outstanding.release()
-                continue
-            except Exception:
-                with out_lock:
-                    out.other_error += 1
-                outstanding.release()
-                continue
-            w.add_done_callback(
-                ref, lambda r=ref, t=t0: done_q.put(
-                    (r, t, time.perf_counter())))
+    gen = LoadGenerator(handle, rps=p.offered_rps,
+                        request_timeout_s=p.request_timeout_s,
+                        threads=p.submitter_threads, rng=rng,
+                        resolve_grace_s=p.resolve_grace_s)
 
     def killer() -> None:
         # victims come from the HANDLE's push-refreshed replica set (local,
@@ -245,35 +326,16 @@ def _run_storm_inner(p: StormProfile, rng: random.Random, injector,
             except Exception:
                 logger.warning("storm kill pass failed", exc_info=True)
 
-    collect_t = threading.Thread(target=collector, daemon=True)
-    collect_t.start()
     kill_t = threading.Thread(target=killer, daemon=True)
     kill_t.start()
-    subs = [threading.Thread(target=submitter, args=(k,), daemon=True)
-            for k in range(p.submitter_threads)]
-    t_start = time.perf_counter()
-    for t in subs:
-        t.start()
+    gen.start()
     time.sleep(p.duration_s)
     stop.set()
-    for t in subs:
-        t.join(timeout=10)
-    kill_t.join(timeout=p.kill_period_s + 10)
-    elapsed = time.perf_counter() - t_start
-
     # Every submitted request must RESOLVE (result / typed timeout / typed
     # shed) within deadline + grace; anything left is a hung request.
-    resolve_deadline = time.monotonic() + p.request_timeout_s + p.resolve_grace_s
-    with out_lock:
-        submitted = out.submitted
-    resolved = 0
-    while resolved < submitted and time.monotonic() < resolve_deadline:
-        if outstanding.acquire(timeout=0.25):
-            resolved += 1
-    done_q.put(None)
-    collect_t.join(timeout=10)
-    with out_lock:
-        out.hung = submitted - resolved
+    out = gen.stop_and_drain()
+    kill_t.join(timeout=p.kill_period_s + 10)
+    elapsed = gen.elapsed_s
 
     stats = serve.router_stats()
     lat = sorted(out.latencies_ms)

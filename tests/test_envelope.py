@@ -48,6 +48,37 @@ _R14_MEMBW_RATIO = {
     "np_roundtrip_100mb": 0.45,
 }
 
+# PR 16 raw-bytes out-of-band lane: a 32 MB `bytes` roundtrip must stay on
+# the zero-copy buffer plane. The floor is denominated ONLY in this
+# machine's memcpy bandwidth (no committed-artifact term: the committing
+# box measured oob at 0.138x membw vs 0.083x for the in-band pickle path —
+# too close to discriminate under CI noise, so 0.05x is a collapse-class
+# floor that catches the lane disappearing entirely, e.g. blobs copied
+# through the pickle stream twice plus framing).
+_R16_MEMBW_RATIO = {
+    "put_get_32mb_raw_bytes": 0.05,
+}
+
+# Committed SERVEBENCH_r16.json values (serve decode fast lanes: donated
+# KV caches, fused on-device sampling, lookahead pipelining, batched
+# bucketed prefill). Measured on the quick profile (d_model=256 / 4-layer
+# f32 model, max_len=512), which is what _servebench_quick_rows() re-runs,
+# so the 0.5x-slack artifact term compares like with like.
+_R16 = {
+    "decode_tokens_per_s": 2301.1,   # 8-slot flagship row
+    "prefill_tokens_per_s": 3015.8,  # 4 x 64-token batched admission
+}
+# Machine-calibration terms (the effective floor takes the min, r14
+# discipline). Decode: the engine's fused step rides ONE jitted call, so
+# its steps/s tracks the raw-kernel steps/s measured on the same box —
+# the pre-PR loop (host argmax + 3 blocking syncs per step) ran at ~0.16x
+# raw, the donated+pipelined loop at 0.9-1.1x, so 0.35x discriminates the
+# collapse without flaking. Prefill: batched admission must not cost more
+# per token than prefilling one prompt at a time (that IS the batching
+# claim); 0.6x leaves room for scheduler noise.
+_R16_DECODE_VS_RAW_KERNEL = 0.35
+_R16_PREFILL_VS_SINGLE = 0.6
+
 
 def _memcpy_bytes_per_s() -> float:
     """This machine's large-copy bandwidth (the unit the byte-rate floors
@@ -117,6 +148,14 @@ def test_envelope_smoke(tmp_path):
             f"zero-copy pin path has collapsed back to copy-per-get "
             f"behavior")
 
+    # --- raw-bytes oob lane floor (PR 16, machine-denominated only) ---
+    for row, ratio in _R16_MEMBW_RATIO.items():
+        floor = ratio * membw
+        assert rates[row] >= floor, (
+            f"{row} {rates[row]} fell below {ratio}x this machine's "
+            f"{membw:.3g} B/s memcpy: the out-of-band bytes lane has "
+            f"collapsed back to in-band pickling")
+
     # the burst must ride the warm pool on fork-capable platforms: a
     # silent fall-through to all-cold spawns is a regression even when
     # it happens to fit the time budget. Leases served by ALREADY-IDLE
@@ -134,3 +173,82 @@ def test_envelope_smoke(tmp_path):
         assert frac >= 0.5, (
             f"warm_start_fraction {frac}: most actor leases were served "
             f"by cold spawns despite a fork-capable platform")
+
+
+def _servebench_quick_rows():
+    """Re-measure the two servebench floor rows at the quick profile
+    (trimmed iteration counts — compile dominates the wall time anyway)."""
+    from ray_tpu.models.servebench import (_bench_model, measure_decode,
+                                           measure_prefill)
+
+    params, cfg, max_len = _bench_model(True)
+    decode = measure_decode(params, cfg, num_slots=8, max_len=max_len,
+                            steps=20, warm_steps=8)
+    prefill = measure_prefill(params, cfg, max_len=max_len, iters=4)
+    return params, cfg, max_len, decode, prefill
+
+
+def test_servebench_regression_floors():
+    """SERVEBENCH_r16.json regression floors (PR 16). Each floor is
+    min(0.5x the committed artifact, ratio x a same-box raw-kernel probe)
+    so a slower CI machine is judged against its own silicon, while the
+    fast-lane structure (donated in-place cache, fused sampling, one
+    dispatch per step, batched admission) can't silently collapse."""
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models.serving import decode_step_fused, prefill_kv
+
+    params, cfg, max_len, decode, prefill = _servebench_quick_rows()
+
+    # raw fused-kernel probe: the same jitted step the engine dispatches,
+    # driven with zero host bookkeeping — this machine's device-speed
+    # ceiling for an 8-slot decode step
+    L, kvh, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    k = jnp.zeros((L, 8, kvh, max_len, hd), cfg.dtype)
+    v = jnp.zeros((L, 8, kvh, max_len, hd), cfg.dtype)
+    lengths = jnp.full((8,), 7, jnp.int32)
+    tokens = jnp.arange(1, 9, dtype=jnp.int32)
+    for _ in range(3):  # compile + settle
+        k, v, lengths, tokens = decode_step_fused(
+            params, k, v, lengths, tokens, cfg=cfg, attn_len=64)
+    np.asarray(tokens)
+    t0 = time.perf_counter()
+    raw_steps = 20
+    for _ in range(raw_steps):
+        k, v, lengths, tokens = decode_step_fused(
+            params, k, v, lengths, tokens, cfg=cfg, attn_len=64)
+    np.asarray(tokens)
+    raw_tok_per_s = raw_steps * 8 / (time.perf_counter() - t0)
+
+    floor = min(_SLACK * _R16["decode_tokens_per_s"],
+                _R16_DECODE_VS_RAW_KERNEL * raw_tok_per_s)
+    assert decode["decode_tokens_per_s"] >= floor, (
+        f"decode_tokens_per_s {decode['decode_tokens_per_s']} fell below "
+        f"the r16 floor {floor:.1f} (min of {_SLACK}x artifact "
+        f"{_R16['decode_tokens_per_s']} and {_R16_DECODE_VS_RAW_KERNEL}x "
+        f"this box's raw fused-kernel rate {raw_tok_per_s:.1f} tok/s): the "
+        f"decode loop is paying host-sync/reallocation costs per step again")
+
+    # single-prompt prefill probe: batched admission must not cost more
+    # per token than one-at-a-time prefill on the same box
+    one = jnp.arange(1, 65, dtype=jnp.int32)[None]
+    tl = jnp.asarray(64, jnp.int32)  # prefill_kv takes a scalar true_len
+    logits, _, _ = prefill_kv(params, one, tl, cfg, max_len)
+    np.asarray(logits)  # compile + settle
+    t0 = time.perf_counter()
+    for _ in range(4):
+        logits, _, _ = prefill_kv(params, one, tl, cfg, max_len)
+    np.asarray(logits)
+    single_tok_per_s = 4 * 64 / (time.perf_counter() - t0)
+
+    floor = min(_SLACK * _R16["prefill_tokens_per_s"],
+                _R16_PREFILL_VS_SINGLE * single_tok_per_s)
+    assert prefill["prefill_tokens_per_s"] >= floor, (
+        f"prefill_tokens_per_s {prefill['prefill_tokens_per_s']} fell "
+        f"below the r16 floor {floor:.1f} (min of {_SLACK}x artifact "
+        f"{_R16['prefill_tokens_per_s']} and {_R16_PREFILL_VS_SINGLE}x "
+        f"this box's single-prompt rate {single_tok_per_s:.1f} tok/s): "
+        f"batched bucketed admission has collapsed")

@@ -117,3 +117,136 @@ def test_int8_quantized_engine_quality_and_memory():
     out = eng.generate([5, 17, 400, 3], max_new_tokens=8)
     assert len(out) == 4 + 8  # prompt + generated
     assert all(0 <= t < CFG.vocab_size for t in out)
+
+
+def test_decode_step_donation_clean():
+    """PR 16 acceptance: the fused decode step donates the K/V/length
+    buffers, so steady-state stepping must not reallocate the caches —
+    buffer identity stays within the initial donated set and the number of
+    live cache-shaped device arrays is stable. Tokens and lengths must stay
+    on device between steps (no implicit host sync in the step path)."""
+    eng = ContinuousBatchingEngine(PARAMS, CFG, num_slots=2, max_len=MAX_LEN)
+    eng.submit([5, 17, 400, 3], max_new_tokens=60)
+    eng.step()  # prefill dispatch
+    eng.step()  # first fused decode: compile + donation warm-up
+    cache_shape = eng.k.shape
+    # XLA may alias a donated output onto ANY dead donated input of matching
+    # shape/dtype, so k/v pointers can swap — the SET must be closed.
+    ptrs = {eng.k.unsafe_buffer_pointer(), eng.v.unsafe_buffer_pointer()}
+    n_live = sum(1 for a in jax.live_arrays() if a.shape == cache_shape)
+    for _ in range(10):
+        eng.step()
+        assert eng.k.unsafe_buffer_pointer() in ptrs
+        assert eng.v.unsafe_buffer_pointer() in ptrs
+        assert isinstance(eng.tokens, jax.Array)
+        assert isinstance(eng.lengths, jax.Array)
+        now_live = sum(1 for a in jax.live_arrays() if a.shape == cache_shape)
+        assert now_live <= n_live  # no per-step full-cache reallocation
+
+
+def test_progress_and_submit_not_blocked_during_step():
+    """Satellite: the engine must hold only `_step_lock` across device
+    waits, so streaming `progress()` reads and new `submit()`s complete
+    while a step is blocked on the device."""
+    import threading
+    import time
+
+    eng = ContinuousBatchingEngine(PARAMS, CFG, num_slots=2, max_len=MAX_LEN)
+    rid = eng.submit([1, 2, 3], max_new_tokens=30)
+    eng.step()  # prefill
+    eng.step()  # warm decode (drains pending-first so _reap is the sync)
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    def slow_to_host(arr):
+        entered.set()
+        release.wait(5.0)
+        return np.asarray(arr)
+
+    eng._to_host = slow_to_host  # instance attr shadows the staticmethod
+    stepper = threading.Thread(target=eng.step)
+    stepper.start()
+    try:
+        assert entered.wait(5.0), "step never reached the host sync"
+        t0 = time.perf_counter()
+        toks, done = eng.progress(rid)
+        rid2 = eng.submit([4, 5], max_new_tokens=4)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 0.5, f"bookkeeping blocked {elapsed:.2f}s behind a step"
+        assert not done
+    finally:
+        release.set()
+        stepper.join(10.0)
+        del eng._to_host  # restore the real sync
+    eng.run_until_done()
+    assert eng.result(rid) == _reference([1, 2, 3], 30)
+    assert eng.result(rid2) == _reference([4, 5], 4)
+
+
+def test_quantize_int8_roundtrip_parity():
+    """w8a16 numerics: per-channel absmax int8 round-trip error is bounded
+    by half a quantization step per row."""
+    from ray_tpu.ops.pallas.quant import dequantize_int8, quantize_int8
+
+    w = jax.random.normal(jax.random.PRNGKey(1), (64, 128), jnp.float32)
+    vals, scales = quantize_int8(w)
+    assert vals.dtype == jnp.int8
+    assert scales.shape == (64, 1)  # per-channel (per-row) scales
+    back = dequantize_int8(vals, scales, jnp.float32)
+    err = np.abs(np.asarray(back) - np.asarray(w))
+    bound = np.asarray(scales) * 0.5 + 1e-6
+    assert (err <= bound).all(), float((err - bound).max())
+
+
+def test_quantized_engine_matches_quantized_reference():
+    """quantize_weights=True must be EXACTLY the quantized model run through
+    the reference generate loop — the fast decode path adds no numerics of
+    its own on top of the quantization."""
+    from ray_tpu.models.serving import quantize_model_params
+
+    qparams = quantize_model_params(PARAMS, CFG)
+    prompt = [5, 17, 400, 3]
+    ref = generate(qparams, jnp.asarray([prompt], jnp.int32), CFG,
+                   max_new_tokens=8, max_len=MAX_LEN, temperature=0.0)
+    ref = np.asarray(ref)[0].tolist()
+    eng = ContinuousBatchingEngine(PARAMS, CFG, num_slots=2, max_len=MAX_LEN,
+                                   quantize_weights=True)
+    assert eng.generate(prompt, max_new_tokens=8) == ref
+
+
+def test_batched_bucketed_admission_parity():
+    """All same-bucket waiting requests are admitted in ONE prefill call per
+    bucket; a single step() drains the whole waiting queue into free slots
+    without perturbing outputs."""
+    eng = ContinuousBatchingEngine(PARAMS, CFG, num_slots=4, max_len=MAX_LEN)
+    prompts = [[1, 2, 3], [4, 5], list(range(40, 51)), [9]]  # mixed buckets
+    rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.step()
+    with eng._lock:
+        assert len(eng._active) == 4  # one step admitted everything
+        assert not eng._waiting
+    eng.run_until_done()
+    for rid, p in zip(rids, prompts):
+        assert eng.result(rid) == _reference(p, 6)
+
+
+def test_driver_mode_concurrent_generates():
+    """Driver-thread mode: concurrent blocking generates and a streaming
+    read all complete against the background stepper, with full parity."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    eng = ContinuousBatchingEngine(PARAMS, CFG, num_slots=4, max_len=MAX_LEN)
+    eng.start_driver()
+    try:
+        prompts = [[1, 2, 3], [100, 200, 300, 400, 17], [7], [9, 8]]
+        with ThreadPoolExecutor(max_workers=4) as ex:
+            futs = [ex.submit(eng.generate, p, max_new_tokens=6, timeout=120)
+                    for p in prompts]
+            outs = [f.result(timeout=120) for f in futs]
+        for p, out in zip(prompts, outs):
+            assert out == _reference(p, 6)
+        streamed = list(eng.generate_stream([5, 6], max_new_tokens=5))
+        assert [5, 6] + streamed == _reference([5, 6], 5)
+    finally:
+        eng.stop_driver()
