@@ -13,6 +13,7 @@
 #   ci/run_ci.sh --node-chaos # multi-node kill storm only
 #   ci/run_ci.sh --partition  # partition-heal storm only
 #   ci/run_ci.sh --servebench # serving decode/prefill perf smoke only
+#   ci/run_ci.sh --trainstorm # RL fleet chaos (rollout->learner loop) only
 #
 # Stages:
 #   1. native      : arena + scheduler + token-loader compiled whole-program
@@ -62,13 +63,22 @@
 #                    + p50/p99 under the storm load generator; fails on any
 #                    missing artifact row (regression FLOORS live in
 #                    tests/test_envelope.py, machine-calibrated).
+#  11. trainstorm  : RL fleet chaos (quick profile): serve-deployed rollout
+#                    replicas -> checkpointed learner actor, weight-epoch-
+#                    fenced broadcasts, under composed chaos (seeded replica
+#                    kills + learner crash-restart + learner|replicas
+#                    partition-heal). Prints samples/s, learner steps/s and
+#                    the recovery-to-first-post-restart-step time; fails on
+#                    any hung future, a chaos mode that never landed, a
+#                    blown recovery budget, or a missing artifact row
+#                    (throughput FLOORS live in tests/test_envelope.py).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGE="${1:-all}"
 
 run_native() {
-  echo "=== [1/10] native modules under ASan/UBSan ==="
+  echo "=== [1/11] native modules under ASan/UBSan ==="
   mkdir -p build
   g++ -std=c++17 -O1 -g -fsanitize=address,undefined \
       -fno-omit-frame-pointer -o build/sanitize_native \
@@ -80,7 +90,7 @@ run_native() {
 }
 
 run_fast() {
-  echo "=== [2/10] fast test tier ==="
+  echo "=== [2/11] fast test tier ==="
   python -m pytest tests/ -q
   # core-primitives smoke: the submission AND completion hot paths
   # (function table, event batching, batched result delivery, put/get)
@@ -107,7 +117,7 @@ EOF
 }
 
 run_stress() {
-  echo "=== [3/10] actor ordering stress x20 ==="
+  echo "=== [3/11] actor ordering stress x20 ==="
   for i in $(seq 1 20); do
     python -m pytest tests/test_actor_ordering_stress.py -q -x \
       || { echo "ordering stress failed on iteration $i"; exit 1; }
@@ -115,7 +125,7 @@ run_stress() {
 }
 
 run_chaos() {
-  echo "=== [4/10] control-plane HA chaos suite ==="
+  echo "=== [4/11] control-plane HA chaos suite ==="
   # Deterministic fault injection: pin + print the seed so a red run
   # replays the same chaos schedule (override by exporting the variable;
   # timing-dependent counters can still drift between runs).
@@ -132,7 +142,7 @@ run_chaos() {
 }
 
 run_serve_storm() {
-  echo "=== [5/10] serve traffic-storm chaos ==="
+  echo "=== [5/11] serve traffic-storm chaos ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -148,7 +158,7 @@ run_serve_storm() {
 }
 
 run_burst() {
-  echo "=== [6/10] warm-pool elasticity burst ==="
+  echo "=== [6/11] warm-pool elasticity burst ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "burst seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -173,7 +183,7 @@ run_burst() {
 }
 
 run_head_failover() {
-  echo "=== [7/10] standby-head kill-and-promote storm ==="
+  echo "=== [7/11] standby-head kill-and-promote storm ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -192,7 +202,7 @@ run_head_failover() {
 }
 
 run_node_chaos() {
-  echo "=== [8/10] multi-node kill storm (node failure domain) ==="
+  echo "=== [8/11] multi-node kill storm (node failure domain) ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "node storm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -212,7 +222,7 @@ run_node_chaos() {
 }
 
 run_partition_storm() {
-  echo "=== [9/10] partition-heal storm (partition failure domain) ==="
+  echo "=== [9/11] partition-heal storm (partition failure domain) ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "partition storm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -234,7 +244,7 @@ run_partition_storm() {
 }
 
 run_servebench() {
-  echo "=== [10/10] serving perf smoke (servebench quick) ==="
+  echo "=== [10/11] serving perf smoke (servebench quick) ==="
   # Quick profile of python -m ray_tpu.models.servebench: fused-decode
   # tokens/s + the 1/4/8 slot sweep table, w8a16 logits-parity row,
   # batched bucketed prefill, and p50/p99 request latency under the storm
@@ -245,6 +255,37 @@ run_servebench() {
   timeout -k 10 600 env JAX_PLATFORMS=cpu python -m ray_tpu.models.servebench \
     --json /tmp/ray_tpu_servebench_ci.json \
     || { echo "servebench failed"; exit 1; }
+}
+
+run_trainstorm() {
+  echo "=== [11/11] RL fleet chaos (trainstorm quick) ==="
+  : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
+  export RAY_TPU_FAULT_INJECTION_SEED
+  echo "trainstorm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
+  # --quick: ~12 s rollout->learner loop (serve replicas -> named learner
+  # actor over the zero-copy object plane) with seeded replica kills, one
+  # learner crash-restart (resume from the latest COMPLETE checkpoint,
+  # exactly-once by rollout id) and one learner|replicas partition-heal.
+  # Exits nonzero if any future hangs, any chaos mode fails to land, or
+  # recovery blows its budget.
+  ts_json="$(mktemp /tmp/ray_tpu_trainstorm_ci.XXXXXX.json)"
+  timeout -k 10 450 env JAX_PLATFORMS=cpu python -m ray_tpu.rllib.trainstorm \
+    --quick --seed "${RAY_TPU_FAULT_INJECTION_SEED}" --json "$ts_json" \
+    || { echo "trainstorm failed (seed ${RAY_TPU_FAULT_INJECTION_SEED})"
+         exit 1; }
+  TS_JSON="$ts_json" python - <<'EOF'
+import json, os
+art = json.load(open(os.environ["TS_JSON"]))
+need = {"samples_per_s", "learner_steps_per_s", "staleness_hist",
+        "recovery_to_first_post_restart_step_s", "replica_kills",
+        "learner_kills", "learner_restarts", "partition", "fenced_updates",
+        "applied_batches", "duplicate_batches", "stale_batches", "zero_hung"}
+missing = need - set(art)
+assert not missing, f"trainstorm artifact missing rows: {missing}"
+assert art["zero_hung"], "trainstorm left hung futures"
+print("trainstorm artifact rows ok:", ", ".join(sorted(need)))
+EOF
+  rm -f "$ts_json"
 }
 
 case "$STAGE" in
@@ -258,11 +299,12 @@ case "$STAGE" in
   --node-chaos) run_node_chaos ;;
   --partition)  run_partition_storm ;;
   --servebench) run_servebench ;;
+  --trainstorm) run_trainstorm ;;
   all)        run_native; run_fast; run_stress; run_chaos; run_serve_storm
               run_burst; run_head_failover; run_node_chaos
-              run_partition_storm; run_servebench ;;
+              run_partition_storm; run_servebench; run_trainstorm ;;
   *) echo "unknown stage: $STAGE" \
-     "(use --native|--fast|--stress|--chaos|--storm|--burst|--failover|--node-chaos|--partition|--servebench)" >&2
+     "(use --native|--fast|--stress|--chaos|--storm|--burst|--failover|--node-chaos|--partition|--servebench|--trainstorm)" >&2
      exit 2 ;;
 esac
 echo "CI green"

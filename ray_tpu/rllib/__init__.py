@@ -42,3 +42,5 @@ from ray_tpu.rllib.slateq import (
     InterestEvolutionEnv, SlateQ, SlateQConfig)
 from ray_tpu.rllib.maml import MAML, MAMLConfig, SinusoidTasks
 from ray_tpu.rllib.dreamer import Dreamer, DreamerConfig, PointGoalEnv
+from ray_tpu.rllib.fleet import (FleetConfig, FleetDriver, FleetLearner,
+                                 FleetLearnerImpl, rollout_deployment)

@@ -1,0 +1,716 @@
+"""RL fleet: serve-deployed rollout replicas feeding a checkpointed learner.
+
+The composite scenario the serve+train stack exists for (ROADMAP item 2,
+PAPERS.md Podracer/RLAX fleets): N rollout replicas behind a serve
+deployment generate episodes — riding the continuous-batching decode engine
+when the policy is a transformer, plain env rollouts otherwise — ship
+sample batches to a learner actor through the zero-copy object plane, and
+receive updated weights back through the serve *lightweight-update* path
+(`serve.reconfigure`: in-place user_config push, no rolling restart).
+
+Robustness contract:
+
+- **Weight epochs.** Every broadcast carries a monotonically increasing
+  ``epoch``. Replicas fence regressions in ``reconfigure()`` (a rolling
+  update replaying an old config cannot roll weights back) and every
+  rollout envelope records the epoch it was generated under; the learner
+  drops samples older than ``max_staleness`` epochs and histograms the lag.
+- **Exactly-once sample accounting.** The learner dedupes rollout ids.
+  The applied-id set rides the checkpoint, so a crash-restart resumes from
+  the latest *complete* checkpoint (`train.checkpointing.latest_checkpoint`)
+  without double-applying any batch that checkpoint already contains —
+  post-checkpoint batches were rolled back with the params, so re-applying
+  them is correct, not a duplicate.
+- **Partition tolerance.** The two loop boundaries are named fault points
+  (`fleet_ingest`: replicas->learner, `fleet_weights`: learner->replicas)
+  judged by the injector's partition rules, so a
+  ``partition:learner|replicas`` blackhole starves the loop without killing
+  it; the driver retries with backoff until heal — no hung futures, every
+  future resolves or times out.
+
+`python -m ray_tpu.rllib.trainstorm` composes all three failure modes over
+this module and commits the TRAINSTORM artifact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core.rpc import (RpcDisconnected, fault_point,
+                              get_fault_injector)
+
+logger = logging.getLogger(__name__)
+
+# Named fault-point labels for the loop's two logical boundaries, plus the
+# literal group labels `partition:learner|replicas` specs resolve against.
+INGEST_FAULT_POINT = "fleet_ingest"      # sample handoff into the learner
+WEIGHTS_FAULT_POINT = "fleet_weights"    # weight broadcast to replicas
+LEARNER_GROUP = "learner"
+REPLICA_GROUP = "replicas"
+LEARNER_ACTOR_NAME = "fleet_learner"
+
+
+def define_fleet_groups(inj=None):
+    """Register the `learner` / `replicas` partition groups (each a single
+    literal label — these are logical planes, not node addresses) on the
+    installed injector so `partition:learner|replicas` severs exactly the
+    fleet_ingest / fleet_weights boundaries. No-op without an injector."""
+    inj = inj if inj is not None else get_fault_injector()
+    if inj is None:
+        return None
+    inj.define_group(LEARNER_GROUP, {LEARNER_GROUP})
+    inj.define_group(REPLICA_GROUP, {REPLICA_GROUP})
+    return inj
+
+
+# --------------------------------------------------------------------- config
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Knobs for the rollout->learner loop. Every field can be overridden
+    with a ``RAY_TPU_FLEET_<FIELD>`` environment variable (same pattern as
+    ServeConfig) so chaos harnesses and CI shrink the fleet without code."""
+
+    num_replicas: int = 2
+    num_envs: int = 2            # vector envs per replica (mlp policy)
+    rollout_len: int = 32        # steps per env per sample() call
+    max_staleness: int = 2       # drop samples > this many epochs old
+    checkpoint_every: int = 4    # learner steps between checkpoints
+    keep_checkpoints: int = 3    # retention for gc_checkpoints
+    broadcast_every: int = 1     # learner steps between weight broadcasts
+    lr: float = 3e-4
+    gamma: float = 0.99
+    lam: float = 0.95
+    sgd_epochs: int = 2
+    minibatch_size: int = 64
+    seed: int = 0
+    policy: str = "mlp"          # "mlp" (env rollouts) | "transformer"
+    max_new_tokens: int = 8      # transformer policy: decode length
+    ingest_timeout_s: float = 30.0     # single learner-call timeout
+    ingest_backoff_s: float = 0.2      # retry backoff while partitioned
+    ingest_deadline_s: float = 60.0    # give up (drop batch) after this
+    sample_timeout_s: float = 60.0
+    deployment_name: str = "rollout_fleet"
+
+    @classmethod
+    def from_env(cls, **overrides) -> "FleetConfig":
+        kwargs: Dict[str, Any] = {}
+        for f in dataclasses.fields(cls):
+            raw = os.environ.get(f"RAY_TPU_FLEET_{f.name.upper()}")
+            if raw is None:
+                continue
+            if f.type in ("int", int):
+                kwargs[f.name] = int(raw)
+            elif f.type in ("float", float):
+                kwargs[f.name] = float(raw)
+            else:
+                kwargs[f.name] = raw
+        kwargs.update(overrides)
+        return cls(**kwargs)
+
+
+# ----------------------------------------------------------- rollout replicas
+
+
+class _MlpRollouts:
+    """Plain env-rollout policy: the PPO RolloutWorkerImpl over CartPole."""
+
+    def __init__(self, cfg: FleetConfig, seed: int):
+        from ray_tpu.rllib.env import CartPoleEnv
+        from ray_tpu.rllib.ppo import RolloutWorkerImpl
+
+        self._worker = RolloutWorkerImpl(
+            CartPoleEnv, num_envs=cfg.num_envs, seed=seed,
+            obs_dim=4, num_actions=2)
+
+    def set_weights(self, weights) -> None:
+        self._worker.set_weights(weights)
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        return self._worker.sample(num_steps)
+
+
+class _TransformerRollouts:
+    """Transformer policy: episodes are sampled continuations out of the
+    continuous-batching decode engine (PR 16) — the Podracer shape where
+    the 'environment step' IS a model decode. The sample batch ships token
+    sequences; the learner applies a next-token LM step on them."""
+
+    def __init__(self, cfg: FleetConfig, seed: int):
+        import jax
+
+        from ray_tpu.models import ModelConfig, init_params
+
+        self._mcfg = ModelConfig.tiny()
+        self._cfg = cfg
+        self._params = init_params(jax.random.PRNGKey(seed), self._mcfg)
+        self._rng = np.random.default_rng(seed)
+        self._engine = None
+        self._rebuild_engine()
+
+    def _rebuild_engine(self) -> None:
+        from ray_tpu.models.serving import ContinuousBatchingEngine
+
+        old, self._engine = self._engine, None
+        if old is not None:
+            old.stop_driver()
+        self._engine = ContinuousBatchingEngine(
+            self._params, self._mcfg, num_slots=2, max_len=64)
+        self._engine.start_driver()
+
+    def set_weights(self, weights) -> None:
+        import jax.numpy as jnp
+        import jax
+
+        self._params = jax.tree_util.tree_map(jnp.asarray, weights)
+        # the engine closed over the old params; swap in a fresh one
+        self._rebuild_engine()
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        n_seqs = max(1, num_steps // self._cfg.max_new_tokens)
+        prompt_len = 4
+        seqs = []
+        for _ in range(n_seqs):
+            prompt = [int(t) for t in self._rng.integers(
+                1, self._mcfg.vocab_size, size=prompt_len)]
+            toks = self._engine.generate(
+                prompt, max_new_tokens=self._cfg.max_new_tokens)
+            seqs.append(prompt + list(toks))
+        width = max(len(s) for s in seqs)
+        tokens = np.zeros((len(seqs), width), np.int32)
+        for i, s in enumerate(seqs):
+            tokens[i, :len(s)] = s
+        return {"tokens": tokens,
+                "episode_returns": np.array(
+                    [float(len(s) - prompt_len) for s in seqs], np.float32)}
+
+
+def _make_policy(cfg: FleetConfig, seed: int):
+    if cfg.policy == "transformer":
+        return _TransformerRollouts(cfg, seed)
+    return _MlpRollouts(cfg, seed)
+
+
+def rollout_deployment(cfg: FleetConfig):
+    """Build the serve deployment class for the rollout fleet.
+
+    Weight delivery is `reconfigure(user_config)` — the serve lightweight-
+    update path — with **epoch fencing**: a config whose epoch is <= the
+    replica's current epoch is refused *silently* (counted, not raised).
+    Raising would trip the controller's rolling-redeploy fallback and
+    restart the whole fleet over what is by definition a no-op."""
+
+    @serve.deployment(name=cfg.deployment_name, num_replicas=cfg.num_replicas)
+    class RolloutReplica:
+        def __init__(self, cfg_dict: dict):
+            self._cfg = FleetConfig(**cfg_dict)
+            # replicas must not generate identical trajectories: decorrelate
+            # the env/rng seed by pid while keeping the run seeded overall
+            self._impl = _make_policy(
+                self._cfg, self._cfg.seed + (os.getpid() % 10000))
+            self._epoch = -1          # no weights applied yet
+            self._fenced = 0
+            self._applied_updates = 0
+            self._lock = threading.Lock()
+
+        def reconfigure(self, user_config) -> dict:
+            if not isinstance(user_config, dict) or "epoch" not in user_config:
+                return {"applied": False, "reason": "not-a-weight-config"}
+            epoch = int(user_config["epoch"])
+            with self._lock:
+                if epoch <= self._epoch:
+                    # FENCE: out-of-order broadcast (rolling update replaying
+                    # an older config, or a delayed push landing late).
+                    self._fenced += 1
+                    logger.info("replica fenced weight epoch %d (at %d)",
+                                epoch, self._epoch)
+                    return {"applied": False, "reason": "fenced",
+                            "epoch": self._epoch}
+                self._impl.set_weights(user_config["weights"])
+                self._epoch = epoch
+                self._applied_updates += 1
+                return {"applied": True, "epoch": epoch}
+
+        def sample(self, num_steps: Optional[int] = None) -> dict:
+            """One rollout. Returns a small envelope; the batch itself goes
+            through the zero-copy object plane (`ray_tpu.put` here, shm view
+            on the learner's same-node `get`) instead of riding the serve
+            response path. A replica killed mid-call is retried on a peer by
+            the handle's mid-request failover; the fresh uuid per attempt
+            keeps retries dedupe-transparent at the learner."""
+            with self._lock:
+                if self._epoch < 0:
+                    return {"rollout_id": None, "weight_epoch": -1,
+                            "ref": None, "reason": "no-weights-yet"}
+                n = int(num_steps or self._cfg.rollout_len)
+                batch = self._impl.sample(n)
+            return {"rollout_id": uuid.uuid4().hex,
+                    "weight_epoch": self._epoch,
+                    "ref": ray_tpu.put(batch),
+                    "num_env_steps": n * self._cfg.num_envs,
+                    "pid": os.getpid()}
+
+        def fence_stats(self) -> dict:
+            with self._lock:
+                return {"epoch": self._epoch, "fenced": self._fenced,
+                        "applied_updates": self._applied_updates,
+                        "pid": os.getpid()}
+
+    return RolloutReplica
+
+
+# ---------------------------------------------------------------- the learner
+
+
+class FleetLearnerImpl:
+    """Checkpointed learner with exactly-once ingest accounting.
+
+    State = params/opt pytrees + (step, epoch, applied rollout ids), saved
+    atomically every `checkpoint_every` steps via train.checkpointing.
+    `ingest` is the only mutation path: dedupe -> staleness gate -> update.
+    """
+
+    def __init__(self, cfg_dict: dict, ckpt_root: str, min_epoch: int = 0):
+        self._cfg = cfg = FleetConfig(**cfg_dict)
+        self._ckpt_root = ckpt_root
+        self._core = self._build_core(cfg)
+        self._step = 0
+        self._epoch = 0
+        self._applied_ids: set = set()
+        self._staleness_hist: Dict[int, int] = {}
+        self._dropped_stale = 0
+        self._dropped_dup = 0
+        self._rng = np.random.default_rng(cfg.seed)
+        self._restored_from: Optional[str] = None
+        self._restore()
+        # A broadcast can outrun the last checkpoint: the driver passes the
+        # highest epoch it ever PUBLISHED so a restarted learner never
+        # re-issues an epoch the replicas would (correctly) fence forever.
+        self._epoch = max(self._epoch, int(min_epoch))
+
+    # -------------------------------------------------------- policy cores
+    def _build_core(self, cfg: FleetConfig):
+        if cfg.policy == "transformer":
+            return _TransformerLearnerCore(cfg)
+        return _MlpLearnerCore(cfg)
+
+    # ------------------------------------------------------- checkpointing
+    def _restore(self) -> None:
+        from ray_tpu.train.checkpointing import (abstract_like,
+                                                 latest_checkpoint,
+                                                 load_checkpoint)
+
+        path = latest_checkpoint(self._ckpt_root)
+        if path is None:
+            return
+        state, meta = load_checkpoint(
+            path, abstract_like(self._core.state()))
+        self._core.load_state(state)
+        self._step = int(meta["step"])
+        self._epoch = int(meta.get("epoch", 0))
+        self._applied_ids = set(meta.get("applied_ids", []))
+        self._restored_from = path
+        logger.info("fleet learner restored step=%d epoch=%d (%d applied "
+                    "ids) from %s", self._step, self._epoch,
+                    len(self._applied_ids), path)
+
+    def _maybe_checkpoint(self) -> Optional[str]:
+        if self._cfg.checkpoint_every <= 0:
+            return None
+        if self._step % self._cfg.checkpoint_every != 0:
+            return None
+        from ray_tpu.train.checkpointing import (gc_checkpoints,
+                                                 save_checkpoint)
+
+        path = save_checkpoint(
+            self._core.state(), self._ckpt_root, self._step,
+            meta={"epoch": self._epoch,
+                  "applied_ids": sorted(self._applied_ids)})
+        gc_checkpoints(self._ckpt_root, self._cfg.keep_checkpoints)
+        return path
+
+    # --------------------------------------------------------------- ingest
+    def ingest(self, rollout_id: str, gen_epoch: int, batch) -> dict:
+        """Apply one sample batch exactly once. `batch` arrives as a
+        materialized top-level ObjectRef arg (zero-copy plane: same-node
+        shm view, no extra copy through the serve response path)."""
+        if rollout_id in self._applied_ids:
+            self._dropped_dup += 1
+            return {"applied": False, "reason": "duplicate",
+                    "step": self._step}
+        lag = max(0, self._epoch - int(gen_epoch))
+        self._staleness_hist[lag] = self._staleness_hist.get(lag, 0) + 1
+        if lag > self._cfg.max_staleness:
+            self._dropped_stale += 1
+            return {"applied": False, "reason": "stale", "lag": lag,
+                    "step": self._step}
+        stats = self._core.update(batch, self._rng)
+        self._step += 1
+        self._applied_ids.add(rollout_id)
+        ckpt = self._maybe_checkpoint()
+        return {"applied": True, "step": self._step, "lag": lag,
+                "checkpoint": ckpt, "stats": stats}
+
+    # -------------------------------------------------------------- weights
+    def advance_epoch(self) -> dict:
+        """Bump the weight epoch and return the broadcast payload. The
+        driver (not the learner) owns delivery: it pushes this through
+        serve.reconfigure so rolling updates and in-place pushes share one
+        monotonic epoch stream."""
+        self._epoch += 1
+        return {"epoch": self._epoch, "weights": self._core.weights()}
+
+    def info(self) -> dict:
+        return {"step": self._step, "epoch": self._epoch,
+                "applied": len(self._applied_ids),
+                "dropped_stale": self._dropped_stale,
+                "dropped_dup": self._dropped_dup,
+                "staleness_hist": dict(self._staleness_hist),
+                "restored_from": self._restored_from,
+                "pid": os.getpid()}
+
+
+class _MlpLearnerCore:
+    """PPO update loop over env-rollout batches."""
+
+    def __init__(self, cfg: FleetConfig):
+        from ray_tpu.rllib.ppo import PPOLearner
+
+        self._cfg = cfg
+        self._learner = PPOLearner(obs_dim=4, num_actions=2, lr=cfg.lr,
+                                   seed=cfg.seed)
+
+    def state(self):
+        return {"params": self._learner.params,
+                "opt_state": self._learner.opt_state}
+
+    def load_state(self, state) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self._learner.params = jax.tree_util.tree_map(
+            jnp.asarray, state["params"])
+        self._learner.opt_state = jax.tree_util.tree_map(
+            jnp.asarray, state["opt_state"])
+
+    def weights(self):
+        return self._learner.get_weights()
+
+    def update(self, batch, rng) -> Dict[str, float]:
+        from ray_tpu.rllib.ppo import compute_gae
+
+        adv, ret = compute_gae(batch, self._cfg.gamma, self._cfg.lam)
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        T, N = batch["rewards"].shape
+        flat = {
+            "obs": batch["obs"].reshape(T * N, -1),
+            "actions": batch["actions"].reshape(T * N),
+            "logp": batch["logp"].reshape(T * N),
+            "advantages": adv.reshape(T * N).astype(np.float32),
+            "returns": ret.reshape(T * N).astype(np.float32),
+        }
+        return self._learner.update_minibatches(
+            flat, self._cfg.sgd_epochs, self._cfg.minibatch_size, rng)
+
+
+class _TransformerLearnerCore:
+    """Next-token LM step over decode-engine token batches."""
+
+    def __init__(self, cfg: FleetConfig):
+        import jax
+        import optax
+
+        from ray_tpu.models import ModelConfig, init_params
+        from ray_tpu.models.transformer import loss_fn
+
+        self._mcfg = ModelConfig.tiny()
+        self._params = init_params(jax.random.PRNGKey(cfg.seed), self._mcfg)
+        self._opt = optax.adam(cfg.lr)
+        self._opt_state = self._opt.init(self._params)
+
+        def step(params, opt_state, batch):
+            (l, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch, self._mcfg)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, l
+
+        self._step_fn = jax.jit(step)
+
+    def state(self):
+        return {"params": self._params, "opt_state": self._opt_state}
+
+    def load_state(self, state) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self._params = jax.tree_util.tree_map(jnp.asarray, state["params"])
+        self._opt_state = jax.tree_util.tree_map(
+            jnp.asarray, state["opt_state"])
+
+    def weights(self):
+        import jax
+
+        return jax.tree_util.tree_map(
+            np.asarray, jax.device_get(self._params))
+
+    def update(self, batch, rng) -> Dict[str, float]:
+        tokens = np.asarray(batch["tokens"], np.int32)
+        lm_batch = {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+        self._params, self._opt_state, loss = self._step_fn(
+            self._params, self._opt_state, lm_batch)
+        return {"total_loss": float(loss)}
+
+
+FleetLearner = ray_tpu.remote(FleetLearnerImpl)
+
+
+# ----------------------------------------------------------------- the driver
+
+
+@dataclasses.dataclass
+class IngestOutcome:
+    applied: int = 0
+    duplicate: int = 0
+    stale: int = 0
+    partition_dropped: int = 0   # gave up after ingest_deadline_s
+    retries: int = 0
+
+
+class FleetDriver:
+    """Owns the loop: deploy the rollout fleet, (re)create the named
+    learner actor, and iterate sample -> ingest -> broadcast. All fault
+    points live HERE (one process, one injector): the driver mediates both
+    boundaries, so `partition:learner|replicas` starves exactly what a real
+    network blackhole between the planes would."""
+
+    def __init__(self, cfg: FleetConfig, ckpt_root: str):
+        self.cfg = cfg
+        self.ckpt_root = ckpt_root
+        # harness hook: set to abort retry loops early (abandoned serve
+        # futures still resolve typed via the deadline reaper — no hangs)
+        self.stop_event = threading.Event()
+        self.outcomes = IngestOutcome()
+        # staleness lag per ingest verdict, aggregated HERE because the
+        # learner's in-memory histogram resets on crash-restart
+        self.staleness_hist: Dict[int, int] = {}
+        self.broadcasts = 0
+        self.broadcast_failures = 0
+        self.last_broadcast_epoch = 0   # highest epoch ever PUBLISHED
+        self.learner_restarts = 0
+        self.recovery_s: List[float] = []
+        self.sample_failures = 0
+        self._handle = None
+        self._learner = None
+        define_fleet_groups()
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self):
+        dep = rollout_deployment(self.cfg)
+        self._handle = serve.run(
+            dep.bind(dataclasses.asdict(self.cfg)),
+            name=self.cfg.deployment_name)
+        self._sample_handle = self._handle.options(
+            method_name="sample", timeout_s=self.cfg.sample_timeout_s)
+        self.ensure_learner()
+        # prime the fleet so replicas can sample at all
+        self.broadcast(require_all=True)
+        return self._handle
+
+    def ensure_learner(self, was_restart: bool = False):
+        """Connect to (or [re]create) the named learner actor."""
+        try:
+            self._learner = ray_tpu.get_actor(LEARNER_ACTOR_NAME)
+            return self._learner
+        except ValueError:
+            pass
+        t0 = time.monotonic()
+        self._learner = FleetLearner.options(
+            name=LEARNER_ACTOR_NAME).remote(
+                dataclasses.asdict(self.cfg), self.ckpt_root,
+                min_epoch=self.last_broadcast_epoch)
+        # block until constructed (restore included) so recovery time is
+        # honest: measured to a *usable* learner, not an enqueued actor
+        ray_tpu.get(self._learner.info.remote(), timeout=120)
+        if was_restart:
+            self.learner_restarts += 1
+            self.recovery_s.append(time.monotonic() - t0)
+        return self._learner
+
+    def stop(self):
+        self.stop_event.set()
+        try:
+            serve.delete(self.cfg.deployment_name)
+        except Exception:
+            logger.debug("fleet deployment delete lost", exc_info=True)
+        try:
+            learner = ray_tpu.get_actor(LEARNER_ACTOR_NAME)
+            ray_tpu.kill(learner, no_restart=True)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------ the loop
+    def sample_round(self) -> List[dict]:
+        """Fan one sample() per target replica through the handle (the
+        router spreads them; mid-request failover covers replica kills).
+        Returns the envelopes that resolved."""
+        futs = [self._sample_handle.remote()
+                for _ in range(self.cfg.num_replicas)]
+        out = []
+        for f in futs:
+            if self.stop_event.is_set():
+                break  # abandoned futures resolve typed (deadline reaper)
+            try:
+                env = ray_tpu.get(f, timeout=self.cfg.sample_timeout_s)
+                if env.get("rollout_id") is not None:
+                    out.append(env)
+            except Exception:
+                # replica kill beyond the retry budget / drain window —
+                # the round simply yields fewer batches
+                self.sample_failures += 1
+                logger.info("sample round lost a batch", exc_info=True)
+        return out
+
+    def ingest(self, envelope: dict) -> Optional[dict]:
+        """Deliver one envelope to the learner, riding out partitions
+        (retry+backoff up to ingest_deadline_s) and learner crashes
+        (recreate the named actor, then retry — dedupe/checkpoint make the
+        retry exactly-once). Returns the learner's verdict, or None if the
+        batch was abandoned at the deadline."""
+        deadline = time.monotonic() + self.cfg.ingest_deadline_s
+        while True:
+            try:
+                # the partitionable boundary: replicas-plane -> learner-plane
+                fault_point(INGEST_FAULT_POINT,
+                            origin=REPLICA_GROUP, dest=LEARNER_GROUP)
+                res = ray_tpu.get(
+                    self._learner.ingest.remote(
+                        envelope["rollout_id"], envelope["weight_epoch"],
+                        envelope["ref"]),
+                    timeout=self.cfg.ingest_timeout_s)
+            except RpcDisconnected:
+                if (self.stop_event.is_set()
+                        or time.monotonic() > deadline):
+                    self.outcomes.partition_dropped += 1
+                    return None
+                self.outcomes.retries += 1
+                time.sleep(self.cfg.ingest_backoff_s)
+                continue
+            except Exception:
+                if (self.stop_event.is_set()
+                        or time.monotonic() > deadline):
+                    self.outcomes.partition_dropped += 1
+                    return None
+                logger.info("learner ingest failed; reconnecting",
+                            exc_info=True)
+                self.outcomes.retries += 1
+                time.sleep(self.cfg.ingest_backoff_s)
+                try:
+                    self.ensure_learner(was_restart=True)
+                except Exception:
+                    logger.info("learner recreate failed; will retry",
+                                exc_info=True)
+                continue
+            if "lag" in res:
+                self.staleness_hist[res["lag"]] = (
+                    self.staleness_hist.get(res["lag"], 0) + 1)
+            if res.get("applied"):
+                self.outcomes.applied += 1
+            elif res.get("reason") == "duplicate":
+                self.outcomes.duplicate += 1
+            elif res.get("reason") == "stale":
+                self.outcomes.stale += 1
+            return res
+
+    def broadcast(self, require_all: bool = False) -> bool:
+        """Pull the next epoch's weights from the learner and push them
+        through the serve lightweight-update path. Partitioned broadcasts
+        retry inside the ingest deadline; the epoch was already consumed,
+        so a lost broadcast simply widens observed staleness (bounded by
+        max_staleness at the learner)."""
+        deadline = time.monotonic() + self.cfg.ingest_deadline_s
+        payload = None
+        while payload is None:
+            try:
+                payload = ray_tpu.get(self._learner.advance_epoch.remote(),
+                                      timeout=self.cfg.ingest_timeout_s)
+            except Exception:
+                if (self.stop_event.is_set()
+                        or time.monotonic() > deadline):
+                    self.broadcast_failures += 1
+                    return False
+                time.sleep(self.cfg.ingest_backoff_s)
+                try:
+                    self.ensure_learner(was_restart=True)
+                except Exception:
+                    pass
+        self.last_broadcast_epoch = max(self.last_broadcast_epoch,
+                                        int(payload["epoch"]))
+        while True:
+            try:
+                # the partitionable boundary: learner-plane -> replicas-plane
+                fault_point(WEIGHTS_FAULT_POINT,
+                            origin=LEARNER_GROUP, dest=REPLICA_GROUP)
+                ok = serve.reconfigure(self.cfg.deployment_name, payload)
+                self.broadcasts += 1
+                if require_all and not ok:
+                    # a fresh fleet must not sample weightless: re-push
+                    # until every replica acked the priming epoch
+                    raise RpcDisconnected("priming broadcast incomplete")
+                return ok
+            except (RpcDisconnected, KeyError, OSError, TimeoutError):
+                if (self.stop_event.is_set()
+                        or time.monotonic() > deadline):
+                    self.broadcast_failures += 1
+                    return False
+                time.sleep(self.cfg.ingest_backoff_s)
+
+    def train_round(self) -> Dict[str, Any]:
+        """One loop iteration: sample the fleet, ingest every envelope,
+        broadcast per `broadcast_every`. Returns round metrics."""
+        t0 = time.monotonic()
+        envelopes = self.sample_round()
+        applied = 0
+        applied_env_steps = 0
+        last = None
+        for env in envelopes:
+            res = self.ingest(env)
+            if res is not None:
+                last = res
+                if res.get("applied"):
+                    applied += 1
+                    applied_env_steps += env.get("num_env_steps", 0)
+        if (last is not None and self.cfg.broadcast_every > 0
+                and last.get("applied")
+                and last["step"] % self.cfg.broadcast_every == 0):
+            self.broadcast()
+        return {"envelopes": len(envelopes), "applied": applied,
+                "applied_env_steps": applied_env_steps,
+                "round_s": time.monotonic() - t0}
+
+    def learner_info(self, timeout: float = 30.0) -> dict:
+        return ray_tpu.get(self._learner.info.remote(), timeout=timeout)
+
+    def fence_stats(self, timeout: float = 30.0) -> List[dict]:
+        h = self._handle.options(method_name="fence_stats",
+                                 timeout_s=timeout)
+        futs = [h.remote() for _ in range(self.cfg.num_replicas * 2)]
+        stats: Dict[int, dict] = {}
+        for f in futs:
+            try:
+                s = ray_tpu.get(f, timeout=timeout)
+                stats[s["pid"]] = s
+            except Exception:
+                pass
+        return list(stats.values())

@@ -1,0 +1,391 @@
+"""TRAINSTORM: the RL fleet under composed chaos, with a committed artifact.
+
+`python -m ray_tpu.rllib.trainstorm` runs the rollout->learner loop
+(rllib/fleet.py) while three seeded failure modes fire mid-training:
+
+  1. **replica kills** — a killer thread hard-kills live rollout replicas on
+     a period; mid-episode requests recover via serve mid-request failover
+     and the controller restarts replacements (which pick the latest weight
+     epoch up from the recorded user_config).
+  2. **learner crash-restart** — the named learner actor is killed once;
+     the driver recreates it, it restores from the latest *complete*
+     checkpoint, and exactly-once ingest accounting (rollout-id dedupe in
+     the checkpoint) guarantees no batch is applied twice across the
+     restart. Recovery is measured kill -> first post-restart applied step.
+  3. **partition-heal** — a `partition:learner|replicas` blackhole severs
+     the fleet_ingest/fleet_weights boundaries for a window, then heals;
+     the driver's bounded retry loops must converge with zero hung futures.
+
+The run commits `TRAINSTORM_r17.json`: samples/s, learner steps/s,
+recovery-to-first-post-restart-step, the staleness histogram, chaos event
+counts and `zero_hung`. CI replays a `--quick` profile and asserts on the
+required rows; `tests/test_envelope.py` floors the two rates against
+machine-calibrated probes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os as _os
+import random
+import shutil
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_ARTIFACT = "TRAINSTORM_r17.json"
+ROUND = 17
+
+
+@dataclasses.dataclass
+class TrainStormProfile:
+    duration_s: float = 30.0
+    seed: int = 0
+    # fleet shape (forwarded into FleetConfig; env RAY_TPU_FLEET_* still
+    # overrides anything not set here)
+    num_replicas: int = 3
+    num_envs: int = 2
+    rollout_len: int = 32
+    max_staleness: int = 2
+    checkpoint_every: int = 3
+    keep_checkpoints: int = 3
+    broadcast_every: int = 1
+    policy: str = "mlp"
+    # chaos schedule
+    replica_kill_period_s: float = 6.0
+    learner_kill_at_frac: float = 0.35   # one crash-restart mid-run
+    partition_at_frac: float = 0.6
+    partition_duration_s: float = 4.0
+    # budgets
+    recovery_budget_s: float = 30.0
+    drain_grace_s: float = 60.0
+    # loop timeouts (forwarded into FleetConfig)
+    sample_timeout_s: float = 60.0
+    ingest_timeout_s: float = 15.0
+    ingest_deadline_s: float = 45.0
+
+
+QUICK_PROFILE = dict(duration_s=12.0, replica_kill_period_s=4.0,
+                     rollout_len=16, checkpoint_every=2,
+                     partition_duration_s=2.5, num_replicas=2,
+                     sample_timeout_s=30.0, ingest_timeout_s=10.0,
+                     ingest_deadline_s=25.0, drain_grace_s=45.0,
+                     recovery_budget_s=45.0)
+
+
+def _effective_cpus() -> int:
+    try:
+        return len(_os.sched_getaffinity(0)) or 1
+    except (AttributeError, OSError):
+        return _os.cpu_count() or 1
+
+
+def run_trainstorm(profile: Optional[TrainStormProfile] = None,
+                   out_path: Optional[str] = DEFAULT_ARTIFACT,
+                   ckpt_root: Optional[str] = None) -> Dict[str, Any]:
+    """Run one storm on the CURRENT cluster (caller already init'd).
+    Returns the result dict (written to out_path unless None). Never raises
+    on a dirty run — callers assert on result["violations"]."""
+    import ray_tpu
+    from ray_tpu.core import rpc as _rpc
+    from ray_tpu.rllib.fleet import (LEARNER_ACTOR_NAME, LEARNER_GROUP,
+                                     REPLICA_GROUP, FleetConfig, FleetDriver,
+                                     define_fleet_groups)
+
+    p = profile or TrainStormProfile()
+    rng = random.Random(p.seed)
+    cfg = FleetConfig.from_env(
+        num_replicas=p.num_replicas, num_envs=p.num_envs,
+        rollout_len=p.rollout_len, max_staleness=p.max_staleness,
+        checkpoint_every=p.checkpoint_every,
+        keep_checkpoints=p.keep_checkpoints,
+        broadcast_every=p.broadcast_every, policy=p.policy, seed=p.seed,
+        sample_timeout_s=p.sample_timeout_s,
+        ingest_timeout_s=p.ingest_timeout_s,
+        ingest_deadline_s=p.ingest_deadline_s)
+    owns_ckpt = ckpt_root is None
+    ckpt_root = ckpt_root or tempfile.mkdtemp(prefix="trainstorm_ckpt_")
+    # an injector with no spec rules: partitions are armed at runtime so
+    # the blackhole window is scheduled, not probabilistic
+    injector = _rpc.install_fault_injector("", p.seed)
+    define_fleet_groups(injector)
+
+    driver = FleetDriver(cfg, ckpt_root)
+    t_start = time.monotonic()
+    try:
+        driver.start()
+        return _run_inner(p, rng, cfg, driver, injector, out_path, t_start)
+    finally:
+        try:
+            driver.stop()
+        finally:
+            _rpc.clear_fault_injector()
+            if owns_ckpt:
+                shutil.rmtree(ckpt_root, ignore_errors=True)
+
+
+def _run_inner(p: TrainStormProfile, rng: random.Random, cfg, driver,
+               injector, out_path: Optional[str],
+               t_start: float) -> Dict[str, Any]:
+    import ray_tpu
+    from ray_tpu.rllib.fleet import LEARNER_ACTOR_NAME
+
+    stop = threading.Event()
+    rounds = 0
+    env_steps_applied = 0
+    loop_error: List[BaseException] = []
+
+    def loop() -> None:
+        nonlocal rounds, env_steps_applied
+        while not stop.is_set():
+            try:
+                m = driver.train_round()
+            except BaseException as e:  # a storm must surface, not die
+                loop_error.append(e)
+                logger.warning("train loop error", exc_info=True)
+                time.sleep(0.2)
+                continue
+            rounds += 1
+            env_steps_applied += m["applied_env_steps"]
+
+    replica_kills = 0
+
+    def replica_killer() -> None:
+        nonlocal replica_kills
+        while not stop.wait(p.replica_kill_period_s):
+            try:
+                handle = driver._handle
+                with handle._lock:
+                    replicas = list(handle._replicas)
+                if len(replicas) < 2:
+                    continue  # never kill the last replica
+                victim = replicas[rng.randrange(len(replicas))]
+                ray_tpu.kill(victim)
+                replica_kills += 1
+                logger.info("trainstorm killed a rollout replica")
+            except Exception:
+                logger.warning("replica kill pass failed", exc_info=True)
+
+    learner_kill: Dict[str, Any] = {"kills": 0, "recovery_s": None,
+                                    "applied_at_kill": None,
+                                    "step_at_kill": None}
+
+    def learner_killer() -> None:
+        if stop.wait(p.duration_s * p.learner_kill_at_frac):
+            return
+        try:
+            info = driver.learner_info(timeout=30)
+            victim = ray_tpu.get_actor(LEARNER_ACTOR_NAME)
+            learner_kill["applied_at_kill"] = driver.outcomes.applied
+            learner_kill["step_at_kill"] = info["step"]
+            t_kill = time.monotonic()
+            ray_tpu.kill(victim, no_restart=True)
+            learner_kill["kills"] += 1
+            logger.info("trainstorm killed the learner at step %d",
+                        info["step"])
+            # recovery = kill -> first post-restart APPLIED step; keep
+            # watching through the drain window (a slow box often lands
+            # the post-restart step after the storm clock stops)
+            watch_until = t_kill + p.recovery_budget_s + p.drain_grace_s
+            while time.monotonic() < watch_until:
+                if driver.outcomes.applied > learner_kill["applied_at_kill"]:
+                    learner_kill["recovery_s"] = time.monotonic() - t_kill
+                    return
+                time.sleep(0.05)
+        except Exception:
+            logger.warning("learner kill failed", exc_info=True)
+
+    partition: Dict[str, Any] = {"injected": 0, "healed": 0,
+                                 "window_s": p.partition_duration_s,
+                                 "retries_during": 0}
+
+    def partitioner() -> None:
+        from ray_tpu.rllib.fleet import LEARNER_GROUP, REPLICA_GROUP
+
+        if stop.wait(p.duration_s * p.partition_at_frac):
+            return
+        retries_before = driver.outcomes.retries
+        injector.partition(LEARNER_GROUP, REPLICA_GROUP)
+        partition["injected"] += 1
+        logger.info("trainstorm partitioned learner|replicas")
+        stop.wait(p.partition_duration_s)
+        partition["healed"] += injector.heal()
+        partition["retries_during"] = (driver.outcomes.retries
+                                       - retries_before)
+        logger.info("trainstorm healed the partition")
+
+    threads = [threading.Thread(target=f, daemon=True, name=n)
+               for f, n in ((loop, "ts-loop"),
+                            (replica_killer, "ts-replica-killer"),
+                            (learner_killer, "ts-learner-killer"),
+                            (partitioner, "ts-partitioner"))]
+    for t in threads:
+        t.start()
+    time.sleep(p.duration_s)
+    stop.set()
+    window_s = time.monotonic() - t_start
+    applied_at_stop = driver.outcomes.applied
+    env_steps_at_stop = env_steps_applied
+    driver.stop_event.set()  # abort in-flight retry loops cooperatively
+
+    # Drain: every thread must exit inside the grace window — a stuck loop
+    # IS a hung future (an unresolved get inside train_round).
+    hung = 0
+    for t in threads:
+        t.join(timeout=p.drain_grace_s)
+        if t.is_alive():
+            hung += 1
+            logger.error("trainstorm thread %s failed to drain", t.name)
+    elapsed = time.monotonic() - t_start
+
+    info: Dict[str, Any] = {}
+    fence_stats: List[dict] = []
+    try:
+        info = driver.learner_info(timeout=60)
+        fence_stats = driver.fence_stats(timeout=30)
+    except Exception:
+        hung += 1
+        logger.error("post-storm learner_info unresolved", exc_info=True)
+
+    # Rates over the ACTIVE window (chaos included, drain excluded):
+    # samples/s = env transitions ingested+applied; learner steps/s =
+    # batches applied (one optimizer pass each).
+    samples_per_s = env_steps_at_stop / window_s if window_s > 0 else 0.0
+    steps_per_s = applied_at_stop / window_s if window_s > 0 else 0.0
+
+    violations: List[str] = []
+    if hung:
+        violations.append(f"hung: {hung} unresolved thread(s)/future(s)")
+    if loop_error:
+        violations.append(f"loop_error: {loop_error[0]!r}")
+    if replica_kills < 1:
+        violations.append("chaos: no replica kill landed")
+    if learner_kill["kills"] < 1:
+        violations.append("chaos: no learner crash-restart landed")
+    if partition["injected"] < 1 or partition["healed"] < 1:
+        violations.append("chaos: no partition-heal cycle landed")
+    if learner_kill["kills"] and learner_kill["recovery_s"] is None:
+        violations.append("recovery: no post-restart step before drain")
+    elif (learner_kill["recovery_s"] is not None
+          and learner_kill["recovery_s"] > p.recovery_budget_s):
+        violations.append(
+            f"recovery: {learner_kill['recovery_s']:.1f}s > "
+            f"budget {p.recovery_budget_s:.1f}s")
+    if driver.outcomes.applied < 1:
+        violations.append("liveness: no batch applied at all")
+
+    result: Dict[str, Any] = {
+        "bench": "trainstorm",
+        "round": ROUND,
+        "seed": p.seed,
+        "policy": cfg.policy,
+        "effective_cpus": _effective_cpus(),
+        "duration_s": round(elapsed, 3),
+        "profile": dataclasses.asdict(p),
+        "rounds": rounds,
+        "samples_per_s": round(samples_per_s, 3),
+        "learner_steps_per_s": round(steps_per_s, 3),
+        "learner_steps": info.get("step", 0),
+        "applied_batches": driver.outcomes.applied,
+        "duplicate_batches": driver.outcomes.duplicate,
+        "stale_batches": driver.outcomes.stale,
+        "partition_dropped_batches": driver.outcomes.partition_dropped,
+        "ingest_retries": driver.outcomes.retries,
+        "staleness_hist": {str(k): v for k, v in sorted(
+            driver.staleness_hist.items())},
+        "staleness_hist_since_restart": {str(k): v for k, v in sorted(
+            (info.get("staleness_hist") or {}).items())},
+        "weight_epoch": info.get("epoch", 0),
+        "broadcasts": driver.broadcasts,
+        "broadcast_failures": driver.broadcast_failures,
+        "fenced_updates": sum(s.get("fenced", 0) for s in fence_stats),
+        "replica_kills": replica_kills,
+        "learner_kills": learner_kill["kills"],
+        "learner_restarts": driver.learner_restarts,
+        "learner_step_at_kill": learner_kill["step_at_kill"],
+        "recovery_to_first_post_restart_step_s": (
+            None if learner_kill["recovery_s"] is None
+            else round(learner_kill["recovery_s"], 3)),
+        "recovery_budget_s": p.recovery_budget_s,
+        "partition": partition,
+        "sample_failures": driver.sample_failures,
+        "zero_hung": hung == 0,
+        "violations": violations,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return result
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    import ray_tpu
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--seed", type=int, default=None,
+                    help="chaos-schedule + fleet seed (default: "
+                         "RAY_TPU_FAULT_INJECTION_SEED or 0)")
+    ap.add_argument("--quick", action="store_true",
+                    help="short CI profile (~12 s, 2 replicas)")
+    ap.add_argument("--policy", choices=("mlp", "transformer"),
+                    default="mlp")
+    ap.add_argument("--json", default=DEFAULT_ARTIFACT,
+                    help=f"artifact path (default {DEFAULT_ARTIFACT})")
+    args = ap.parse_args(argv)
+
+    seed = (args.seed if args.seed is not None
+            else int(_os.environ.get("RAY_TPU_FAULT_INJECTION_SEED", "0")))
+    kw: Dict[str, Any] = dict(seed=seed, duration_s=args.duration,
+                              policy=args.policy)
+    if args.quick:
+        kw.update(QUICK_PROFILE)
+    profile = TrainStormProfile(**kw)
+
+    ray_tpu.init(num_cpus=max(8, profile.num_replicas + 4),
+                 resources={"TPU": 8})
+    try:
+        result = run_trainstorm(profile, out_path=args.json)
+    finally:
+        try:
+            from ray_tpu import serve
+
+            serve.shutdown()
+        finally:
+            ray_tpu.shutdown()
+
+    print(f"trainstorm[r{ROUND}] seed={result['seed']} "
+          f"policy={result['policy']} {result['duration_s']:.1f}s on "
+          f"{result['effective_cpus']} effective cpus")
+    print(f"  samples/s={result['samples_per_s']:.1f} "
+          f"learner_steps/s={result['learner_steps_per_s']:.2f} "
+          f"steps={result['learner_steps']} epoch={result['weight_epoch']}")
+    print(f"  chaos: replica_kills={result['replica_kills']} "
+          f"learner_kills={result['learner_kills']} "
+          f"partition={result['partition']['injected']}/"
+          f"{result['partition']['healed']} "
+          f"recovery={result['recovery_to_first_post_restart_step_s']}s")
+    print(f"  accounting: applied={result['applied_batches']} "
+          f"dup={result['duplicate_batches']} stale={result['stale_batches']} "
+          f"fenced={result['fenced_updates']} "
+          f"staleness_hist={result['staleness_hist']}")
+    print(f"  zero_hung={result['zero_hung']}")
+    if result["violations"]:
+        for v in result["violations"]:
+            print(f"  VIOLATION: {v}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

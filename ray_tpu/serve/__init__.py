@@ -5,6 +5,7 @@ from ray_tpu.serve.api import (
     status,
     delete,
     get_deployment_handle,
+    reconfigure,
     start_http_proxy,
     start_http_proxies_per_node,
     start_grpc_proxy,

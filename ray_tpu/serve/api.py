@@ -509,6 +509,39 @@ class ServeController:
         self._reconcile_one(name)
         return True
 
+    def reconfigure_deployment(self, name: str, user_config: Any) -> bool:
+        """Lightweight update: push a new user_config into every live
+        replica (and the in-flight rolling candidate) IN PLACE — no def_blob
+        re-ship, no version bump, no rolling restart.  This is the weight
+        broadcast path the RL fleet rides: the learner publishes
+        {weights, epoch} here and each replica's reconfigure() applies (or
+        epoch-fences) it.  Unlike the deploy() fallback, a replica failure
+        here does NOT trigger a rolling redeploy — the caller owns retry
+        policy — but the accepted config is recorded so reconcile hands it
+        to any replacement replicas it starts later.
+        """
+        existing = self._deployments.get(name)
+        if existing is None:
+            raise KeyError(f"no deployment named {name!r}")
+        targets = list(self._replicas.get(name, []))
+        if existing.get("_rolling") is not None:
+            targets.append(existing["_rolling"][0])
+        # Record first: a replica that dies mid-push gets replaced by the
+        # reconcile loop, and the replacement must init with the NEW config
+        # (otherwise a crash window could resurrect fenced-out weights).
+        existing["user_config"] = user_config
+        self._checkpoint()
+        errors = 0
+        for r in targets:
+            try:
+                ray_tpu.get(r.reconfigure.remote(user_config), timeout=30)
+            except Exception:
+                errors += 1
+                logger.warning("reconfigure push to a %s replica lost "
+                               "(replica will pick config up on replace)",
+                               name, exc_info=True)
+        return errors == 0
+
     def delete_deployment(self, name: str):
         d = self._deployments.pop(name, None)
         self._probes.pop(name, None)
@@ -1572,6 +1605,16 @@ def delete(name: str) -> bool:
 
 def get_deployment_handle(name: str) -> DeploymentHandle:
     return _cached_handle(name)
+
+
+def reconfigure(name: str, user_config: Any) -> bool:
+    """Push a new user_config to a live deployment in place (lightweight
+    update: no rolling restart).  Returns True if every live replica
+    acknowledged; False if some pushes were lost (stragglers converge when
+    the reconcile loop replaces them).  Raises KeyError for unknown names."""
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return ray_tpu.get(
+        controller.reconfigure_deployment.remote(name, user_config))
 
 
 def shutdown() -> None:

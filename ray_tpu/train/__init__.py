@@ -1,7 +1,10 @@
 from ray_tpu.train.step import TrainState, make_train_step, make_init_fn, batch_sharding
 from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
-from ray_tpu.train.checkpointing import abstract_like, restore_sharded, save_sharded
+from ray_tpu.train.checkpointing import (abstract_like, gc_checkpoints,
+                                         latest_checkpoint, load_checkpoint,
+                                         restore_sharded, save_checkpoint,
+                                         save_sharded)
 from ray_tpu.train.sklearn import SklearnPredictor, SklearnTrainer
 from ray_tpu.train.huggingface import TransformersTrainer
 from ray_tpu.train.gbdt import (GBDTPredictor, GBDTTrainer, LightGBMTrainer,

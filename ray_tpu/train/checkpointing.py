@@ -11,9 +11,12 @@ mesh -> restore -> continue).
 
 from __future__ import annotations
 
+import json
 import logging
 import os
-from typing import Any, Optional
+import re
+import shutil
+from typing import Any, Optional, Tuple
 
 import jax
 
@@ -75,3 +78,110 @@ def abstract_like(state: Any, shardings: Optional[Any] = None) -> Any:
     return jax.tree_util.tree_map(
         lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
         state, shardings)
+
+
+# ---------------------------------------------------------------------------
+# Step-numbered checkpoint directories with atomic completion, a
+# latest-complete pointer, and keep-last-K retention.  This is the restart
+# contract the RL fleet learner (rllib/fleet.py) builds on: a crash between
+# "orbax finished writing" and "rename landed" leaves only a torn .tmp-*
+# directory that latest_checkpoint() never resolves, so restart always
+# resumes from a checkpoint whose state AND meta are both fully on disk.
+# ---------------------------------------------------------------------------
+
+_STEP_DIR_RE = re.compile(r"^step_(\d+)$")
+_TMP_PREFIX = ".tmp-"
+_META_NAME = "meta.json"
+_STATE_NAME = "state"
+
+
+def checkpoint_path(root: str, step: int) -> str:
+    return os.path.join(os.path.abspath(root), f"step_{step}")
+
+
+def save_checkpoint(state: Any, root: str, step: int,
+                    meta: Optional[dict] = None) -> str:
+    """Atomically save `state` (+ JSON-serializable `meta`) as step `step`.
+
+    Everything is written under a hidden `.tmp-step_N-<pid>` staging dir
+    first; the final `os.replace` onto `step_N` is the commit point.  A
+    directory named `step_N` therefore always holds a complete save.
+    """
+    root = os.path.abspath(root)
+    os.makedirs(root, exist_ok=True)
+    final = checkpoint_path(root, step)
+    tmp = os.path.join(root, f"{_TMP_PREFIX}step_{step}-{os.getpid()}")
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    try:
+        save_sharded(state, os.path.join(tmp, _STATE_NAME))
+        with open(os.path.join(tmp, _META_NAME), "w") as f:
+            json.dump({"step": step, **(meta or {})}, f)
+        if os.path.exists(final):  # e.g. re-save after a rolled-back restart
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return final
+
+
+def _complete_steps(root: str) -> list:
+    """(step, path) for every COMPLETE checkpoint under root, ascending."""
+    root = os.path.abspath(root)
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        m = _STEP_DIR_RE.match(name)
+        if not m:
+            continue
+        path = os.path.join(root, name)
+        # The rename is the commit point, but guard against a partially
+        # rm'd directory anyway: meta.json + state dir must both exist.
+        if (os.path.isfile(os.path.join(path, _META_NAME))
+                and os.path.isdir(os.path.join(path, _STATE_NAME))):
+            out.append((int(m.group(1)), path))
+    out.sort()
+    return out
+
+
+def latest_checkpoint(root: str) -> Optional[str]:
+    """Path of the newest *complete* checkpoint under `root`, or None.
+
+    In-progress / torn `.tmp-*` staging dirs and step dirs missing their
+    meta or state are ignored — this is what the learner restart path
+    resolves, so a crash mid-save can never be resumed from.
+    """
+    steps = _complete_steps(root)
+    return steps[-1][1] if steps else None
+
+
+def load_checkpoint(path: str, target: Any) -> Tuple[Any, dict]:
+    """Restore (state, meta) from a complete checkpoint directory."""
+    with open(os.path.join(path, _META_NAME)) as f:
+        meta = json.load(f)
+    state = restore_sharded(os.path.join(path, _STATE_NAME), target)
+    return state, meta
+
+
+def gc_checkpoints(root: str, keep: int) -> list:
+    """Keep the newest `keep` complete checkpoints; delete the rest plus
+    any torn `.tmp-*` staging dirs.  Returns the deleted paths."""
+    root = os.path.abspath(root)
+    deleted = []
+    steps = _complete_steps(root)
+    for _, path in steps[:-keep] if keep > 0 else steps:
+        shutil.rmtree(path, ignore_errors=True)
+        deleted.append(path)
+    if os.path.isdir(root):
+        for name in os.listdir(root):
+            if name.startswith(_TMP_PREFIX):
+                path = os.path.join(root, name)
+                shutil.rmtree(path, ignore_errors=True)
+                deleted.append(path)
+    if deleted:
+        logger.info("checkpoint GC removed %d dirs under %s",
+                    len(deleted), root)
+    return deleted

@@ -1,0 +1,40 @@
+"""Version bridges over jax API drift.
+
+The repo targets the modern top-level `jax.shard_map(f, mesh=..., in_specs=...,
+out_specs=..., check_vma=..., axis_names=...)`.  Older jax (< 0.5) only ships
+`jax.experimental.shard_map.shard_map`, with two renamed knobs:
+
+  - ``check_vma``  -> ``check_rep`` (same meaning: verify per-axis
+    replication/varying-mesh-axes annotations)
+  - ``axis_names`` (the axes that ARE manual) -> ``auto`` (the axes that are
+    NOT manual) — inverse sense, so we complement against the mesh's axes.
+
+Call sites import :func:`shard_map` from here and always use the modern
+keyword spelling; the shim forwards to whichever implementation exists.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Set
+
+import jax
+
+
+def shard_map(f: Callable, *, mesh: Any, in_specs: Any, out_specs: Any,
+              check_vma: bool = True,
+              axis_names: Optional[Set[str]] = None) -> Callable:
+    """`jax.shard_map` when available, else the experimental equivalent."""
+    if hasattr(jax, "shard_map"):
+        kwargs = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma,
+                             **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    auto: frozenset = frozenset()
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _shard_map(f, mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma, auto=auto)

@@ -44,3 +44,43 @@ def test_restore_onto_reshaped_mesh(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     # the restored embed really lives on the new mesh's sharding
     assert restored["embed"].sharding.mesh.shape["fsdp"] == 4
+
+
+def test_step_checkpoints_latest_and_retention(tmp_path):
+    """Step-addressed checkpoints (train.checkpointing.save_checkpoint):
+    latest_checkpoint resolves only COMPLETE saves, torn staging dirs and
+    bare step dirs are invisible, and gc keeps the newest K."""
+    import json
+    import os
+
+    from ray_tpu.train import (gc_checkpoints, latest_checkpoint,
+                               load_checkpoint, save_checkpoint)
+
+    root = str(tmp_path / "run")
+    assert latest_checkpoint(root) is None  # empty / missing root
+    state = {"w": np.arange(8, dtype=np.float32)}
+    for step in (2, 4, 6):
+        save_checkpoint(state, root, step, meta={"epoch": step * 10})
+    # a torn save: staging dir left behind by a crash mid-write
+    os.makedirs(os.path.join(root, ".tmp-step_8-123"))
+    # an incomplete final dir (no meta.json commit marker)
+    os.makedirs(os.path.join(root, "step_9", "state"))
+
+    latest = latest_checkpoint(root)
+    assert latest is not None and latest.endswith("step_6")
+    restored, meta = load_checkpoint(latest, abstract_like(state))
+    np.testing.assert_array_equal(np.asarray(restored["w"]), state["w"])
+    assert meta["step"] == 6 and meta["epoch"] == 60
+
+    deleted = gc_checkpoints(root, keep=2)
+    kept = sorted(d for d in os.listdir(root) if d.startswith("step_"))
+    # GC only reasons about COMPLETE checkpoints: step_2 (oldest complete)
+    # goes, step_4/step_6 stay, the incomplete step_9 is not its business
+    assert kept == ["step_4", "step_6", "step_9"]
+    assert any(p.endswith("step_2") for p in deleted)
+    assert not any(d.startswith(".tmp-") for d in os.listdir(root))
+    # the incomplete dir still never resolves as latest
+    assert latest_checkpoint(root).endswith("step_6")
+    # meta survives on disk as plain json (inspectable artifacts)
+    with open(os.path.join(root, "step_6", "meta.json")) as f:
+        assert json.load(f)["epoch"] == 60

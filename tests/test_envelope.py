@@ -79,6 +79,19 @@ _R16 = {
 _R16_DECODE_VS_RAW_KERNEL = 0.35
 _R16_PREFILL_VS_SINGLE = 0.6
 
+# TRAINSTORM_r17.json floors (PR 17, RL fleet rollout->learner loop). The
+# artifact is measured UNDER CHAOS (serve replicas + named learner actor +
+# object-plane hops + seeded kills/partition on however few cores CI has),
+# while the re-measured quick loop below is the same sample->ingest path
+# in-process — far faster. So the 0.5x-artifact term is the binding floor
+# on the calibration box and the raw-probe ratios keep a slower machine
+# judged against its own silicon: a loop step is one rollout (raw env-
+# stepping probe) plus one PPO minibatch update (raw update probe)
+# serialized, so its steps/s can't honestly fall below ~0.2x the raw
+# update rate unless the path regrew per-step compiles or batch copies.
+_R17_SAMPLES_VS_RAW_ENV = 0.10
+_R17_STEPS_VS_RAW_UPDATE = 0.20
+
 
 def _memcpy_bytes_per_s() -> float:
     """This machine's large-copy bandwidth (the unit the byte-rate floors
@@ -252,3 +265,72 @@ def test_servebench_regression_floors():
         f"{_R16['prefill_tokens_per_s']} and {_R16_PREFILL_VS_SINGLE}x "
         f"this box's single-prompt rate {single_tok_per_s:.1f} tok/s): "
         f"batched bucketed admission has collapsed")
+
+
+def test_trainstorm_regression_floors():
+    """TRAINSTORM_r17.json regression floors (PR 17). Re-measures the RL
+    fleet's sample->ingest loop in-process at a quick profile and pins
+    samples/s + learner steps/s at min(0.5x the committed under-chaos
+    artifact, ratio x same-box raw probes), r14/r16 discipline."""
+    import json
+    import os
+    import time
+    from dataclasses import asdict
+
+    from ray_tpu.rllib.fleet import FleetConfig, FleetLearnerImpl, _MlpRollouts
+    from ray_tpu.rllib.ppo import PPOLearner
+
+    art_path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "TRAINSTORM_r17.json")
+    art = json.load(open(art_path))  # committed artifact IS the floor source
+
+    cfg = FleetConfig(num_envs=2, rollout_len=32, checkpoint_every=0, seed=0)
+    rolls = _MlpRollouts(cfg, seed=0)
+    rolls.set_weights(PPOLearner(4, 2, lr=cfg.lr, seed=0).get_weights())
+    learner = FleetLearnerImpl(asdict(cfg), "/tmp/_r17_floor_unused")
+
+    # raw probes: this box's env-stepping and PPO-update ceilings
+    rolls.sample(32)  # warm
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < 0.8:
+        rolls.sample(32)
+        n += 32 * cfg.num_envs
+    raw_env_steps_per_s = n / (time.perf_counter() - t0)
+    batch = rolls.sample(32)
+    learner.ingest("warm", 0, batch)  # compile
+    t0 = time.perf_counter()
+    k = 0
+    while time.perf_counter() - t0 < 0.8:
+        learner.ingest(f"probe-{k}", 0, batch)
+        k += 1
+    raw_updates_per_s = k / (time.perf_counter() - t0)
+
+    # the loop under measurement: rollout -> exactly-once ingest, serialized
+    t0 = time.perf_counter()
+    env_steps = steps = 0
+    while time.perf_counter() - t0 < 1.2:
+        b = rolls.sample(32)
+        assert learner.ingest(f"loop-{steps}", 0, b)["applied"]
+        env_steps += 32 * cfg.num_envs
+        steps += 1
+    dt = time.perf_counter() - t0
+    samples_per_s = env_steps / dt
+    steps_per_s = steps / dt
+
+    floor = min(_SLACK * art["samples_per_s"],
+                _R17_SAMPLES_VS_RAW_ENV * raw_env_steps_per_s)
+    assert samples_per_s >= floor, (
+        f"fleet samples/s {samples_per_s:.1f} fell below the r17 floor "
+        f"{floor:.1f} (min of {_SLACK}x artifact {art['samples_per_s']} and "
+        f"{_R17_SAMPLES_VS_RAW_ENV}x this box's raw env-stepping rate "
+        f"{raw_env_steps_per_s:.1f}/s): the rollout->ingest path is paying "
+        f"per-round costs the fleet loop never had")
+    floor = min(_SLACK * art["learner_steps_per_s"],
+                _R17_STEPS_VS_RAW_UPDATE * raw_updates_per_s)
+    assert steps_per_s >= floor, (
+        f"fleet learner steps/s {steps_per_s:.2f} fell below the r17 floor "
+        f"{floor:.2f} (min of {_SLACK}x artifact "
+        f"{art['learner_steps_per_s']} and {_R17_STEPS_VS_RAW_UPDATE}x this "
+        f"box's raw update rate {raw_updates_per_s:.2f}/s): the ingest path "
+        f"regrew per-step compiles or batch copies")

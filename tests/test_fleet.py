@@ -1,0 +1,143 @@
+"""RL fleet (rllib/fleet.py): weight-epoch fencing on the serve lightweight-
+update path, exactly-once ingest accounting across learner crash-restart, and
+staleness gating. The full chaos composition lives in
+`python -m ray_tpu.rllib.trainstorm`; these tests pin the invariants it
+leans on."""
+
+from dataclasses import asdict
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.rllib.fleet import (FleetConfig, FleetLearnerImpl, _MlpRollouts,
+                                 rollout_deployment)
+
+
+def _small_cfg(**kw):
+    base = dict(num_replicas=1, num_envs=1, rollout_len=8, max_staleness=1,
+                checkpoint_every=2, keep_checkpoints=2, sgd_epochs=1,
+                minibatch_size=8, seed=0)
+    base.update(kw)
+    return FleetConfig(**base)
+
+
+def _batch(cfg, seed=0):
+    from ray_tpu.rllib.ppo import PPOLearner
+
+    rolls = _MlpRollouts(cfg, seed=seed)
+    rolls.set_weights(PPOLearner(4, 2, lr=cfg.lr, seed=cfg.seed).get_weights())
+    return rolls.sample(cfg.rollout_len)
+
+
+@pytest.fixture
+def serve_cluster(ray_start_regular):
+    yield ray_start_regular
+    serve.shutdown()
+
+
+def test_exactly_once_across_learner_restart(tmp_path):
+    """A learner crash between checkpoints must neither double-apply a
+    checkpointed batch nor lose a post-checkpoint one: restart restores the
+    applied-id set from the latest complete save, the replayed batch that
+    WAS checkpointed dedupes, and the rolled-back one re-applies."""
+    cfg = _small_cfg(checkpoint_every=2)
+    root = str(tmp_path / "ckpt")
+    learner = FleetLearnerImpl(asdict(cfg), root)
+    batch = _batch(cfg)
+    r_a = learner.ingest("rid-a", 0, batch)
+    r_b = learner.ingest("rid-b", 0, batch)
+    r_c = learner.ingest("rid-c", 0, batch)
+    assert r_a["applied"] and r_b["applied"] and r_c["applied"]
+    assert r_b["checkpoint"] is not None, "step 2 should have checkpointed"
+    assert r_c["checkpoint"] is None, "step 3 is past the checkpoint"
+
+    # crash: the in-memory learner is gone; a replacement restores from disk
+    reborn = FleetLearnerImpl(asdict(cfg), root)
+    info = reborn.info()
+    assert info["step"] == 2, "restore must come from the step-2 checkpoint"
+    assert info["applied"] == 2
+
+    # the checkpointed batch replayed by the driver -> exactly-once dedupe
+    replay_b = reborn.ingest("rid-b", 0, batch)
+    assert not replay_b["applied"] and replay_b["reason"] == "duplicate"
+    assert reborn.info()["step"] == 2, "duplicate must not advance the step"
+    # the batch the crash rolled back is NOT a duplicate: it re-applies
+    replay_c = reborn.ingest("rid-c", 0, batch)
+    assert replay_c["applied"] and replay_c["step"] == 3
+
+
+def test_restart_epoch_never_regresses_below_broadcast(tmp_path):
+    """A broadcast can outrun the last checkpoint. The driver passes the
+    highest epoch it ever published so the reborn learner's next
+    advance_epoch() is not one the replicas would fence forever."""
+    cfg = _small_cfg()
+    root = str(tmp_path / "ckpt")
+    learner = FleetLearnerImpl(asdict(cfg), root)
+    for _ in range(3):
+        payload = learner.advance_epoch()
+    assert payload["epoch"] == 3
+    reborn = FleetLearnerImpl(asdict(cfg), root, min_epoch=3)
+    assert reborn.advance_epoch()["epoch"] == 4
+
+
+def test_stale_batch_dropped_and_histogrammed(tmp_path):
+    cfg = _small_cfg(max_staleness=1)
+    learner = FleetLearnerImpl(asdict(cfg), str(tmp_path / "ckpt"))
+    for _ in range(3):
+        learner.advance_epoch()          # learner is at epoch 3
+    batch = _batch(cfg)
+    old = learner.ingest("rid-old", 0, batch)    # lag 3 > max_staleness
+    assert not old["applied"] and old["reason"] == "stale" and old["lag"] == 3
+    ok = learner.ingest("rid-ok", 2, batch)      # lag 1 <= max_staleness
+    assert ok["applied"] and ok["lag"] == 1
+    info = learner.info()
+    assert info["dropped_stale"] == 1
+    assert info["staleness_hist"] == {3: 1, 1: 1}
+
+
+def test_replica_epoch_fencing_over_serve(serve_cluster, tmp_path):
+    """Weight delivery rides serve's lightweight-update path; a replica must
+    fence an out-of-order epoch (rolling update replaying an older config)
+    without tripping the controller's redeploy fallback."""
+    cfg = _small_cfg(deployment_name="fleet_fence_test")
+    handle = serve.run(rollout_deployment(cfg).bind(asdict(cfg)),
+                       name="fleet_fence_app")
+    sampler = handle.options(method_name="sample", timeout_s=30.0)
+    stats = handle.options(method_name="fence_stats", timeout_s=30.0)
+
+    # before any broadcast a replica refuses to sample with unset weights
+    env = ray_tpu.get(sampler.remote(), timeout=60)
+    assert env["rollout_id"] is None and env["weight_epoch"] == -1
+
+    learner = FleetLearnerImpl(asdict(cfg), str(tmp_path / "ckpt"))
+    w1 = learner.advance_epoch()                       # epoch 1
+    assert serve.reconfigure(cfg.deployment_name, w1)
+    w3 = {"epoch": 3, "weights": w1["weights"]}        # a later push
+    assert serve.reconfigure(cfg.deployment_name, w3)
+    # stale replay: epoch 2 arrives after epoch 3 was applied -> fenced
+    w2 = {"epoch": 2, "weights": w1["weights"]}
+    serve.reconfigure(cfg.deployment_name, w2)
+
+    st = ray_tpu.get(stats.remote(), timeout=60)
+    assert st["epoch"] == 3, "fenced update must not regress the epoch"
+    assert st["fenced"] >= 1
+    assert st["applied_updates"] == 2
+
+    # envelopes stamp the generation epoch and ship the batch by ref
+    # through the object plane, not the serve response path
+    env = ray_tpu.get(sampler.remote(), timeout=60)
+    assert env["weight_epoch"] == 3 and env["rollout_id"]
+    batch = ray_tpu.get(env["ref"], timeout=60)
+    assert batch["obs"].shape[0] == cfg.rollout_len
+    assert env["num_env_steps"] == cfg.rollout_len * cfg.num_envs
+
+
+def test_fleet_config_from_env(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_FLEET_MAX_STALENESS", "5")
+    monkeypatch.setenv("RAY_TPU_FLEET_POLICY", "transformer")
+    cfg = FleetConfig.from_env(num_replicas=3)
+    assert cfg.max_staleness == 5
+    assert cfg.policy == "transformer"
+    assert cfg.num_replicas == 3

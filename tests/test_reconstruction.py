@@ -117,6 +117,74 @@ def test_reconstruction_survives_repeat_gets(head_and_worker_cluster):
     os.unlink(marker)
 
 
+def test_reconstruction_composed_with_partition_heal(head_and_worker_cluster):
+    """Lineage reconstruction composed with partition injection (the PR-13
+    failure domain meeting the recovery path): the owner loses the primary
+    copy, and while the replacement work node is blackholed from the
+    head/store side a consumer get()s the freed object. The in-flight get
+    must neither crash nor hang unbounded: reconstruction is submitted,
+    parks until the partition heals, then completes — with total executions
+    bounded by lineage_reconstruction_max_retries."""
+    import threading
+
+    from ray_tpu.core import rpc
+    from ray_tpu.core.config import get_config
+
+    cluster, head, work = head_and_worker_cluster
+    marker = _counter_file()
+
+    @ray_tpu.remote(resources={"work": 1})
+    def produce(path):
+        with open(path, "a") as f:
+            f.write("ran\n")
+        return np.full(1 << 17, 3.0)
+
+    ref = produce.remote(marker)
+    ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=60)
+    assert ready
+    # Lose the primary copy; the replacement node is where the re-executed
+    # task MUST land — and it is about to be partitioned away.
+    cluster.remove_node(work)
+    replacement = cluster.add_node(num_cpus=2, resources={"work": 2})
+    inj = rpc.install_fault_injector("", seed=11)
+    inj.define_group("ownerside", {cluster.head.address,
+                                   cluster.gcs_address, "store"})
+    inj.define_group("island", {replacement.address})
+    inj.partition("ownerside", "island")
+    try:
+        result: dict = {}
+
+        def consume():
+            try:
+                result["value"] = ray_tpu.get(ref, timeout=120)
+            except BaseException as e:
+                result["error"] = e
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        # the get is in flight THROUGH the blackhole window: reconstruction
+        # was requested but cannot complete while the island is dark
+        time.sleep(3.0)
+        assert "error" not in result, (
+            f"get() died during the partition: {result.get('error')!r}")
+        assert "value" not in result, (
+            "reconstruction completed THROUGH the blackhole — partition "
+            "is not actually severing the island")
+        inj.heal()
+        t.join(timeout=120)
+        assert not t.is_alive(), "get() hung after the partition healed"
+        assert "error" not in result, repr(result.get("error"))
+        assert float(result["value"][0]) == 3.0
+        with open(marker) as f:
+            runs = f.read().count("ran")
+        max_retries = get_config().lineage_reconstruction_max_retries
+        assert 2 <= runs <= 1 + max_retries, (
+            f"{runs} executions vs bound 1+{max_retries}")
+    finally:
+        rpc.clear_fault_injector()
+        os.unlink(marker)
+
+
 def test_copy_failover_avoids_reexecution():
     """Pulled copies register with the owner (multi-location directory):
     when the primary's node dies but a pulled copy survives elsewhere, gets

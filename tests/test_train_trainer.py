@@ -149,6 +149,11 @@ def test_torch_trainer_ddp_gloo(ray_start_regular):
     assert abs(result.metrics["weight0"] - 1.0) < 0.2
 
 
+@pytest.mark.skipif(
+    not hasattr(__import__("jax"), "shard_map"),
+    reason="jax.distributed multiprocess worlds are unimplemented on the "
+           "CPU backend of jax<0.5 ('Multiprocess computations aren't "
+           "implemented on the CPU backend')")
 def test_jax_distributed_worker_group(ray_start_regular):
     """Two worker actors form one jax.distributed world through the KV
     rendezvous: global device count spans both processes and a psum over a
