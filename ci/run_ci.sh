@@ -14,6 +14,7 @@
 #   ci/run_ci.sh --partition  # partition-heal storm only
 #   ci/run_ci.sh --servebench # serving decode/prefill perf smoke only
 #   ci/run_ci.sh --trainstorm # RL fleet chaos (rollout->learner loop) only
+#   ci/run_ci.sh --memstorm   # store storm (storage failure domain) only
 #
 # Stages:
 #   1. native      : arena + scheduler + token-loader compiled whole-program
@@ -72,13 +73,22 @@
 #                    any hung future, a chaos mode that never landed, a
 #                    blown recovery budget, or a missing artifact row
 #                    (throughput FLOORS live in tests/test_envelope.py).
+#  12. memstorm    : store storm (quick profile): the object store driven to
+#                    2-4x capacity under composed storage chaos — seeded
+#                    ENOSPC/EIO/torn/bitflip spill faults, a disk-full
+#                    degrade->probe->heal cycle, pin-cap pressure, OOM
+#                    kills composed with spilling. Exits nonzero on any
+#                    hung get, any silent corruption (end-to-end checksums
+#                    over every surviving ref), untyped backpressure, or
+#                    failed post-heal convergence (restore-bandwidth FLOOR
+#                    lives in tests/test_envelope.py).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGE="${1:-all}"
 
 run_native() {
-  echo "=== [1/11] native modules under ASan/UBSan ==="
+  echo "=== [1/12] native modules under ASan/UBSan ==="
   mkdir -p build
   g++ -std=c++17 -O1 -g -fsanitize=address,undefined \
       -fno-omit-frame-pointer -o build/sanitize_native \
@@ -90,7 +100,7 @@ run_native() {
 }
 
 run_fast() {
-  echo "=== [2/11] fast test tier ==="
+  echo "=== [2/12] fast test tier ==="
   python -m pytest tests/ -q
   # core-primitives smoke: the submission AND completion hot paths
   # (function table, event batching, batched result delivery, put/get)
@@ -117,7 +127,7 @@ EOF
 }
 
 run_stress() {
-  echo "=== [3/11] actor ordering stress x20 ==="
+  echo "=== [3/12] actor ordering stress x20 ==="
   for i in $(seq 1 20); do
     python -m pytest tests/test_actor_ordering_stress.py -q -x \
       || { echo "ordering stress failed on iteration $i"; exit 1; }
@@ -125,7 +135,7 @@ run_stress() {
 }
 
 run_chaos() {
-  echo "=== [4/11] control-plane HA chaos suite ==="
+  echo "=== [4/12] control-plane HA chaos suite ==="
   # Deterministic fault injection: pin + print the seed so a red run
   # replays the same chaos schedule (override by exporting the variable;
   # timing-dependent counters can still drift between runs).
@@ -142,7 +152,7 @@ run_chaos() {
 }
 
 run_serve_storm() {
-  echo "=== [5/11] serve traffic-storm chaos ==="
+  echo "=== [5/12] serve traffic-storm chaos ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -158,7 +168,7 @@ run_serve_storm() {
 }
 
 run_burst() {
-  echo "=== [6/11] warm-pool elasticity burst ==="
+  echo "=== [6/12] warm-pool elasticity burst ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "burst seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -183,7 +193,7 @@ run_burst() {
 }
 
 run_head_failover() {
-  echo "=== [7/11] standby-head kill-and-promote storm ==="
+  echo "=== [7/12] standby-head kill-and-promote storm ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -202,7 +212,7 @@ run_head_failover() {
 }
 
 run_node_chaos() {
-  echo "=== [8/11] multi-node kill storm (node failure domain) ==="
+  echo "=== [8/12] multi-node kill storm (node failure domain) ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "node storm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -222,7 +232,7 @@ run_node_chaos() {
 }
 
 run_partition_storm() {
-  echo "=== [9/11] partition-heal storm (partition failure domain) ==="
+  echo "=== [9/12] partition-heal storm (partition failure domain) ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "partition storm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -244,7 +254,7 @@ run_partition_storm() {
 }
 
 run_servebench() {
-  echo "=== [10/11] serving perf smoke (servebench quick) ==="
+  echo "=== [10/12] serving perf smoke (servebench quick) ==="
   # Quick profile of python -m ray_tpu.models.servebench: fused-decode
   # tokens/s + the 1/4/8 slot sweep table, w8a16 logits-parity row,
   # batched bucketed prefill, and p50/p99 request latency under the storm
@@ -258,7 +268,7 @@ run_servebench() {
 }
 
 run_trainstorm() {
-  echo "=== [11/11] RL fleet chaos (trainstorm quick) ==="
+  echo "=== [11/12] RL fleet chaos (trainstorm quick) ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "trainstorm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -288,6 +298,41 @@ EOF
   rm -f "$ts_json"
 }
 
+run_memstorm() {
+  echo "=== [12/12] store storm (storage failure domain, memstorm quick) ==="
+  : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
+  export RAY_TPU_FAULT_INJECTION_SEED
+  echo "memstorm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
+  # --quick: the object store driven to ~2.5x capacity by producer waves
+  # while seeded fs faults land on the spill path (enospc/eio/torn/
+  # bitflip), a disk-full degrade->probe->heal cycle runs, pins push past
+  # the pin cap, and the memory monitor OOM-kills producers mid-spill.
+  # Every surviving ref is re-read and checksummed end to end; the
+  # harness exits nonzero on any hung get, silent corruption, untyped
+  # backpressure, or failed post-heal convergence.
+  ms_json="$(mktemp /tmp/ray_tpu_memstorm_ci.XXXXXX.json)"
+  timeout -k 10 450 env JAX_PLATFORMS=cpu python -m ray_tpu.core.memstorm \
+    --quick --seed "${RAY_TPU_FAULT_INJECTION_SEED}" --json "$ms_json" \
+    || { echo "store storm failed (seed ${RAY_TPU_FAULT_INJECTION_SEED})"
+         exit 1; }
+  MS_JSON="$ms_json" python - <<'EOF'
+import json, os
+art = json.load(open(os.environ["MS_JSON"]))
+need = {"ok", "zero_hung", "zero_silent_corruption", "spill_restore_gbps",
+        "counters", "phases", "violations"}
+missing = need - set(art)
+assert not missing, f"memstorm artifact missing rows: {missing}"
+assert art["ok"] and art["zero_hung"] and art["zero_silent_corruption"], \
+    f"memstorm contract violated: {art['violations']}"
+c = art["counters"]
+for axis in ("spilled_bytes_total", "restored_bytes_total", "lost_spills",
+             "degraded_enters", "degraded_heals", "puts_rejected_typed"):
+    assert c.get(axis, 0) > 0, f"memstorm chaos axis never fired: {axis}"
+print("memstorm artifact rows ok:", ", ".join(sorted(need)))
+EOF
+  rm -f "$ms_json"
+}
+
 case "$STAGE" in
   --native)     run_native ;;
   --fast)       run_fast ;;
@@ -300,11 +345,13 @@ case "$STAGE" in
   --partition)  run_partition_storm ;;
   --servebench) run_servebench ;;
   --trainstorm) run_trainstorm ;;
+  --memstorm)   run_memstorm ;;
   all)        run_native; run_fast; run_stress; run_chaos; run_serve_storm
               run_burst; run_head_failover; run_node_chaos
-              run_partition_storm; run_servebench; run_trainstorm ;;
+              run_partition_storm; run_servebench; run_trainstorm
+              run_memstorm ;;
   *) echo "unknown stage: $STAGE" \
-     "(use --native|--fast|--stress|--chaos|--storm|--burst|--failover|--node-chaos|--partition|--servebench|--trainstorm)" >&2
+     "(use --native|--fast|--stress|--chaos|--storm|--burst|--failover|--node-chaos|--partition|--servebench|--trainstorm|--memstorm)" >&2
      exit 2 ;;
 esac
 echo "CI green"

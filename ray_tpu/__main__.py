@@ -346,7 +346,21 @@ def main(argv=None) -> int:
                     st["node_id"] = st["node_id"].hex()
                     out.append(st)
                     c.close()
-                print(json.dumps(out, indent=2))
+                # cluster storage roll-up (same block gcs_stats aggregates
+                # from heartbeats, but computed live from the nodes here)
+                storage = {
+                    "used_bytes": sum(s.get("used_bytes", 0) for s in out),
+                    "capacity_bytes": sum(s.get("capacity_bytes", 0)
+                                          for s in out),
+                    "pinned_bytes": sum(s.get("pinned_bytes", 0)
+                                        for s in out),
+                    "spilled_bytes": sum(s.get("spilled_bytes", 0)
+                                         for s in out),
+                    "nodes_spill_degraded": [
+                        s["node_id"] for s in out if s.get("spill_degraded")],
+                }
+                print(json.dumps({"storage": storage, "nodes": out},
+                                 indent=2))
                 return 0
             if args.cmd == "profile":
                 import time as _time

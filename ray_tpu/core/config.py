@@ -47,6 +47,27 @@ class Config:
     # put ALREADY-FAULTED tmpfs pages (~4-5x the fresh-page write path).
     # Drained first under memory pressure; 0 disables recycling.
     object_segment_pool_bytes: int = 256 * 1024 * 1024
+    # --- storage failure domain (checksummed spills, disk-full ladder,
+    # store-full admission; cf. reference ObjectStoreFullError +
+    # local_object_manager.h spill IO workers) ---
+    # ":"-separated fallback spill directories. A spill write that fails
+    # with ENOSPC/EIO retries down this list under backoff; empty = the
+    # per-pid session spill dir only.
+    object_spill_dirs: str = ""
+    # per-directory write retries (with backoff) before the next dir
+    spill_write_retries: int = 2
+    spill_retry_backoff_ms: int = 50
+    # once EVERY spill dir has failed the store goes spill-degraded: it
+    # stops spilling (puts flip to backpressure) and probes the dirs at
+    # this period until one heals. 0 disables the self-heal probe.
+    spill_degraded_probe_period_s: float = 2.0
+    # put()/obj_create block at most this long for eviction/unpin headroom
+    # before failing with typed ObjectStoreFullError
+    put_full_timeout_s: float = 10.0
+    # reader pins may hold at most this fraction of capacity: the first
+    # pin that would cross it is refused (readers fall back to a bounded
+    # copy window), so pinned entries can never wedge eviction entirely
+    max_pinned_fraction: float = 0.75
 
     # --- health / heartbeats (cf. gcs_health_check_manager.h) ---
     health_check_period_ms: int = 1000
@@ -224,6 +245,9 @@ class Config:
     #   delay:<method>:<ms>[:<prob>]    stall before send
     #   sever_once:<method>             cut the connection at first match
     #   sever:<method>[:<prob>]         cut the connection per match
+    #   fs:<site>:<mode>[:<prob>]       filesystem fault at a named site
+    #                                   (spill_write, spill_restore; modes
+    #                                   enospc, eio, torn, bitflip)
     # <method> may be "*". Empty = injection disabled (zero overhead).
     fault_injection_spec: str = ""
     # seeds the injector's RNG so probabilistic faults replay exactly

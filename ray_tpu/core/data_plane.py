@@ -177,11 +177,18 @@ class DataPlaneServer:
     def _serve_push(self, conn: socket.socket, oid: ObjectID,
                     size: int) -> None:
         """Receive a source-initiated copy straight into a new segment."""
+        from ray_tpu.core.config import get_config
+
         if self._store.contains(oid):
             conn.sendall(_REP.pack(SKIP, 0))
             return
         try:
-            shm = self._store.create(oid, size)
+            # bounded wait for eviction/unpin headroom (own thread per
+            # connection — blocking here stalls only this push); a store
+            # still full after the window replies MISSING and the source
+            # falls back / retries
+            shm = self._store.create_blocking(
+                oid, size, min(get_config().put_full_timeout_s, 5.0))
         except FileExistsError:
             conn.sendall(_REP.pack(SKIP, 0))
             return

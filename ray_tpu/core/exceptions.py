@@ -65,6 +65,16 @@ class ObjectLostError(RayTpuError):
     """An object was lost (e.g. node died) and could not be reconstructed."""
 
 
+class ObjectStoreFullError(RayTpuError):
+    """The local object store could not admit an object: eviction, spilling
+    and pin release freed no headroom within `put_full_timeout_s` (or the
+    store is spill-degraded — every configured spill dir is failing — and
+    puts are flipped to backpressure). A typed, bounded outcome instead of
+    silent overcommit past capacity (reference ObjectStoreFullError in
+    ray.exceptions + plasma's PlasmaStoreFull). Matched BY TYPE by callers
+    and the store storm; don't match the message."""
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     """`get()` timed out."""
 

@@ -1890,6 +1890,22 @@ class GcsServer:
             alive = sum(1 for n in self._nodes.values() if n["alive"])
             provisional = sum(1 for n in self._nodes.values()
                               if n["alive"] and n.get("restored"))
+            # storage failure-domain roll-up: per-node object_store blocks
+            # (heartbeat node_stats) summed fleet-wide + the degraded list
+            storage = {"used_bytes": 0, "capacity_bytes": 0,
+                       "pinned_bytes": 0, "pool_bytes": 0,
+                       "spilled_bytes": 0, "nodes_reporting": 0,
+                       "nodes_spill_degraded": []}
+            for nid, n in self._nodes.items():
+                blk = (n.get("stats") or {}).get("object_store")
+                if not n["alive"] or not blk:
+                    continue
+                storage["nodes_reporting"] += 1
+                for k in ("used_bytes", "capacity_bytes", "pinned_bytes",
+                          "pool_bytes", "spilled_bytes"):
+                    storage[k] += blk.get(k, 0)
+                if blk.get("spill_degraded"):
+                    storage["nodes_spill_degraded"].append(nid.hex())
             bcast = {"seq": self._bcast_seq, "fulls": self._bcast_fulls,
                      "deltas": self._bcast_deltas,
                      "bytes_sent": self._bcast_bytes,
@@ -1937,6 +1953,7 @@ class GcsServer:
             "fencing_rejections": self._fencing_rejections,
             "broadcast": bcast,
             "node_failure": node_failure,
+            "storage": storage,
             "promotion": dict(self.promotion) if self.promotion else None,
         }
 

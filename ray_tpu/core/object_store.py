@@ -26,23 +26,107 @@ Two tiers, matching reference semantics (SURVEY appendix C):
 
 from __future__ import annotations
 
+import errno
 import logging
 import mmap
 import os
+import shutil
+import struct
 import threading
 import time
+import zlib
 from collections import OrderedDict
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from ray_tpu.core.config import get_config
+from ray_tpu.core.exceptions import ObjectLostError, ObjectStoreFullError
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.serialization import SerializedObject
 
 logger = logging.getLogger(__name__)
 
 _SHM_DIR = "/dev/shm"
+
+# ---------------------------------------------------------------------------
+# Spill envelope (storage failure domain): every spilled object is framed
+#   magic(4) version(1) pad(3) payload_len(8) crc32(4) | payload
+# written to a tmp name and committed with fsync + os.replace, so a spill
+# file either exists complete-and-verifiable or not at all. _restore
+# verifies magic, length AND checksum before attaching; any mismatch
+# (torn write that raced a crash, bit rot, truncation, missing file) marks
+# that copy LOST — a typed outcome that routes into lineage reconstruction
+# instead of a raw buffer error (cf. reference local_object_manager.h spill
+# IO workers + ObjectLostError semantics).
+
+SPILL_MAGIC = b"RTSP"
+SPILL_VERSION = 1
+_SPILL_HDR = struct.Struct("<4sB3xQI")
+SPILL_HEADER_SIZE = _SPILL_HDR.size
+
+
+class SpillCorruptionError(ObjectLostError):
+    """A spilled copy failed envelope verification (short read, bad magic,
+    checksum mismatch, missing file). The copy is gone; whether the OBJECT
+    is lost depends on lineage — callers route into reconstruction. Carries
+    `reason` ("missing"/"torn"/"corrupt"/"io") for observability."""
+
+    def __init__(self, message: str, reason: str = "corrupt"):
+        super().__init__(message)
+        self.reason = reason
+
+
+def spill_pack_header(payload) -> bytes:
+    mv = memoryview(payload)
+    if mv.format != "B" or mv.ndim != 1:
+        mv = mv.cast("B")
+    return _SPILL_HDR.pack(SPILL_MAGIC, SPILL_VERSION, mv.nbytes,
+                           zlib.crc32(mv) & 0xFFFFFFFF)
+
+
+def spill_read_verified(path: str, expect_size: Optional[int] = None) -> bytes:
+    """Read + verify a spill envelope; returns the payload. Raises
+    SpillCorruptionError on ANY defect (typed reason attached)."""
+    try:
+        with open(path, "rb") as f:
+            hdr = f.read(SPILL_HEADER_SIZE)
+            if len(hdr) < SPILL_HEADER_SIZE:
+                raise SpillCorruptionError(
+                    f"spill file {path}: short header "
+                    f"({len(hdr)}/{SPILL_HEADER_SIZE} bytes)", reason="torn")
+            magic, version, length, crc = _SPILL_HDR.unpack(hdr)
+            if magic != SPILL_MAGIC or version != SPILL_VERSION:
+                raise SpillCorruptionError(
+                    f"spill file {path}: bad magic/version "
+                    f"({magic!r} v{version})", reason="corrupt")
+            if expect_size is not None and length != expect_size:
+                raise SpillCorruptionError(
+                    f"spill file {path}: envelope length {length} != "
+                    f"expected {expect_size}", reason="corrupt")
+            payload = f.read(length)
+    except FileNotFoundError:
+        raise SpillCorruptionError(
+            f"spill file {path}: missing", reason="missing") from None
+    except OSError as e:
+        raise SpillCorruptionError(
+            f"spill file {path}: read failed: {e}", reason="io") from e
+    if len(payload) != length:
+        raise SpillCorruptionError(
+            f"spill file {path}: short payload ({len(payload)}/{length} "
+            f"bytes)", reason="torn")
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise SpillCorruptionError(
+            f"spill file {path}: checksum mismatch", reason="corrupt")
+    return payload
+
+
+def _fs_fault(site: str) -> Optional[str]:
+    """Seeded filesystem fault injection at named storage-IO sites
+    (rpc.FaultInjector `fs:<site>:<mode>` rules). None when uninjected."""
+    from ray_tpu.core.rpc import fs_fault
+
+    return fs_fault(site)
 
 
 class ShmSegment:
@@ -159,9 +243,52 @@ class SharedObjectStore:
         cfg = get_config()
         self.capacity = capacity or cfg.object_store_memory
         self.spill_dir = spill_dir or os.path.join(cfg.session_dir_root, "spill", str(os.getpid()))
+        # disk-full degradation ladder: a spill write that fails with
+        # ENOSPC/EIO retries down this dir list under backoff; when EVERY
+        # dir fails the store goes spill-degraded (stops spilling, puts
+        # flip to backpressure) and a periodic probe self-heals it
+        self.spill_dirs: List[str] = [self.spill_dir] + [
+            os.path.join(d, str(os.getpid()))
+            for d in cfg.object_spill_dirs.split(":") if d.strip()]
+        self._spill_degraded = False
+        self._degraded_since = 0.0
+        self._last_probe = 0.0
         self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()  # LRU order
         self._lock = threading.RLock()
+        # waiters for admission headroom (bounded put backpressure):
+        # notified whenever bytes are freed or the degraded state heals
+        self._space = threading.Condition(self._lock)
         self._used = 0
+        self._pinned_bytes = 0  # bytes of entries with >=1 reader pin
+        # storage failure-domain counters (mirrored into stats() and the
+        # ray_tpu_object_* metrics): spilled/restored byte totals, spill
+        # failures by reason, lost spilled copies, admission rejections,
+        # pin-cap refusals, degraded transitions
+        self.counters: Dict[str, Any] = {
+            "spilled_bytes": 0, "restored_bytes": 0,
+            "spill_failures": {}, "lost_spills": 0,
+            "put_backpressure": 0, "pin_cap_refusals": 0,
+            "degraded_enters": 0, "degraded_heals": 0,
+        }
+        try:
+            from ray_tpu.util import metrics as _m
+
+            self._m_spilled = _m.get_or_create(
+                "counter", "ray_tpu_object_spilled_bytes_total",
+                "Bytes spilled to disk (committed envelopes)")
+            self._m_restored = _m.get_or_create(
+                "counter", "ray_tpu_object_restored_bytes_total",
+                "Bytes restored from spill (verified envelopes)")
+            self._m_spill_fail = _m.get_or_create(
+                "counter", "ray_tpu_object_spill_failures_total",
+                "Spill/restore failures by reason",
+                tag_keys=("reason",))
+            self._m_pinned = _m.get_or_create(
+                "gauge", "ray_tpu_object_pinned_bytes",
+                "Bytes held by reader pins (excluded from eviction)")
+        except Exception:  # metrics are never load-bearing
+            self._m_spilled = self._m_restored = None
+            self._m_spill_fail = self._m_pinned = None
         # Segment-reuse pool: deleted (unpinned, unspilled) file segments
         # park here instead of unlinking, bucketed by their page-rounded
         # file size. Reusing a segment hands the writer ALREADY-FAULTED
@@ -203,7 +330,14 @@ class SharedObjectStore:
         """Allocate a segment for `object_id`; caller writes then seals.
         `info`, when given, is filled with {"recycled": bool} so the writer
         can pick its write strategy (mmap memcpy into hot recycled pages vs
-        writev into a fresh file)."""
+        writev into a fresh file).
+
+        Admission is honest: when eviction + spilling + pool drain cannot
+        make `size` fit under capacity (every evictable entry is pinned or
+        unsealed, or the store is spill-degraded), this raises typed
+        ObjectStoreFullError instead of silently overcommitting `_used`
+        past capacity. Callers bound their own wait (`put_full_timeout_s`)
+        on headroom before surfacing it."""
         with self._lock:
             e = self._entries.get(object_id)
             if e is not None:
@@ -214,6 +348,7 @@ class SharedObjectStore:
                     e.doomed = False
                 raise FileExistsError(f"object {object_id} already exists")
             self._maybe_evict(size)
+            self._admit(size)
             if self._arena is not None and size <= self.arena_threshold:
                 off = self._arena.alloc(size)
                 if off is not None:
@@ -261,9 +396,16 @@ class SharedObjectStore:
             e.sealed = True
             self._entries.move_to_end(object_id)
 
-    def put_bytes(self, object_id: ObjectID, data: bytes | memoryview) -> None:
+    def put_bytes(self, object_id: ObjectID, data: bytes | memoryview,
+                  timeout_s: float = 0.0) -> None:
+        """Copy `data` in and seal. `timeout_s` > 0 waits bounded for
+        eviction/unpin headroom before raising ObjectStoreFullError (the
+        server-internal materialization paths: pulls, pushes)."""
         n = len(data) if isinstance(data, (bytes, bytearray)) else data.nbytes
-        shm = self.create(object_id, n)
+        if timeout_s > 0:
+            shm = self.create_blocking(object_id, n, timeout_s)
+        else:
+            shm = self.create(object_id, n)
         try:
             if shm.name.startswith("@"):
                 shm.buf[:n] = data
@@ -367,33 +509,69 @@ class SharedObjectStore:
 
     def lookup(self, object_id: ObjectID) -> Optional[tuple[str, int]]:
         """Return (segment_name, size) for a sealed object, restoring from
-        spill if needed; None if absent (or deleted-but-pinned)."""
+        spill if needed; None if absent (or deleted-but-pinned, or the
+        spilled copy failed envelope verification — the entry is dropped
+        and the caller's absent-handling routes into reconstruction)."""
         with self._lock:
             e = self._entries.get(object_id)
             if e is None or not e.sealed or e.doomed:
                 return None
             if e.spilled_path is not None:
-                self._restore(object_id, e)
+                try:
+                    self._restore(object_id, e)
+                except SpillCorruptionError:
+                    return None  # copy LOST; _restore dropped the entry
             self._entries.move_to_end(object_id)
             return (e.name, e.size)
 
     # ---- pin protocol ----------------------------------------------------
-    def pin(self, object_id: ObjectID) -> Optional[tuple[str, int]]:
+    def pin(self, object_id: ObjectID,
+            transient: bool = False) -> Optional[tuple[str, int]]:
         """Pin a sealed object for a zero-copy reader and return its
-        CURRENT (segment_name, size); None if absent/unsealed/doomed.
+        CURRENT (segment_name, size); None if absent/unsealed/doomed (or
+        the pin-cap refused — see pin_ex to distinguish).
         While pinned the entry is excluded from spill and eviction, and a
         delete() defers the unlink until the last unpin — so reader views
         into the segment stay valid (and accounted) for their lifetime.
-        Restores from spill first: pinning declares intent to attach."""
+        Restores from spill first: pinning declares intent to attach.
+
+        Pin-cap accounting: indefinite reader pins (`transient=False`) may
+        collectively hold at most `max_pinned_fraction` of capacity — the
+        FIRST pin of an entry that would cross the cap is refused, so
+        pinned entries can never wedge eviction entirely. `transient=True`
+        (scoped reads: pinned_view, bounded copy windows) bypasses the cap
+        — those pins are released within one operation."""
+        loc, _ = self.pin_ex(object_id, transient=transient)
+        return loc
+
+    def pin_ex(self, object_id: ObjectID, transient: bool = False
+               ) -> tuple[Optional[tuple[str, int]], Optional[str]]:
+        """pin() with a reason channel: (loc, None) on success,
+        (None, "absent" | "lost" | "pin_cap") on refusal. "pin_cap" means
+        the object IS resident — the caller may fall back to a transient
+        pin + bounded copy instead of treating it as gone."""
         with self._lock:
             e = self._entries.get(object_id)
             if e is None or not e.sealed or e.doomed:
-                return None
+                return None, "absent"
             if e.spilled_path is not None:
-                self._restore(object_id, e)
+                try:
+                    self._restore(object_id, e)
+                except SpillCorruptionError:
+                    return None, "lost"  # copy LOST; entry dropped
+            if (not transient and e.pinned == 0
+                    and e.arena_offset is None
+                    and self._pinned_bytes + e.size
+                    > get_config().max_pinned_fraction * self.capacity):
+                self.counters["pin_cap_refusals"] += 1
+                return None, "pin_cap"
+            if e.pinned == 0:
+                self._pinned_bytes += e.size
+                if self._m_pinned is not None:
+                    self._m_pinned.set(self._pinned_bytes)
             e.pinned += 1
             self._entries.move_to_end(object_id)
-            return (e.name, e.size)
+            return (e.name, e.size), None
 
     def unpin(self, object_id: ObjectID) -> None:
         """Release one pin; finishes a deferred delete at the last one.
@@ -403,7 +581,15 @@ class SharedObjectStore:
             e = self._entries.get(object_id)
             if e is None:
                 return
+            was = e.pinned
             e.pinned = max(0, e.pinned - 1)
+            if was == 1 and e.pinned == 0:
+                self._pinned_bytes = max(0, self._pinned_bytes - e.size)
+                if self._m_pinned is not None:
+                    self._m_pinned.set(self._pinned_bytes)
+                # newly unpinned bytes are spillable again: wake admission
+                # waiters parked in create_blocking
+                self._space.notify_all()
             if e.doomed and e.pinned == 0:
                 self._entries.pop(object_id, None)
                 if e.arena_offset is not None:
@@ -419,7 +605,7 @@ class SharedObjectStore:
         unpinned attach would be unsafe (a concurrent delete could recycle
         and overwrite the inode beneath the view), so callers MUST close.
         Scoped readers should prefer pinned_view."""
-        loc = self.pin(object_id)
+        loc = self.pin(object_id, transient=True)
         if loc is None:
             return None
         try:
@@ -446,8 +632,9 @@ class SharedObjectStore:
         serves). The pin keeps the segment out of spill/eviction for the
         duration, so a long transfer can't race a spill into a
         double-IO restore (or a recycled inode). Yields the buffer, or
-        None when the object is absent."""
-        loc = self.pin(object_id)
+        None when the object is absent (or its spilled copy is lost).
+        Transient: scoped pins bypass the `max_pinned_fraction` cap."""
+        loc = self.pin(object_id, transient=True)
         if loc is None:
             yield None
             return
@@ -486,10 +673,15 @@ class SharedObjectStore:
                 e.doomed = True
                 return
             self._entries.pop(object_id, None)
+            if e.pinned > 0:
+                # deleting a pinned-but-spilled entry: its pin bytes leave
+                # the cap accounting with it
+                self._pinned_bytes = max(0, self._pinned_bytes - e.size)
             if e.arena_offset is not None:
                 if self._arena is not None:
                     self._arena.free(e.arena_offset)
                 self._used -= e.size
+                self._space.notify_all()
             elif e.spilled_path is None:
                 self._reclaim(e)
             elif os.path.exists(e.spilled_path):
@@ -513,6 +705,7 @@ class SharedObjectStore:
             self._drain_pool(need)
         self._pool.setdefault(bucket, []).append(e.name)
         self._pool_bytes += bucket
+        self._space.notify_all()  # freed live bytes: wake admission waiters
 
     def _drain_pool(self, want: int) -> int:
         """Caller holds _lock. Unlink pooled segments until `want` bytes
@@ -531,15 +724,29 @@ class SharedObjectStore:
     def stats(self) -> dict:
         with self._lock:
             spilled = sum(1 for e in self._entries.values() if e.spilled_path)
+            spilled_bytes = sum(e.size for e in self._entries.values()
+                                if e.spilled_path)
             pinned = sum(1 for e in self._entries.values() if e.pinned > 0)
+            c = self.counters
             return {
                 "num_objects": len(self._entries),
                 "used_bytes": self._used,
                 "capacity_bytes": self.capacity,
                 "num_spilled": spilled,
+                "spilled_bytes": spilled_bytes,
                 "num_pinned": pinned,
                 "pinned_refs": sum(e.pinned for e in self._entries.values()),
+                "pinned_bytes": self._pinned_bytes,
                 "pool_bytes": self._pool_bytes,
+                "spill_degraded": self._spill_degraded,
+                "spilled_bytes_total": c["spilled_bytes"],
+                "restored_bytes_total": c["restored_bytes"],
+                "spill_failures": dict(c["spill_failures"]),
+                "lost_spills": c["lost_spills"],
+                "put_backpressure": c["put_backpressure"],
+                "pin_cap_refusals": c["pin_cap_refusals"],
+                "degraded_enters": c["degraded_enters"],
+                "degraded_heals": c["degraded_heals"],
             }
 
     def shutdown(self) -> None:
@@ -548,6 +755,7 @@ class SharedObjectStore:
                 e.pinned = 0  # process exiting: force-reclaim
                 e.doomed = False
                 self.delete(oid)
+            self._pinned_bytes = 0
             self._drain_pool(self._pool_bytes)
             if self._arena is not None:
                 self._arena.close()
@@ -558,11 +766,52 @@ class SharedObjectStore:
     def _unlink(self, e: _Entry) -> None:
         ShmSegment.unlink(e.name)
 
+    def _admit(self, incoming: int) -> None:
+        """Caller holds _lock, after _maybe_evict. Typed store-full check:
+        live bytes + pooled segments + the incoming object must fit under
+        capacity. The pool is drained first (idle warmth never causes a
+        rejection); what remains over budget is genuine — pinned or
+        unsealed entries that cannot move, or a spill-degraded store."""
+        deficit = self._used + self._pool_bytes + incoming - self.capacity
+        if deficit > 0:
+            self._drain_pool(deficit)
+        if self._used + self._pool_bytes + incoming <= self.capacity:
+            return
+        self.counters["put_backpressure"] += 1
+        raise ObjectStoreFullError(
+            f"object store cannot admit {incoming} bytes: "
+            f"used={self._used} pinned={self._pinned_bytes} "
+            f"pool={self._pool_bytes} capacity={self.capacity}"
+            + (" [spill-degraded: every spill dir is failing]"
+               if self._spill_degraded else ""))
+
+    def create_blocking(self, object_id: ObjectID, size: int,
+                        timeout_s: float, info: Optional[dict] = None):
+        """create() with a bounded wait for eviction/unpin headroom: parks
+        on the store's space condition (notified by delete/unpin/heal)
+        until admission succeeds or `timeout_s` expires, then re-raises the
+        typed ObjectStoreFullError. For server-internal materialization
+        paths (pulls, data-plane pushes) that run on their own threads;
+        the worker put path does its own client-side bounded retry."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._space:
+            while True:
+                try:
+                    return self.create(object_id, size, info=info)
+                except ObjectStoreFullError:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or size > self.capacity:
+                        raise
+                    self._space.wait(min(remaining, 0.1))
+
     def _maybe_evict(self, incoming: int) -> None:
         """Spill least-recently-used sealed objects until there is room.
 
         Mirrors the reference's threshold-triggered spilling
-        (`object_spilling_threshold` 0.8, `ray_config_def.h:583`).
+        (`object_spilling_threshold` 0.8, `ray_config_def.h:583`). A
+        spill-degraded store (every spill dir failing) skips spilling
+        entirely — after a probe-period attempt to self-heal — and only
+        drains the pool; admission then backpressures puts.
         """
         threshold = get_config().object_spilling_threshold
         budget = self.capacity * threshold - self._pool_bytes
@@ -572,6 +821,8 @@ class SharedObjectStore:
         # warmth never costs a spill
         self._drain_pool(int(self._used + incoming - budget))
         budget = self.capacity * threshold - self._pool_bytes
+        if self._spill_degraded and not self._probe_spill_dirs():
+            return
         for oid in list(self._entries):
             if self._used + incoming <= budget:
                 break
@@ -580,39 +831,235 @@ class SharedObjectStore:
                     or e.arena_offset is not None):
                 continue  # pinned entries hold reader views; arena objects
                 # are small — only idle file segments spill
-            self._spill(oid, e)
+            if not self._spill(oid, e) and self._spill_degraded:
+                return  # every dir just failed: stop burning IO this pass
 
-    def _spill(self, object_id: ObjectID, e: _Entry) -> None:
-        os.makedirs(self.spill_dir, exist_ok=True)
-        path = os.path.join(self.spill_dir, object_id.hex())
+    def _probe_spill_dirs(self) -> bool:
+        """Caller holds _lock. Self-healing probe for the spill-degraded
+        state: at most once per `spill_degraded_probe_period_s`, try a
+        tiny committed write in each spill dir (through the same fault
+        points as a real spill). One healthy dir clears degradation and
+        wakes admission waiters. Returns the healthy/healed state."""
+        if not self._spill_degraded:
+            return True
+        period = get_config().spill_degraded_probe_period_s
+        now = time.monotonic()
+        if period <= 0 or now - self._last_probe < period:
+            return False
+        self._last_probe = now
+        for d in self.spill_dirs:
+            try:
+                if _fs_fault("spill_write") in ("enospc", "eio"):
+                    continue  # injected window still open for this probe
+                os.makedirs(d, exist_ok=True)
+                probe = os.path.join(d, ".probe")
+                with open(probe + ".tmp", "wb") as f:
+                    f.write(b"rtpu-probe")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(probe + ".tmp", probe)
+                os.unlink(probe)
+            except OSError:
+                continue
+            self._spill_degraded = False
+            self.counters["degraded_heals"] += 1
+            logger.warning("object store spill path healed (dir %s); "
+                           "resuming spilling", d)
+            self._space.notify_all()
+            return True
+        return False
+
+    def _count_spill_failure(self, reason: str) -> None:
+        fails = self.counters["spill_failures"]
+        fails[reason] = fails.get(reason, 0) + 1
+        if self._m_spill_fail is not None:
+            self._m_spill_fail.inc(tags={"reason": reason})
+
+    def _spill(self, object_id: ObjectID, e: _Entry) -> bool:
+        """Caller holds _lock. Durable spill: checksummed envelope, tmp
+        write, fsync, os.replace — the file is either complete and
+        verifiable or absent. ENOSPC/EIO retries down `spill_dirs` under
+        backoff; when every dir fails the store enters the spill-degraded
+        state (spilling stops, puts flip to backpressure) until a probe
+        heals it. Returns True when the object moved to disk."""
+        cfg = get_config()
         try:
             shm = ShmSegment(e.name, e.size)
-            with open(path, "wb") as f:
-                f.write(shm.buf[: e.size])
-            shm.close()
         except FileNotFoundError:
-            return
-        self._unlink(e)
-        e.spilled_path = path
-        self._used -= e.size
-        logger.debug("spilled %s (%d bytes) to %s", object_id, e.size, path)
+            return False  # segment swept externally; nothing to spill
+        try:
+            payload = bytes(shm.buf[: e.size])
+        finally:
+            shm.close()
+        header = spill_pack_header(payload)
+        injected = _fs_fault("spill_write")
+        if injected == "bitflip" and e.size > 0:
+            # corrupt ONE payload byte after checksumming: the envelope
+            # commits "successfully" and the defect is only caught by
+            # _restore's verification — the silent-bit-rot scenario
+            corrupt = bytearray(payload)
+            corrupt[len(corrupt) // 2] ^= 0x40
+            payload = bytes(corrupt)
+        for d in self.spill_dirs:
+            path = os.path.join(d, object_id.hex())
+            tmp = path + ".tmp"
+            for attempt in range(max(1, cfg.spill_write_retries)):
+                try:
+                    if injected in ("enospc", "eio"):
+                        raise OSError(
+                            errno.ENOSPC if injected == "enospc"
+                            else errno.EIO,
+                            f"[fault-injection] {injected} on spill_write")
+                    os.makedirs(d, exist_ok=True)
+                    with open(tmp, "wb") as f:
+                        f.write(header)
+                        if injected == "torn":
+                            # commit a half-written payload: a crash that
+                            # raced the write — caught by length/crc checks
+                            f.write(payload[: max(0, e.size // 2)])
+                        else:
+                            f.write(payload)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(tmp, path)
+                except OSError as err:
+                    self._count_spill_failure(
+                        "enospc" if err.errno == errno.ENOSPC else "io")
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+                    if attempt + 1 < max(1, cfg.spill_write_retries):
+                        time.sleep(cfg.spill_retry_backoff_ms / 1000.0
+                                   * (attempt + 1))
+                    # next attempt re-rolls the injector: a probabilistic
+                    # ENOSPC window can clear mid-retry like a real disk
+                    injected = _fs_fault("spill_write")
+                    continue
+                self._unlink(e)
+                e.spilled_path = path
+                self._used -= e.size
+                self.counters["spilled_bytes"] += e.size
+                if self._m_spilled is not None:
+                    self._m_spilled.inc(e.size)
+                logger.debug("spilled %s (%d bytes) to %s",
+                             object_id, e.size, path)
+                return True
+            injected = _fs_fault("spill_write")
+        if not self._spill_degraded:
+            self._spill_degraded = True
+            self._degraded_since = time.monotonic()
+            self._last_probe = time.monotonic()
+            self.counters["degraded_enters"] += 1
+            logger.error(
+                "object store is SPILL-DEGRADED: every spill dir failed "
+                "(%s); spilling stops and puts backpressure until a probe "
+                "heals", self.spill_dirs)
+        return False
 
     def _restore(self, object_id: ObjectID, e: _Entry) -> None:
+        """Caller holds _lock. Verified restore: the envelope's magic,
+        length and crc32 must all check out before the payload re-enters
+        shm. ANY defect (torn, short, corrupt, missing, unreadable) marks
+        this copy LOST — the entry is dropped and SpillCorruptionError
+        (an ObjectLostError) raised; callers surface absent and lineage
+        reconstruction takes over."""
         assert e.spilled_path is not None
+        path = e.spilled_path
+        try:
+            injected = _fs_fault("spill_restore")
+            if injected in ("eio", "torn"):
+                raise SpillCorruptionError(
+                    f"spill file {path}: [fault-injection] {injected} on "
+                    f"restore", reason="torn" if injected == "torn"
+                    else "io")
+            payload = spill_read_verified(path, expect_size=e.size)
+            if injected == "bitflip":
+                raise SpillCorruptionError(
+                    f"spill file {path}: [fault-injection] bitflip on "
+                    f"restore", reason="corrupt")
+        except SpillCorruptionError as err:
+            # the copy is gone: drop the entry + the bad file so repeated
+            # lookups don't re-verify a corpse, count it, surface typed
+            self._entries.pop(object_id, None)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            self.counters["lost_spills"] += 1
+            self._count_spill_failure(err.reason)
+            logger.error("spilled copy of %s LOST (%s): %s",
+                         object_id, err.reason, err)
+            raise
         self._maybe_evict(e.size)
         shm, _ = self._alloc_file_segment(e.size)
         name = shm.name
-        with open(e.spilled_path, "rb") as f:
-            shm.buf[: e.size] = f.read(e.size)
-        shm.close()
         try:
-            os.unlink(e.spilled_path)
+            shm.buf[: e.size] = payload
+        finally:
+            shm.close()
+        try:
+            os.unlink(path)
         except OSError:
             pass
         e.name = name
         e.spilled_path = None
         self._used += e.size
+        self.counters["restored_bytes"] += e.size
+        if self._m_restored is not None:
+            self._m_restored.inc(e.size)
         logger.debug("restored %s from spill", object_id)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    except OSError:
+        return False
+    return True
+
+
+def sweep_stale_spill_dirs(roots: Optional[List[str]] = None,
+                           live_pids: Optional[set] = None) -> List[str]:
+    """Collect spill dirs leaked by SIGKILLed stores. Spill dirs are keyed
+    `<root>/<pid>`; a raylet that dies without shutdown() leaves its dir
+    (and every spilled object in it) behind forever — every kill storm
+    does this. Sweeps children whose pid no longer runs, mirroring the
+    `rtpu-worker-*.env` reaper (raylet._sweep_stale_envfiles): called at
+    store startup and hourly from the raylet reaper loop. Returns the
+    removed paths. `roots` defaults to the session spill root plus every
+    configured `object_spill_dirs` entry."""
+    cfg = get_config()
+    if roots is None:
+        roots = [os.path.join(cfg.session_dir_root, "spill")] + [
+            d for d in cfg.object_spill_dirs.split(":") if d.strip()]
+    live = set(live_pids or ())
+    live.add(os.getpid())
+    removed: List[str] = []
+    for root in roots:
+        try:
+            children = os.listdir(root)
+        except OSError:
+            continue
+        for child in children:
+            if not child.isdigit() or int(child) in live:
+                continue
+            if _pid_alive(int(child)):
+                continue
+            path = os.path.join(root, child)
+            try:
+                shutil.rmtree(path)
+                removed.append(path)
+            except OSError:
+                pass  # raced another sweeper / permissions: next pass
+    if removed:
+        logger.info("reaped %d stale spill dir(s): %s",
+                    len(removed), removed[:4])
+    return removed
 
 
 def attach_object(name: str, size: int, readonly: bool = False):

@@ -26,7 +26,9 @@ from typing import Dict, List, Optional, Tuple
 from ray_tpu.core import rpc
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
-from ray_tpu.core.object_store import SharedObjectStore
+from ray_tpu.core.exceptions import ObjectStoreFullError
+from ray_tpu.core.object_store import (SharedObjectStore,
+                                       sweep_stale_spill_dirs)
 from ray_tpu.core.scheduler import NodeView, SchedulingPolicy
 from ray_tpu.core.runtime_env_manager import env_key as _env_key
 from ray_tpu.core.task_spec import TaskSpec, TaskType
@@ -121,6 +123,12 @@ class Raylet:
         self._server = rpc.RpcServer(host)
         self._server.register_all(self)
         self.store = SharedObjectStore(capacity=object_store_memory)
+        try:
+            # collect spill dirs leaked by SIGKILLed prior stores (kill
+            # storms do this every run); re-swept hourly by _reaper_loop
+            sweep_stale_spill_dirs()
+        except Exception:
+            logger.exception("startup spill dir sweep failed")
         # bulk transfer side channel: raw sockets, shm->kernel->shm copies
         # only (see data_plane.py; reference object_manager.h:117 keeps bulk
         # chunk streams off the control plane the same way)
@@ -219,6 +227,7 @@ class Raylet:
         # workers we SIGKILLed for memory pressure: their death notification
         # carries reason="oom" so exhausted retries surface OutOfMemoryError
         self._oom_killed: set = set()
+        self.oom_kills_total = 0  # monotonic; read by memstorm/tests
         self._shutdown = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -763,11 +772,22 @@ class Raylet:
             import psutil
 
             vm = psutil.virtual_memory()
+            st = self.store.stats()
             return {
                 "cpu_percent": psutil.cpu_percent(interval=None),
                 "mem_used": vm.used,
                 "mem_total": vm.total,
-                "object_store_used": self.store.stats().get("used_bytes", 0),
+                "object_store_used": st.get("used_bytes", 0),
+                # storage failure-domain block: aggregated per node into
+                # gcs_stats["storage"] (used/pinned/pool/spilled/degraded)
+                "object_store": {
+                    "used_bytes": st.get("used_bytes", 0),
+                    "capacity_bytes": st.get("capacity_bytes", 0),
+                    "pinned_bytes": st.get("pinned_bytes", 0),
+                    "pool_bytes": st.get("pool_bytes", 0),
+                    "spilled_bytes": st.get("spilled_bytes", 0),
+                    "spill_degraded": st.get("spill_degraded", False),
+                },
                 "num_workers": len(self._workers),
             }
         except (OSError, ValueError, KeyError) as e:
@@ -1197,6 +1217,7 @@ class Raylet:
                 # it exited on its own between pick and kill
                 self._oom_killed.discard(victim.worker_id)
                 return False
+            self.oom_kills_total += 1
         return True
 
     def _memory_usage_fraction(self, psutil) -> float:
@@ -1220,10 +1241,20 @@ class Raylet:
 
     def _reaper_loop(self) -> None:
         """Reap dead spawned processes + kill long-idle workers + reclaim
-        long-unreferenced runtime envs."""
+        long-unreferenced runtime envs + collect stale spill dirs."""
         cfg = get_config()
         last_env_gc = time.monotonic()
+        last_spill_gc = time.monotonic()
         while not self._shutdown.wait(1.0):
+            if time.monotonic() - last_spill_gc >= 3600.0:
+                # hourly: spill dirs leaked by SIGKILLed stores (keyed by
+                # pid; the startup sweep in __init__ covers the common
+                # case, this covers raylets outliving their killed peers)
+                last_spill_gc = time.monotonic()
+                try:
+                    sweep_stale_spill_dirs()
+                except Exception:
+                    logger.exception("stale spill dir sweep failed")
             if time.monotonic() - last_env_gc >= 60.0:
                 last_env_gc = time.monotonic()
                 try:
@@ -2096,6 +2127,15 @@ class Raylet:
                     "recycled": info.get("recycled", False)}
         except FileExistsError:
             return {"ok": False, "exists": True}
+        except ObjectStoreFullError as e:
+            # typed backpressure: the WORKER bounds its retry window
+            # (put_full_timeout_s) — this handler runs on the rpc loop and
+            # must not block on headroom itself. `fatal` short-circuits the
+            # retry loop for objects that can never fit.
+            return {"ok": False, "full": True,
+                    "degraded": self.store.stats()["spill_degraded"],
+                    "fatal": size > self.store.capacity,
+                    "error": str(e)}
 
     def rpc_obj_seal(self, conn, req_id, payload):
         """Fire-and-forget on the put hot path (the single-writer seal
@@ -2169,6 +2209,11 @@ class Raylet:
             self.store.put_bytes(object_id, payload["data"])
         except FileExistsError:
             pass
+        except ObjectStoreFullError as e:
+            return {"ok": False, "full": True,
+                    "degraded": self.store.stats()["spill_degraded"],
+                    "fatal": len(payload["data"]) > self.store.capacity,
+                    "error": str(e)}
         self._resolve_pulls(object_id)
         return True
 
@@ -2226,10 +2271,20 @@ class Raylet:
         object_id: ObjectID = payload["object_id"]
         pin = bool(payload.get("pin"))
         if pin:
-            loc = self.store.pin(object_id)
+            loc, reason = self.store.pin_ex(object_id)
             if loc is not None:
                 self._track_pin(conn, object_id)
                 return loc
+            if reason == "pin_cap":
+                # resident, but indefinite reader pins are at the
+                # max_pinned_fraction cap: grant a TRANSIENT pin with a
+                # copy-only marker — the reader copies out inside a bounded
+                # window and unpins, instead of wedging the store (or
+                # spuriously reporting the object lost)
+                loc = self.store.pin(object_id, transient=True)
+                if loc is not None:
+                    self._track_pin(conn, object_id)
+                    return (loc[0], loc[1], "copy_only")
         else:
             loc = self.store.lookup(object_id)
             if loc is not None:
@@ -2266,9 +2321,15 @@ class Raylet:
                                      timeout=cfg.object_transfer_chunk_timeout_s)
                     if data is not None:
                         try:
-                            self.store.put_bytes(object_id, data)
+                            # bounded wait for headroom: this thread may
+                            # block, the rpc loop does not
+                            self.store.put_bytes(
+                                object_id, data,
+                                timeout_s=min(cfg.put_full_timeout_s, 5.0))
                         except FileExistsError:
                             pass
+                        except ObjectStoreFullError as e:
+                            err = f"pull target store full: {e}"
                     else:
                         err = f"object {object_id} not found at {source}"
                 else:
@@ -2364,7 +2425,10 @@ class Raylet:
         self._pull_budget.acquire(size)
         try:
             try:
-                shm = self.store.create(object_id, size)
+                shm = self.store.create_blocking(
+                    object_id, size, min(cfg.put_full_timeout_s, 5.0))
+            except ObjectStoreFullError as e:
+                return f"pull target store full: {e}"
             except FileExistsError:
                 # A local producer (e.g. lineage re-execution) or another pull
                 # beat us to the entry — but it may be UNSEALED; report success
@@ -2537,7 +2601,13 @@ class Raylet:
                 # segment recycle) in the reply->attach window — cross-node
                 # pulls land sealed-and-pinnable. A pin that misses means
                 # the object vanished again: error, the reader re-pulls.
-                pinned = self.store.pin(object_id)
+                pinned, reason = self.store.pin_ex(object_id)
+                if pinned is None and reason == "pin_cap":
+                    # at the max_pinned_fraction cap: transient copy-only
+                    # grant, same contract as rpc_pull_object's cap path
+                    pinned = self.store.pin(object_id, transient=True)
+                    if pinned is not None:
+                        pinned = (pinned[0], pinned[1], "copy_only")
                 if pinned is not None:
                     self._track_pin(conn, object_id)
                     conn.reply(req_id, pinned)

@@ -63,6 +63,22 @@ REQ, REP, ERR, PUSH = 0, 1, 2, 3
 #                                 workers inherit the topology. prob < 1.0
 #                                 models a flaky (gray) link rather than a
 #                                 clean cut. Heal with FaultInjector.heal().
+#   fs:<site>:<mode>[:<prob>]     filesystem fault at a named site in the
+#                                 storage plane (object_store.py calls
+#                                 fs_fault(site) at its spill IO
+#                                 boundaries). Sites: spill_write,
+#                                 spill_restore (or "*"). Modes:
+#                                   enospc   OSError(ENOSPC) — disk full
+#                                   eio      OSError(EIO) — media error
+#                                   torn     the committed file is
+#                                            truncated mid-payload (a
+#                                            crash between write and
+#                                            fsync; restore-side: short
+#                                            read)
+#                                   bitflip  one payload byte corrupted
+#                                            after checksumming
+#                                 Composable with drop/sever/partition
+#                                 rules; seeded like everything else.
 #
 # Determinism: one seeded RNG drives every probabilistic decision, so a
 # single-threaded call sequence replays exactly under the same seed.
@@ -89,12 +105,17 @@ REQ, REP, ERR, PUSH = 0, 1, 2, 3
 # drop/sever rules hit its send boundary by method name.
 
 
+# filesystem fault modes injectable at fs:<site> boundaries
+FS_FAULT_MODES = ("enospc", "eio", "torn", "bitflip")
+
+
 class _FaultRule:
     __slots__ = ("action", "method", "prob", "delay_s", "armed", "hits",
-                 "group_a", "group_b")
+                 "group_a", "group_b", "fs_mode")
 
     def __init__(self, action: str, method: str, prob: float = 1.0,
-                 delay_s: float = 0.0, group_a: str = "", group_b: str = ""):
+                 delay_s: float = 0.0, group_a: str = "", group_b: str = "",
+                 fs_mode: str = ""):
         self.action = action
         self.method = method
         self.prob = prob
@@ -103,6 +124,7 @@ class _FaultRule:
         self.hits = 0
         self.group_a = group_a
         self.group_b = group_b
+        self.fs_mode = fs_mode
 
     def matches(self, method: str) -> bool:
         if not self.armed:
@@ -114,6 +136,9 @@ class _FaultRule:
     def __repr__(self):
         if self.action == "partition":
             return (f"_FaultRule(partition:{self.group_a}|{self.group_b} "
+                    f"prob={self.prob} armed={self.armed} hits={self.hits})")
+        if self.action == "fs":
+            return (f"_FaultRule(fs:{self.method}:{self.fs_mode} "
                     f"prob={self.prob} armed={self.armed} hits={self.hits})")
         return (f"_FaultRule({self.action}:{self.method} prob={self.prob} "
                 f"delay={self.delay_s}s hits={self.hits})")
@@ -138,7 +163,7 @@ class FaultInjector:
         self.rules = [self._parse_rule(r) for r in
                       spec.replace(",", ";").split(";") if r.strip()]
         self.stats: Dict[str, int] = {"drop": 0, "delay": 0, "sever": 0,
-                                      "partition": 0}
+                                      "partition": 0, "fs": 0}
 
     @staticmethod
     def _parse_groups(text: str) -> Dict[str, set]:
@@ -159,10 +184,17 @@ class FaultInjector:
         parts = [p.strip() for p in text.strip().split(":")]
         action = parts[0]
         if action not in ("drop", "delay", "sever", "sever_once",
-                          "partition"):
+                          "partition", "fs"):
             raise ValueError(f"unknown fault action {action!r} in {text!r}")
         if len(parts) < 2 or not parts[1]:
             raise ValueError(f"fault rule {text!r} needs a method name")
+        if action == "fs":
+            if len(parts) < 3 or parts[2] not in FS_FAULT_MODES:
+                raise ValueError(
+                    f"fs rule {text!r} needs a mode in {FS_FAULT_MODES} "
+                    f"('fs:<site>:<mode>[:<prob>]')")
+            prob = float(parts[3]) if len(parts) > 3 else 1.0
+            return _FaultRule("fs", parts[1], prob=prob, fs_mode=parts[2])
         if action == "partition":
             a, sep, b = parts[1].partition("|")
             if not sep or not a.strip() or not b.strip():
@@ -205,6 +237,41 @@ class FaultInjector:
                               group_a=group_a, group_b=group_b)
             self.rules.append(rule)
             return rule
+
+    # ------------------------------------------------------ filesystem API
+    def fs(self, site: str, mode: str, prob: float = 1.0) -> "_FaultRule":
+        """Install (or re-arm) an fs:<site>:<mode> rule at runtime — the
+        harness-side sibling of the spec grammar. Disarm the returned
+        rule (.armed = False) to close the fault window."""
+        if mode not in FS_FAULT_MODES:
+            raise ValueError(f"fs mode {mode!r} not in {FS_FAULT_MODES}")
+        with self._lock:
+            for rule in self.rules:
+                if (rule.action == "fs" and rule.method == site
+                        and rule.fs_mode == mode):
+                    rule.armed = True
+                    rule.prob = prob
+                    return rule
+            rule = _FaultRule("fs", site, prob=prob, fs_mode=mode)
+            self.rules.append(rule)
+            return rule
+
+    def fs_fault(self, site: str) -> Optional[str]:
+        """Evaluate fs rules at a named storage-IO site; returns the fault
+        mode to inject ("enospc"/"eio"/"torn"/"bitflip") or None. First
+        armed matching rule that passes its probability roll wins."""
+        for rule in self.rules:
+            if rule.action != "fs" or not rule.matches(site):
+                continue
+            with self._lock:
+                if not rule.armed:
+                    continue
+                if rule.prob < 1.0 and self._rng.random() >= rule.prob:
+                    continue
+                rule.hits += 1
+                self.stats["fs"] += 1
+            return rule.fs_mode
+        return None
 
     def heal(self) -> int:
         """Heal every partition: disarm all partition rules (other rule
@@ -273,7 +340,8 @@ class FaultInjector:
         if self.partition_drop(origin, dest):
             return "drop"
         for rule in self.rules:
-            if rule.action == "partition" or not rule.matches(method):
+            if (rule.action in ("partition", "fs")
+                    or not rule.matches(method)):
                 continue
             with self._lock:
                 if not rule.armed:
@@ -361,6 +429,19 @@ def fault_point(name: str, origin: Optional[str] = None,
     if inj.on_send(name, None, origin=origin, dest=dest) == "drop":
         raise RpcDisconnected(
             f"[fault-injection seed={inj.seed}] dropped {name}")
+
+
+def fs_fault(site: str) -> Optional[str]:
+    """Named filesystem injection point (sites: spill_write,
+    spill_restore). Returns the fault mode the caller must simulate
+    ("enospc"/"eio"/"torn"/"bitflip") or None. Unlike fault_point() this
+    never raises — the storage plane turns the mode into the right OSError
+    or corruption itself, so the fault exercises the REAL error-handling
+    path, not an injected exception type. Zero overhead uninjected."""
+    inj = get_fault_injector()
+    if inj is None:
+        return None
+    return inj.fs_fault(site)
 
 
 def clear_fault_injector() -> None:

@@ -39,6 +39,7 @@ from ray_tpu.core.exceptions import (
     ActorDiedError,
     GetTimeoutError,
     ObjectLostError,
+    ObjectStoreFullError,
     TaskError,
     WorkerCrashedError,
 )
@@ -817,11 +818,31 @@ class CoreWorker:
         go straight from the array to the segment, no flatten).
 
         Returns (segment_name, attach_size), or None if the object
-        already existed."""
+        already existed.
+
+        Store-full backpressure: a typed `full` refusal retries with
+        backoff for at most `put_full_timeout_s` — eviction, spilling and
+        reader unpins happen on the raylet in the meantime — then raises
+        ObjectStoreFullError (immediately when the store marks the refusal
+        `fatal`: the object can never fit)."""
         size = s.framed_size
-        r = self.raylet.call("obj_create", {"object_id": oid, "size": size})
-        if not r.get("ok"):
-            return None  # already exists
+        cfg = get_config()
+        deadline = time.monotonic() + cfg.put_full_timeout_s
+        attempt = 0
+        while True:
+            r = self.raylet.call("obj_create",
+                                 {"object_id": oid, "size": size})
+            if r.get("ok"):
+                break
+            if not r.get("full"):
+                return None  # already exists
+            remaining = deadline - time.monotonic()
+            if r.get("fatal") or remaining <= 0:
+                raise ObjectStoreFullError(
+                    r.get("error")
+                    or f"object store full putting {oid} ({size} bytes)")
+            attempt += 1
+            time.sleep(min(0.05 * attempt, 0.5, max(remaining, 0.01)))
         name = r["name"]
         if name.startswith("@"):
             buf = attach_object(name, size)  # arena slot: write in place
@@ -1029,8 +1050,13 @@ class CoreWorker:
                 raise ObjectLostError(
                     f"object {ref.id} could not be pulled from {source}: {e}"
                 ) from None
-            name, size = loc
-            if zc and not name.startswith("@"):
+            name, size = loc[0], loc[1]
+            # a third "copy_only" element means the raylet granted a
+            # TRANSIENT pin (indefinite reader pins are at the
+            # max_pinned_fraction cap): copy out inside the bounded pin
+            # window instead of arming a finalizer-held zero-copy view
+            copy_only = len(loc) > 2 and loc[2] == "copy_only"
+            if zc and not copy_only and not name.startswith("@"):
                 value, ok = self._pinned_load(ref.id, name, size,
                                               pre_pinned=True)
                 if ok:
@@ -1039,7 +1065,8 @@ class CoreWorker:
                 continue
             # copy path: arena-resident objects (their slots recycle on
             # free, so views may only alias shm UNDER a pin — the pull
-            # reply's pin covers exactly this copy window) or zc disabled
+            # reply's pin covers exactly this copy window), pin-cap
+            # copy_only grants, or zc disabled
             try:
                 buf = attach_object(name, size)
             except FileNotFoundError as e:

@@ -34,9 +34,14 @@ def test_memory_reports_store_usage(gcs_address, capsys):
     ref = ray_tpu.put(np.zeros(200_000, np.float64))  # 1.6 MB -> plasma
     rc, out = _cli(capsys, "memory", "--address", gcs_address)
     assert rc == 0
-    stats = json.loads(out)
-    assert stats and stats[0]["num_objects"] >= 1
-    assert stats[0]["used_bytes"] > 1_000_000
+    payload = json.loads(out)
+    nodes = payload["nodes"]
+    assert nodes and nodes[0]["num_objects"] >= 1
+    assert nodes[0]["used_bytes"] > 1_000_000
+    storage = payload["storage"]
+    assert storage["used_bytes"] >= nodes[0]["used_bytes"]
+    assert storage["capacity_bytes"] > 0
+    assert storage["nodes_spill_degraded"] == []
     del ref
 
 

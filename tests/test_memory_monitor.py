@@ -47,6 +47,57 @@ def test_oversubscribed_fleet_completes(tight_memory_cluster):
     assert out == [i + 1 for i in range(8)]
 
 
+def test_producer_oom_kill_composes_with_spilling(tmp_path):
+    """OOM kill x storage failure domain: producers whose results keep the
+    object store past its spill threshold get SIGKILLed by the memory
+    monitor mid-storm — consumers' gets must still resolve with correct
+    values (retry + lineage), and the kill cooldown must pace the monitor
+    so retries get a window instead of a cascade through every innocent
+    worker."""
+    import numpy as np
+
+    cfg = get_config()
+    saved = (cfg.memory_monitor_worker_budget_bytes,
+             cfg.memory_usage_threshold, cfg.memory_monitor_refresh_ms,
+             cfg.memory_monitor_kill_cooldown_ms)
+    cfg.memory_monitor_worker_budget_bytes = 1 << 30
+    cfg.memory_usage_threshold = 0.9
+    cfg.memory_monitor_refresh_ms = 100
+    cfg.memory_monitor_kill_cooldown_ms = 2000
+    cluster = Cluster()
+    try:
+        # a 24 MiB store: the fleet's 3 MiB results keep it past the
+        # spill threshold, so kills land while spill/restore is active
+        raylet = cluster.add_node(num_cpus=4,
+                                  object_store_memory=24 << 20)
+        cluster.connect()
+
+        @ray_tpu.remote(max_retries=10)
+        def produce(i):
+            ballast = np.ones((450 << 20) // 8)  # oversubscribes ~2x
+            time.sleep(1.0)
+            return np.full(3 << 20, i % 251, dtype=np.uint8) \
+                + np.uint8(ballast[0] - 1)
+
+        refs = [produce.remote(i) for i in range(8)]
+        for i, r in enumerate(refs):
+            out = ray_tpu.get(r, timeout=300)
+            assert int(out[0]) == i % 251 and int(out[-1]) == i % 251
+        assert raylet.oom_kills_total >= 1, \
+            "the monitor never fired — nothing was composed"
+        assert raylet.store.stats()["spilled_bytes_total"] > 0, \
+            "the store never spilled — nothing was composed"
+        # cooldown paced the kills: with every task re-runnable in ~1 s
+        # and a 2 s cooldown, a healthy monitor needs FAR fewer kills
+        # than a cascade (which would burn one per refresh tick)
+        assert raylet.oom_kills_total <= 8
+    finally:
+        cluster.shutdown()
+        (cfg.memory_monitor_worker_budget_bytes,
+         cfg.memory_usage_threshold, cfg.memory_monitor_refresh_ms,
+         cfg.memory_monitor_kill_cooldown_ms) = saved
+
+
 def test_oom_error_when_retries_exhausted(tight_memory_cluster):
     """A non-retriable hog that ALWAYS trips the monitor must surface
     OutOfMemoryError, not hang or a bare crash."""

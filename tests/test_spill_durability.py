@@ -1,0 +1,412 @@
+"""Storage failure domain: checksummed spill envelope, verified restore
+-> typed loss, disk-full degradation ladder + self-heal, store-full
+admission, reader pin cap, `fs:<site>` fault rules, stale spill-dir
+reaper, and the get()-level regression (a damaged spill file surfaces a
+typed ObjectLostError or a reconstructed value — never a raw decode
+error)."""
+
+import os
+import subprocess
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import rpc
+from ray_tpu.core.cluster import Cluster
+from ray_tpu.core.config import get_config
+from ray_tpu.core.exceptions import ObjectLostError, ObjectStoreFullError
+from ray_tpu.core.ids import ObjectID, TaskID
+from ray_tpu.core.object_store import (SPILL_HEADER_SIZE, SPILL_MAGIC,
+                                       SharedObjectStore,
+                                       SpillCorruptionError,
+                                       spill_pack_header,
+                                       spill_read_verified,
+                                       sweep_stale_spill_dirs)
+
+
+def _oid(i):
+    return ObjectID.for_task_return(TaskID(b"s" * 16), i + 1)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    rpc.clear_fault_injector()
+    yield
+    rpc.clear_fault_injector()
+
+
+def _spilled_entries(store):
+    with store._lock:
+        return {oid: e.spilled_path for oid, e in store._entries.items()
+                if e.spilled_path is not None}
+
+
+def _fill(store, n=8, size=2 << 20, start=0):
+    """Put n payloads through the file path; under a tight capacity the
+    LRU head spills. Returns {oid: payload}."""
+    store.arena_threshold = 0
+    data = {}
+    for i in range(start, start + n):
+        oid = _oid(i)
+        payload = np.random.bytes(size)
+        data[oid] = payload
+        store.put_bytes(oid, payload)
+    return data
+
+
+# ---------------------------------------------------------------------------
+# envelope format + atomic commit
+
+
+def test_spill_envelope_roundtrip_and_atomic_commit(tmp_path):
+    store = SharedObjectStore(capacity=16 << 20, spill_dir=str(tmp_path))
+    try:
+        data = _fill(store)
+        spilled = _spilled_entries(store)
+        assert spilled, store.stats()
+        for oid, path in spilled.items():
+            with open(path, "rb") as f:
+                assert f.read(4) == SPILL_MAGIC
+            assert os.path.getsize(path) \
+                == SPILL_HEADER_SIZE + len(data[oid])
+            assert spill_read_verified(path) == data[oid]
+        # tmp write + fsync + os.replace: no half-committed files remain
+        assert not [p for p in os.listdir(tmp_path)
+                    if p.endswith(".tmp")]
+        st = store.stats()
+        assert st["spilled_bytes_total"] \
+            == sum(len(data[o]) for o in spilled)
+    finally:
+        store.shutdown()
+
+
+def test_envelope_header_pack_verify(tmp_path):
+    payload = np.frombuffer(b"\x07" * 4096, dtype=np.uint8)
+    path = tmp_path / "env"
+    with open(path, "wb") as f:
+        f.write(spill_pack_header(payload) + payload.tobytes())
+    assert spill_read_verified(str(path), expect_size=4096) \
+        == payload.tobytes()
+    with pytest.raises(SpillCorruptionError) as ei:
+        spill_read_verified(str(path), expect_size=4095)
+    assert ei.value.reason == "corrupt"
+    with pytest.raises(SpillCorruptionError) as ei:
+        spill_read_verified(str(tmp_path / "nope"))
+    assert ei.value.reason == "missing"
+
+
+# ---------------------------------------------------------------------------
+# verified restore: every defect is a TYPED loss, never corrupt bytes
+
+
+@pytest.mark.parametrize("damage,reason", [
+    ("truncate", "torn"), ("bitflip", "corrupt"), ("unlink", "missing")])
+def test_damaged_spill_is_typed_lost(tmp_path, damage, reason):
+    store = SharedObjectStore(capacity=16 << 20, spill_dir=str(tmp_path))
+    try:
+        _fill(store)
+        spilled = _spilled_entries(store)
+        oid, path = next(iter(spilled.items()))
+        if damage == "truncate":
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(size // 2)
+        elif damage == "bitflip":
+            with open(path, "r+b") as f:
+                f.seek(SPILL_HEADER_SIZE + 1000)
+                b = f.read(1)
+                f.seek(SPILL_HEADER_SIZE + 1000)
+                f.write(bytes([b[0] ^ 0x40]))
+        else:
+            os.unlink(path)
+        # lookup surfaces ABSENT (the caller's reconstruction hook), the
+        # entry is dropped, the corpse unlinked, the loss counted typed
+        assert store.lookup(oid) is None
+        loc, why = store.pin_ex(oid)
+        assert loc is None and why == "absent"
+        assert not os.path.exists(path)
+        st = store.stats()
+        assert st["lost_spills"] == 1
+        assert st["spill_failures"].get(reason) == 1
+        # healthy spilled neighbours still restore fine
+        for other in spilled:
+            if other != oid:
+                assert store.lookup(other) is not None
+                break
+    finally:
+        store.shutdown()
+
+
+def test_restore_fault_injection_marks_lost(tmp_path):
+    store = SharedObjectStore(capacity=16 << 20, spill_dir=str(tmp_path))
+    inj = rpc.install_fault_injector("", seed=3)
+    try:
+        _fill(store)
+        oid = next(iter(_spilled_entries(store)))
+        rule = inj.fs("spill_restore", "eio", prob=1.0)
+        assert store.lookup(oid) is None
+        rule.armed = False
+        assert store.stats()["lost_spills"] == 1
+    finally:
+        store.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# disk-full degradation ladder
+
+
+def test_enospc_fails_over_to_next_spill_dir(tmp_path):
+    cfg = get_config()
+    saved = cfg.object_spill_dirs
+    cfg.object_spill_dirs = str(tmp_path / "fallback")
+    try:
+        store = SharedObjectStore(capacity=16 << 20,
+                                  spill_dir=str(tmp_path / "primary"))
+        try:
+            # sabotage the primary: a FILE where the dir should be makes
+            # every write attempt fail with a real OSError
+            store.spill_dirs[0] = str(tmp_path / "blocked")
+            (tmp_path / "blocked").write_bytes(b"not a dir")
+            data = _fill(store)
+            spilled = _spilled_entries(store)
+            assert spilled
+            fallback_root = store.spill_dirs[1]
+            for oid, path in spilled.items():
+                assert path.startswith(fallback_root), path
+                assert store.read_bytes(oid) == data[oid]
+            st = store.stats()
+            assert st["spill_failures"].get("io", 0) > 0
+            assert not st["spill_degraded"]
+        finally:
+            store.shutdown()
+    finally:
+        cfg.object_spill_dirs = saved
+
+
+def test_all_dirs_failing_degrades_then_probe_heals(tmp_path):
+    cfg = get_config()
+    saved = cfg.spill_degraded_probe_period_s
+    cfg.spill_degraded_probe_period_s = 0.05
+    inj = rpc.install_fault_injector("", seed=0)
+    store = SharedObjectStore(capacity=8 << 20, spill_dir=str(tmp_path))
+    try:
+        store.arena_threshold = 0
+        rule = inj.fs("spill_write", "enospc", prob=1.0)
+        with pytest.raises(ObjectStoreFullError) as ei:
+            for i in range(8):
+                store.put_bytes(_oid(i), np.random.bytes(2 << 20))
+        assert "spill-degraded" in str(ei.value)
+        st = store.stats()
+        assert st["spill_degraded"] and st["degraded_enters"] == 1
+        assert st["spill_failures"].get("enospc", 0) > 0
+        # a bounded blocking put fails TYPED too while degraded
+        t0 = time.monotonic()
+        with pytest.raises(ObjectStoreFullError):
+            store.create_blocking(_oid(99), 2 << 20, timeout_s=0.3)
+        assert time.monotonic() - t0 < 5.0
+        # window lifts: the next allocation's probe heals and spilling
+        # resumes — the same put that failed now lands
+        rule.armed = False
+        time.sleep(0.1)
+        store.put_bytes(_oid(50), np.random.bytes(2 << 20))
+        st = store.stats()
+        assert not st["spill_degraded"] and st["degraded_heals"] == 1
+    finally:
+        store.shutdown()
+        cfg.spill_degraded_probe_period_s = saved
+
+
+# ---------------------------------------------------------------------------
+# store-full admission + bounded blocking
+
+
+def test_pinned_full_store_rejects_typed_then_unblocks(tmp_path):
+    store = SharedObjectStore(capacity=8 << 20, spill_dir=str(tmp_path))
+    try:
+        store.arena_threshold = 0
+        oids = [_oid(i) for i in range(3)]
+        for oid in oids:
+            store.put_bytes(oid, np.random.bytes(2 << 20))
+            assert store.pin(oid) is not None  # pinned: can't spill
+        with pytest.raises(ObjectStoreFullError) as ei:
+            store.create(_oid(10), 4 << 20)
+        assert store.stats()["put_backpressure"] >= 1
+        assert "pinned" in str(ei.value)
+        # an object bigger than capacity is fatal immediately
+        t0 = time.monotonic()
+        with pytest.raises(ObjectStoreFullError):
+            store.create_blocking(_oid(11), 16 << 20, timeout_s=30.0)
+        assert time.monotonic() - t0 < 5.0
+        # a waiter parked on the space condition resumes on unpin
+        def release():
+            time.sleep(0.3)
+            for oid in oids:
+                store.unpin(oid)
+
+        t = threading.Thread(target=release, daemon=True)
+        t.start()
+        shm = store.create_blocking(_oid(10), 4 << 20, timeout_s=10.0)
+        shm.close()
+        t.join()
+    finally:
+        store.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# reader pin cap
+
+
+def test_pin_cap_refuses_then_transient_copy_window(tmp_path):
+    cfg = get_config()
+    saved = cfg.max_pinned_fraction
+    cfg.max_pinned_fraction = 0.25  # 4 MiB of 16 MiB
+    store = SharedObjectStore(capacity=16 << 20, spill_dir=str(tmp_path))
+    try:
+        store.arena_threshold = 0
+        for i in range(3):
+            store.put_bytes(_oid(i), np.random.bytes(2 << 20))
+        assert store.pin(_oid(0)) is not None
+        assert store.pin(_oid(1)) is not None  # exactly at the cap
+        loc, why = store.pin_ex(_oid(2))
+        assert loc is None and why == "pin_cap"
+        assert store.stats()["pin_cap_refusals"] == 1
+        # transient (scoped) pins bypass the cap: the bounded copy window
+        loc = store.pin(_oid(2), transient=True)
+        assert loc is not None
+        store.unpin(_oid(2))
+        # a SECOND pin of an already-pinned entry is never refused
+        assert store.pin(_oid(0)) is not None
+        store.unpin(_oid(0))
+        store.unpin(_oid(0))
+        store.unpin(_oid(1))
+        assert store.stats()["pinned_bytes"] == 0
+    finally:
+        store.shutdown()
+        cfg.max_pinned_fraction = saved
+
+
+# ---------------------------------------------------------------------------
+# fs fault rule grammar
+
+
+def test_fs_fault_rule_parsing_and_runtime_install():
+    inj = rpc.FaultInjector("fs:spill_write:bitflip:0.5", seed=1)
+    r = inj.rules[0]
+    assert (r.action, r.method, r.fs_mode, r.prob) \
+        == ("fs", "spill_write", "bitflip", 0.5)
+    with pytest.raises(ValueError):
+        rpc.FaultInjector("fs:spill_write:melt")
+    with pytest.raises(ValueError):
+        rpc.FaultInjector("fs:spill_write")
+    # uninstalled: the module helper is a no-op returning None
+    assert rpc.fs_fault("spill_write") is None
+    inj = rpc.install_fault_injector("", seed=7)
+    rule = inj.fs("spill_restore", "torn", prob=1.0)
+    assert rpc.fs_fault("spill_restore") == "torn"
+    assert rpc.fs_fault("spill_write") is None  # site-scoped
+    rule.armed = False
+    assert rpc.fs_fault("spill_restore") is None
+    assert inj.stats["fs"] >= 1
+
+
+def test_fs_fault_probability_is_seeded():
+    outcomes = []
+    for _ in range(2):
+        inj = rpc.install_fault_injector("fs:spill_write:enospc:0.5",
+                                         seed=42)
+        outcomes.append([rpc.fs_fault("spill_write") for _ in range(32)])
+        rpc.clear_fault_injector()
+    assert outcomes[0] == outcomes[1]
+    assert any(outcomes[0]) and not all(outcomes[0])
+
+
+# ---------------------------------------------------------------------------
+# stale spill-dir reaper
+
+
+def test_sweep_stale_spill_dirs(tmp_path):
+    root = tmp_path / "spill"
+    root.mkdir()
+    proc = subprocess.Popen(["true"])
+    proc.wait()  # reaped: its pid is dead (reuse race is negligible here)
+    dead = root / str(proc.pid)
+    dead.mkdir()
+    (dead / "leftover").write_bytes(b"x" * 128)
+    live = root / str(os.getpid())
+    live.mkdir()
+    named = root / "not-a-pid"
+    named.mkdir()
+    removed = sweep_stale_spill_dirs(roots=[str(root)])
+    assert removed == [str(dead)]
+    assert not dead.exists()
+    assert live.exists() and named.exists()
+    # idempotent; a dir held by a LIVE pid is never touched
+    assert sweep_stale_spill_dirs(
+        roots=[str(root)], live_pids={os.getpid()}) == []
+
+
+# ---------------------------------------------------------------------------
+# get()-level regression: a damaged spill under a live cluster
+
+
+@pytest.fixture
+def tight_store_cluster():
+    cluster = Cluster()
+    raylet = cluster.add_node(num_cpus=2, object_store_memory=24 << 20)
+    cluster.connect()
+    yield raylet
+    cluster.shutdown()
+
+
+def _force_spill(raylet, oid, timeout=10.0):
+    """Push filler objects until `oid` moves to disk; returns its path."""
+    deadline = time.monotonic() + timeout
+    fillers = []
+    i = 0
+    while time.monotonic() < deadline:
+        with raylet.store._lock:
+            e = raylet.store._entries.get(oid)
+            assert e is not None, "object vanished while forcing a spill"
+            if e.spilled_path is not None:
+                return e.spilled_path
+        fillers.append(ray_tpu.put(np.random.bytes(3 << 20)))
+        i += 1
+    raise AssertionError(f"object never spilled after {i} filler puts")
+
+
+def test_get_of_truncated_spill_is_typed_not_raw(tight_store_cluster):
+    raylet = tight_store_cluster
+    ref = ray_tpu.put(np.random.bytes(3 << 20))  # driver put: no lineage
+    path = _force_spill(raylet, ref.id)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    # never a raw struct/ValueError out of the envelope decoder: the loss
+    # is detected, typed, and surfaced as ObjectLostError
+    with pytest.raises(ObjectLostError):
+        ray_tpu.get(ref, timeout=30)
+    assert raylet.store.stats()["lost_spills"] >= 1
+
+
+def test_get_of_corrupt_spill_reconstructs_task_output(
+        tight_store_cluster):
+    raylet = tight_store_cluster
+
+    @ray_tpu.remote(max_retries=4)
+    def make():
+        return np.full(3 << 20, 7, dtype=np.uint8)
+
+    ref = make.remote()
+    assert int(ray_tpu.get(ref, timeout=30)[0]) == 7
+    path = _force_spill(raylet, ref.id)
+    with open(path, "r+b") as f:
+        f.seek(SPILL_HEADER_SIZE + 500)
+        f.write(b"\xff")
+    # the spilled copy is LOST but the object has lineage: the get must
+    # resolve by re-executing the producing task, value intact
+    out = ray_tpu.get(ref, timeout=60)
+    assert out.shape == (3 << 20,) and int(out[0]) == 7 \
+        and int(out[-1]) == 7
+    assert raylet.store.stats()["lost_spills"] >= 1
