@@ -15,6 +15,7 @@
 #   ci/run_ci.sh --servebench # serving decode/prefill perf smoke only
 #   ci/run_ci.sh --trainstorm # RL fleet chaos (rollout->learner loop) only
 #   ci/run_ci.sh --memstorm   # store storm (storage failure domain) only
+#   ci/run_ci.sh --tracing    # traced serve storm (cluster timeline) only
 #
 # Stages:
 #   1. native      : arena + scheduler + token-loader compiled whole-program
@@ -82,13 +83,21 @@
 #                    over every surviving ref), untyped backpressure, or
 #                    failed post-heal convergence (restore-bandwidth FLOOR
 #                    lives in tests/test_envelope.py).
+#  13. tracing     : cluster-timeline acceptance — an untraced kill-free
+#                    baseline storm, then the same profile --traced: >=99%
+#                    of accepted requests must form complete correctly-
+#                    parented span chains across >=3 processes, the
+#                    fleet-merged chrome document must validate (monotone
+#                    ts, finite durs), post-alignment clock skew < 10 ms,
+#                    and the traced p50 must stay inside a loose overhead
+#                    budget vs the baseline.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGE="${1:-all}"
 
 run_native() {
-  echo "=== [1/12] native modules under ASan/UBSan ==="
+  echo "=== [1/13] native modules under ASan/UBSan ==="
   mkdir -p build
   g++ -std=c++17 -O1 -g -fsanitize=address,undefined \
       -fno-omit-frame-pointer -o build/sanitize_native \
@@ -100,7 +109,7 @@ run_native() {
 }
 
 run_fast() {
-  echo "=== [2/12] fast test tier ==="
+  echo "=== [2/13] fast test tier ==="
   python -m pytest tests/ -q
   # core-primitives smoke: the submission AND completion hot paths
   # (function table, event batching, batched result delivery, put/get)
@@ -127,7 +136,7 @@ EOF
 }
 
 run_stress() {
-  echo "=== [3/12] actor ordering stress x20 ==="
+  echo "=== [3/13] actor ordering stress x20 ==="
   for i in $(seq 1 20); do
     python -m pytest tests/test_actor_ordering_stress.py -q -x \
       || { echo "ordering stress failed on iteration $i"; exit 1; }
@@ -135,7 +144,7 @@ run_stress() {
 }
 
 run_chaos() {
-  echo "=== [4/12] control-plane HA chaos suite ==="
+  echo "=== [4/13] control-plane HA chaos suite ==="
   # Deterministic fault injection: pin + print the seed so a red run
   # replays the same chaos schedule (override by exporting the variable;
   # timing-dependent counters can still drift between runs).
@@ -152,7 +161,7 @@ run_chaos() {
 }
 
 run_serve_storm() {
-  echo "=== [5/12] serve traffic-storm chaos ==="
+  echo "=== [5/13] serve traffic-storm chaos ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -168,7 +177,7 @@ run_serve_storm() {
 }
 
 run_burst() {
-  echo "=== [6/12] warm-pool elasticity burst ==="
+  echo "=== [6/13] warm-pool elasticity burst ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "burst seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -193,7 +202,7 @@ run_burst() {
 }
 
 run_head_failover() {
-  echo "=== [7/12] standby-head kill-and-promote storm ==="
+  echo "=== [7/13] standby-head kill-and-promote storm ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -212,7 +221,7 @@ run_head_failover() {
 }
 
 run_node_chaos() {
-  echo "=== [8/12] multi-node kill storm (node failure domain) ==="
+  echo "=== [8/13] multi-node kill storm (node failure domain) ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "node storm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -232,7 +241,7 @@ run_node_chaos() {
 }
 
 run_partition_storm() {
-  echo "=== [9/12] partition-heal storm (partition failure domain) ==="
+  echo "=== [9/13] partition-heal storm (partition failure domain) ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "partition storm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -254,7 +263,7 @@ run_partition_storm() {
 }
 
 run_servebench() {
-  echo "=== [10/12] serving perf smoke (servebench quick) ==="
+  echo "=== [10/13] serving perf smoke (servebench quick) ==="
   # Quick profile of python -m ray_tpu.models.servebench: fused-decode
   # tokens/s + the 1/4/8 slot sweep table, w8a16 logits-parity row,
   # batched bucketed prefill, and p50/p99 request latency under the storm
@@ -268,7 +277,7 @@ run_servebench() {
 }
 
 run_trainstorm() {
-  echo "=== [11/12] RL fleet chaos (trainstorm quick) ==="
+  echo "=== [11/13] RL fleet chaos (trainstorm quick) ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "trainstorm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -299,7 +308,7 @@ EOF
 }
 
 run_memstorm() {
-  echo "=== [12/12] store storm (storage failure domain, memstorm quick) ==="
+  echo "=== [12/13] store storm (storage failure domain, memstorm quick) ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "memstorm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -333,6 +342,66 @@ EOF
   rm -f "$ms_json"
 }
 
+run_tracing() {
+  echo "=== [13/13] cluster timeline: traced serve storm ==="
+  : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
+  export RAY_TPU_FAULT_INJECTION_SEED
+  echo "tracing seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
+  # Two runs of the SAME quick kill-free storm profile: an untraced
+  # baseline for the overhead bound, then --traced, where every accepted
+  # request must form a complete correctly-parented span chain across >=3
+  # processes (proxy/driver -> replica -> nested-task worker; the storm
+  # itself exits nonzero below 99%) and the fleet-merged chrome document
+  # must validate. The overhead bound is deliberately loose (2.5x + 150 ms
+  # on p50): the traced run adds a nested task per request on top of the
+  # span bookkeeping, and CI boxes are noisy — it exists to catch a
+  # tracing hot path gone accidentally O(heavy), not to benchmark.
+  base_json="$(mktemp /tmp/ray_tpu_tracing_base.XXXXXX.json)"
+  traced_json="$(mktemp /tmp/ray_tpu_tracing_run.XXXXXX.json)"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m ray_tpu.serve.storm \
+    --quick --kill-period 0 --seed "${RAY_TPU_FAULT_INJECTION_SEED}" \
+    --json "$base_json" \
+    || { echo "tracing baseline storm failed (seed ${RAY_TPU_FAULT_INJECTION_SEED})"
+         exit 1; }
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m ray_tpu.serve.storm \
+    --quick --traced --seed "${RAY_TPU_FAULT_INJECTION_SEED}" \
+    --json "$traced_json" \
+    || { echo "traced storm failed (seed ${RAY_TPU_FAULT_INJECTION_SEED})"
+         exit 1; }
+  BASE_JSON="$base_json" TRACED_JSON="$traced_json" python - <<'EOF'
+import json, os
+from ray_tpu.util import timeline
+
+base = json.load(open(os.environ["BASE_JSON"]))
+art = json.load(open(os.environ["TRACED_JSON"]))
+tr = art.get("tracing")
+assert tr and tr.get("enabled"), "traced artifact has no tracing block"
+assert "tracing" not in base, "baseline ran traced — overhead bound is void"
+assert tr["cross3_fraction"] >= 0.99, \
+    f"complete >=3-process chains: {tr['cross3_fraction']:.1%} < 99%"
+assert tr["clock_sources"] >= 3, \
+    f"only {tr['clock_sources']} clock sources reported"
+assert tr["max_abs_clock_offset_us"] < 10_000, \
+    f"post-alignment clock skew {tr['max_abs_clock_offset_us']}us >= 10ms"
+# re-validate the chrome document from disk: JSON-parseable, every event
+# carrying name/ph/ts/pid/tid, "X" durs finite, ts monotone in file order
+doc = json.load(open(tr["chrome_path"]))
+problems = timeline.validate_chrome(doc)
+assert not problems, f"chrome trace invalid: {problems[:5]}"
+assert len(doc["traceEvents"]) == tr["chrome_events"]
+b, t = (base["latency_ms"]["p50_accepted"], art["latency_ms"]["p50_accepted"])
+budget = b * 2.5 + 150.0
+assert t <= budget, f"traced p50 {t}ms blows overhead budget {budget:.0f}ms " \
+    f"(untraced baseline {b}ms)"
+print(f"tracing stage ok: {tr['chains_3plus_processes']}/{tr['accepted_traced']} "
+      f"chains across >=3 processes, {tr['clock_sources']} clock sources "
+      f"(max offset {tr['max_abs_clock_offset_us']/1000:.2f}ms), "
+      f"{tr['chrome_events']} chrome events, "
+      f"p50 {t}ms vs untraced {b}ms (budget {budget:.0f}ms)")
+EOF
+  rm -f "$base_json" "$traced_json" "$traced_json.trace.json"
+}
+
 case "$STAGE" in
   --native)     run_native ;;
   --fast)       run_fast ;;
@@ -346,12 +415,13 @@ case "$STAGE" in
   --servebench) run_servebench ;;
   --trainstorm) run_trainstorm ;;
   --memstorm)   run_memstorm ;;
+  --tracing)    run_tracing ;;
   all)        run_native; run_fast; run_stress; run_chaos; run_serve_storm
               run_burst; run_head_failover; run_node_chaos
               run_partition_storm; run_servebench; run_trainstorm
-              run_memstorm ;;
+              run_memstorm; run_tracing ;;
   *) echo "unknown stage: $STAGE" \
-     "(use --native|--fast|--stress|--chaos|--storm|--burst|--failover|--node-chaos|--partition|--servebench|--trainstorm|--memstorm)" >&2
+     "(use --native|--fast|--stress|--chaos|--storm|--burst|--failover|--node-chaos|--partition|--servebench|--trainstorm|--memstorm|--tracing)" >&2
      exit 2 ;;
 esac
 echo "CI green"

@@ -90,6 +90,15 @@ def main(argv=None) -> int:
     p_tl.add_argument("--output", default="timeline.json")
     p_tl.add_argument("--address", default=None,
                       help="GCS address: include cluster-wide worker spans")
+    p_tl.add_argument("--trace", default=None, metavar="TRACE_ID",
+                      help="one causal tree only (requires --address); "
+                           "'list' prints recent trace ids instead")
+
+    p_tr = sub.add_parser(
+        "trace", help="critical-path breakdown of one task "
+        "(submit -> lease -> dispatch -> run -> result-deliver)")
+    p_tr.add_argument("task_id", help="task id hex (ray_tpu list tasks)")
+    p_tr.add_argument("--address", required=True)
 
     p_mem = sub.add_parser("memory", help="object store usage per node")
     p_mem.add_argument("--address", required=True)
@@ -267,19 +276,90 @@ def main(argv=None) -> int:
         return env_main(argv)
 
     if args.cmd == "timeline":
+        from ray_tpu.util import timeline as _timeline
         from ray_tpu.util import tracing
 
+        if args.trace and not args.address:
+            print("--trace requires --address", file=sys.stderr)
+            return 2
         extra = []
+        offsets = {}
         if args.address:
             from ray_tpu.core import rpc as _rpc
 
             gcs = _rpc.connect_with_retry(args.address, timeout=5)
             try:
+                if args.trace == "list":
+                    for t in gcs.call("list_traces", {"limit": 50},
+                                      timeout=10):
+                        print(f"{t['trace_id']}  spans={t['spans']:<6d} "
+                              f"last_ts_us={t['last_ts_us']:.0f}")
+                    return 0
+                if args.trace:
+                    reply = gcs.call("get_trace",
+                                     {"trace_id": args.trace}, timeout=10)
+                    doc = _timeline.merge_chrome(reply["spans"],
+                                                 reply.get("offsets"))
+                    with open(args.output, "w") as f:
+                        json.dump(doc, f)
+                    print(f"wrote {args.output} "
+                          f"({len(doc['traceEvents'])} spans of trace "
+                          f"{args.trace})")
+                    return 0
                 extra = gcs.call("get_profile_events", timeout=10)
+                offsets = gcs.call("get_span_offsets", timeout=10)
             finally:
                 gcs.close()
-        tracing.dump(args.output, extra_events=extra)
+        # fleet-merged dump: local ring + every span the GCS holds, clock-
+        # aligned per source and time-sorted into one chrome document
+        doc = _timeline.merge_chrome(
+            tracing.get_events() + list(extra or []), offsets)
+        with open(args.output, "w") as f:
+            json.dump(doc, f)
         print(f"wrote {args.output}")
+        return 0
+
+    if args.cmd == "trace":
+        from ray_tpu.core import rpc as _rpc
+        from ray_tpu.util import timeline as _timeline
+
+        gcs = _rpc.connect_with_retry(args.address, timeout=5)
+        try:
+            reply = gcs.call("get_trace", {"task_id": args.task_id},
+                             timeout=10)
+            stats = gcs.call("gcs_stats", timeout=10)
+        finally:
+            gcs.close()
+        spans = _timeline.apply_offsets(reply.get("spans") or [],
+                                        reply.get("offsets") or {})
+        segs = _timeline.stage_segments(spans, args.task_id)
+        if not segs:
+            print(f"no trace recorded for task {args.task_id} "
+                  f"(is tracing on? RAY_TPU_TRACING_ENABLED=1)",
+                  file=sys.stderr)
+            return 1
+        t0 = min(s[1] for s in segs)
+        t_end = max(s[1] + s[2] for s in segs)
+        print(f"task {args.task_id} (trace {reply.get('trace_id')}): "
+              f"{(t_end - t0) / 1e3:.2f} ms submit -> result-deliver")
+        prev_end = None
+        for stage, start, dur in segs:
+            gap = ""
+            if prev_end is not None and start - prev_end > 50:
+                gap = f"  (+{(start - prev_end) / 1e3:.2f} ms between)"
+            print(f"  {stage:<15s} +{(start - t0) / 1e3:9.2f} ms  "
+                  f"{dur / 1e3:8.2f} ms{gap}")
+            prev_end = start + dur
+        stage_lat = ((stats.get("tracing") or {})
+                     .get("stage_latency_us") or {})
+        if stage_lat:
+            print("fleet stage latency, p50/p99 ms:")
+            for stage in _timeline.STAGE_ORDER:
+                s = stage_lat.get(stage)
+                if s:
+                    print(f"  {stage:<15s} "
+                          f"{s['p50_us'] / 1e3:8.2f} / "
+                          f"{s['p99_us'] / 1e3:8.2f}  (n={s['count']})")
         return 0
 
     if args.cmd in ("memory", "stack", "healthcheck", "global-gc",

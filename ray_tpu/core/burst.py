@@ -168,6 +168,19 @@ def _idle_worker_count() -> int:
     return sum(1 for w in _list_workers() if w.get("idle"))
 
 
+def _flight_record(out_path: Optional[str], violations: List[str],
+                   reason: str = "violations") -> None:
+    """On a failed storm, dump the flight record (last
+    tracing_flight_recorder_window_s of spans + metrics snapshot) next to
+    the artifact — the context the aggregate numbers lack. No-op when the
+    storm passed or writes no artifact."""
+    if not out_path or not violations:
+        return
+    from ray_tpu.util.flight_recorder import dump_flight_record
+
+    dump_flight_record(out_path, violations, reason=reason)
+
+
 def run_burst(profile: Optional[BurstProfile] = None,
               out_path: Optional[str] = None) -> Dict[str, Any]:
     """Run one burst on the CURRENT cluster (caller already init'd).
@@ -331,6 +344,7 @@ def run_burst(profile: Optional[BurstProfile] = None,
         with open(out_path, "w") as f:
             json.dump(result, f, indent=2)
             f.write("\n")
+    _flight_record(out_path, violations)
     return result
 
 
@@ -519,14 +533,24 @@ def run_node_storm(profile: Optional[NodeStormProfile] = None,
         recovered = 0
         settle_deadline = time.monotonic() + p.settle_timeout_s
         last_err: Dict[int, str] = {}
+        watchdog_recorder: Optional[threading.Timer] = None
         if os.environ.get("RAY_TPU_NODE_STORM_DUMP_STACKS"):
             # watchdog: if the settle phase wedges (a ping .remote() or
             # get() blocking past its budget), dump every thread so the
-            # stuck frame is named instead of inferred
+            # stuck frame is named instead of inferred — and the flight
+            # record too, since a hang means the violations path that
+            # normally dumps it may never run
             import faulthandler
 
             faulthandler.dump_traceback_later(
                 p.settle_timeout_s * 0.8, exit=False, file=sys.stderr)
+            if out_path:
+                watchdog_recorder = threading.Timer(
+                    p.settle_timeout_s * 0.8,
+                    _flight_record, (out_path, ["settle phase wedged"],
+                                     "watchdog"))
+                watchdog_recorder.daemon = True
+                watchdog_recorder.start()
         pending = [(a, a.ping.remote()) for a in fleet]
         while pending and time.monotonic() < settle_deadline:
             retry = []
@@ -571,6 +595,8 @@ def run_node_storm(profile: Optional[NodeStormProfile] = None,
             import faulthandler
 
             faulthandler.cancel_dump_traceback_later()
+        if watchdog_recorder is not None:
+            watchdog_recorder.cancel()
         load_counts = load.stop()
         load = None  # stopped; the finally must not re-join it
         if load_counts["hung"]:
@@ -672,6 +698,7 @@ def run_node_storm(profile: Optional[NodeStormProfile] = None,
             with open(out_path, "w") as f:
                 json.dump(result, f, indent=2)
                 f.write("\n")
+        _flight_record(out_path, violations)
         return result
     finally:
         if load is not None:
@@ -1267,6 +1294,7 @@ def run_partition_storm(profile: Optional[PartitionStormProfile] = None,
             with open(out_path, "w") as f:
                 json.dump(result, f, indent=2)
                 f.write("\n")
+        _flight_record(out_path, violations)
         return result
     finally:
         if load is not None:
@@ -1553,6 +1581,7 @@ def run_cross_node_burst(profile: Optional[CrossNodeBurstProfile] = None,
             with open(out_path, "w") as f:
                 json.dump(result, f, indent=2)
                 f.write("\n")
+        _flight_record(out_path, violations)
         return result
     finally:
         if load is not None:

@@ -183,6 +183,29 @@ class Config:
     # bounded buffer: oldest events drop (counted) beyond this
     task_events_max_buffer_size: int = 10000
 
+    # --- distributed tracing (util/tracing.py; cf. reference ProfileEvent
+    # + opt-in OpenTelemetry context propagation) ---
+    # Default-off master switch for trace-CONTEXT propagation: when on,
+    # submits stamp (trace_id, parent span_id) into every TaskSpec, the
+    # serve path and rollout->learner loop carry the same context, and the
+    # raylet ships its lease spans. Local chrome-trace spans record either
+    # way — the knob only gates the cross-process causal tree, so the
+    # default keeps the task hot path free of any per-submit id minting.
+    tracing_enabled: bool = False
+    # in-process span ring bound (mirrors task_events_max_buffer_size):
+    # oldest spans drop (counted; the count rides the next task-events
+    # flush) so fork-template replicas / learner actors can't grow forever
+    tracing_max_buffer_size: int = 20000
+    # GCS-side trace ring: distinct trace_ids retained (oldest evicted)
+    tracing_max_traces: int = 2000
+    # NTP-style clock probe against the GCS (offset = t1 - (t0+t2)/2 from
+    # one RPC round-trip): re-estimated at this period per process, shipped
+    # with each task-events flush for merge-time alignment
+    tracing_clock_probe_period_s: float = 30.0
+    # storm flight recorder: seconds of span history dumped next to the
+    # artifact when a harness violation fires
+    tracing_flight_recorder_window_s: float = 30.0
+
     # --- completion-path fast lanes ---
     # Executor-side ResultBuffer (result_buffer.py): while a delivery is in
     # flight, further results batch per owner until this interval's edge;

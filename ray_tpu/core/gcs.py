@@ -17,6 +17,7 @@ import os
 import logging
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.core import rpc
@@ -304,6 +305,17 @@ class GcsServer:
         self._max_task_events = 10000
         self._task_counts = {"submitted": 0, "finished": 0, "failed": 0}
         self._profile_events: List[dict] = []
+
+        # distributed tracing (observability plane): spans carrying a
+        # trace_id index into a bounded ring of traces (oldest trace
+        # evicted whole); per-source clock offsets from worker clock
+        # probes align the merged timeline; per-stage latencies feed the
+        # p50/p99 roll-up in gcs_stats
+        self._traces: "OrderedDict[str, List[dict]]" = OrderedDict()
+        self._traces_evicted = 0
+        self._spans_dropped = 0       # worker-side ring overflow, summed
+        self._span_clock_offsets: Dict[str, float] = {}  # src -> offset_us
+        self._stage_lat_us: Dict[str, List[float]] = {}
 
         # pubsub: channel -> list[ServerConnection]
         self._subs: Dict[str, List[rpc.ServerConnection]] = {}
@@ -1912,6 +1924,27 @@ class GcsServer:
                      "delta_enabled":
                          get_config().resource_broadcast_delta_enabled}
             joins = list(self._warm_lease_joins)
+            # observability plane: span shipping + per-stage critical-path
+            # latency roll-up (submit/lease/dispatch/run/result-deliver)
+            from ray_tpu.util.stats import percentile as _pct
+
+            stage_lat = {}
+            for stage, window in self._stage_lat_us.items():
+                vals = sorted(window)
+                stage_lat[stage] = {
+                    "count": len(vals),
+                    "p50_us": round(_pct(vals, 0.50) or 0.0, 1),
+                    "p99_us": round(_pct(vals, 0.99) or 0.0, 1),
+                }
+            tracing_blk = {
+                "enabled": get_config().tracing_enabled,
+                "traces": len(self._traces),
+                "traces_evicted": self._traces_evicted,
+                "spans_buffered": len(self._profile_events),
+                "spans_dropped": self._spans_dropped,
+                "clock_sources": len(self._span_clock_offsets),
+                "stage_latency_us": stage_lat,
+            }
             node_failure = {
                 "deaths_by_reason": dict(self._node_deaths),
                 "deaths_total": sum(self._node_deaths.values()),
@@ -1954,6 +1987,7 @@ class GcsServer:
             "broadcast": bcast,
             "node_failure": node_failure,
             "storage": storage,
+            "tracing": tracing_blk,
             "promotion": dict(self.promotion) if self.promotion else None,
         }
 
@@ -2043,6 +2077,13 @@ class GcsServer:
             # events the WORKER dropped (its bounded buffer overflowed) are
             # history lost forever, same class as our ring eviction
             self._task_events_dropped += int(payload.get("dropped", 0))
+            # spans the worker's tracing ring dropped: same honesty
+            # contract for the timeline (surfaced in gcs_stats)
+            self._spans_dropped += int(payload.get("spans_dropped", 0))
+            src = payload.get("src")
+            offset = payload.get("clock_offset_us")
+            if src and offset is not None:
+                self._span_clock_offsets[src] = float(offset)
             profile = payload.get("profile_events")
             if profile:
                 self._append_profile_events(profile)
@@ -2069,12 +2110,37 @@ class GcsServer:
             out.append({"__truncated__": dropped})
         return out
 
+    # stages of the per-task critical path (span categories); each keeps a
+    # bounded latency window for the p50/p99 roll-up in gcs_stats
+    _TRACE_STAGES = ("task_submit", "task_lease", "task_dispatch",
+                     "task_execution", "task_result")
+    _STAGE_WINDOW = 10_000
+
     def _append_profile_events(self, events) -> None:
         """Caller holds self._lock. Capped ring so the GCS can't grow
-        unboundedly."""
+        unboundedly. Spans carrying a trace_id additionally index into the
+        per-trace ring (whole-trace eviction, oldest first) and feed the
+        per-stage latency windows."""
         self._profile_events.extend(events)
         if len(self._profile_events) > 100_000:
             self._profile_events = self._profile_events[-100_000:]
+        max_traces = max(1, get_config().tracing_max_traces)
+        for e in events:
+            tid = e.get("trace_id")
+            if tid:
+                spans = self._traces.get(tid)
+                if spans is None:
+                    while len(self._traces) >= max_traces:
+                        self._traces.popitem(last=False)
+                        self._traces_evicted += 1
+                    spans = self._traces[tid] = []
+                spans.append(e)
+            cat = e.get("cat")
+            if cat in self._TRACE_STAGES and "dur" in e:
+                window = self._stage_lat_us.setdefault(cat, [])
+                window.append(float(e["dur"]))
+                if len(window) > self._STAGE_WINDOW:
+                    del window[:len(window) - self._STAGE_WINDOW]
 
     def rpc_profile_events(self, conn, req_id, payload):
         """Chrome-trace spans shipped by workers (reference ProfileEvent
@@ -2087,6 +2153,55 @@ class GcsServer:
     def rpc_get_profile_events(self, conn, req_id, payload):
         with self._lock:
             return list(self._profile_events)
+
+    # ------------------------------------------------------------- tracing
+    def rpc_clock_probe(self, conn, req_id, payload):
+        """Server-side wall stamp for NTP-style offset estimation: the
+        caller brackets this call with local stamps t0/t2 and computes
+        offset = t1 - (t0 + t2) / 2 (task_events.py). The GCS clock is the
+        fleet's reference frame for merged timelines."""
+        return {"t1_us": time.time() * 1e6}
+
+    def rpc_get_span_offsets(self, conn, req_id, payload):
+        """Per-source clock offsets (src hex -> offset_us vs this GCS),
+        applied at merge time to align spans from different nodes."""
+        with self._lock:
+            return dict(self._span_clock_offsets)
+
+    def rpc_get_trace(self, conn, req_id, payload):
+        """Spans of one causal tree, by trace_id or by task_id (any span
+        whose trace contains the task). Returns spans + the offsets needed
+        to align them."""
+        payload = payload or {}
+        trace_id = payload.get("trace_id")
+        task_id = payload.get("task_id")
+        with self._lock:
+            spans: List[dict] = []
+            if trace_id:
+                spans = list(self._traces.get(trace_id, ()))
+            elif task_id:
+                for tid, tspans in self._traces.items():
+                    if any((s.get("args") or {}).get("task_id") == task_id
+                           for s in tspans):
+                        trace_id = tid
+                        spans = list(tspans)
+                        break
+            return {"trace_id": trace_id, "spans": spans,
+                    "offsets": dict(self._span_clock_offsets)}
+
+    def rpc_list_traces(self, conn, req_id, payload):
+        """Newest-first trace summaries for `ray_tpu timeline --trace`
+        discovery."""
+        limit = (payload or {}).get("limit", 50)
+        with self._lock:
+            items = list(self._traces.items())[-limit:]
+        out = []
+        for tid, spans in reversed(items):
+            ts = [s.get("ts", 0) for s in spans]
+            out.append({"trace_id": tid, "spans": len(spans),
+                        "first_ts_us": min(ts) if ts else 0,
+                        "last_ts_us": max(ts) if ts else 0})
+        return out
 
     def rpc_task_counts(self, conn, req_id, payload):
         """Cumulative task totals (unwindowed, unlike list_task_events)."""

@@ -117,6 +117,7 @@ def main(argv=None) -> None:
         object_store_memory=args.object_store_memory,
     )
     raylet.allow_chaos_kill = True  # standalone daemon: kill-random-node ok
+    raylet.ship_spans = True        # no worker buffer here: ship our ring
     raylet.start()
     print(f"raylet started on node {raylet.node_id.hex()[:12]} "
           f"({raylet.address})")

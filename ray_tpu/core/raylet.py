@@ -32,6 +32,7 @@ from ray_tpu.core.object_store import (SharedObjectStore,
 from ray_tpu.core.scheduler import NodeView, SchedulingPolicy
 from ray_tpu.core.runtime_env_manager import env_key as _env_key
 from ray_tpu.core.task_spec import TaskSpec, TaskType
+from ray_tpu.util import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -63,6 +64,9 @@ class WorkerHandle:
 class _QueuedTask:
     spec: TaskSpec
     spillback_count: int = 0
+    # enqueue stamp (tracing epoch-us) for the lease span: submit-arrival to
+    # worker-grant is the queueing stage of the critical path. 0.0 = untraced.
+    queued_us: float = 0.0
 
 
 class _PullBudget:
@@ -228,6 +232,14 @@ class Raylet:
         # carries reason="oom" so exhausted retries surface OutOfMemoryError
         self._oom_killed: set = set()
         self.oom_kills_total = 0  # monotonic; read by memstorm/tests
+        # Raylets have no TaskEventBuffer (that is a worker-side object), so
+        # lease spans ship on the heartbeat cadence via the same
+        # task_events_batch channel: drain cursor + carry-over drop count +
+        # NTP-style clock offset, mirroring task_events.py.
+        self._spans_sent = 0
+        self._spans_dropped_pending = 0
+        self._clock_offset_us: Optional[float] = None
+        self._clock_probe_at = 0.0
         self._shutdown = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -855,6 +867,51 @@ class Raylet:
             except Exception:
                 if not self._shutdown.is_set():
                     logger.exception("periodic schedule retry failed")
+            try:
+                self._ship_spans()
+            except Exception:
+                logger.debug("raylet span flush failed", exc_info=True)
+
+    def _ship_spans(self) -> None:
+        """Flush locally recorded spans (lease spans, mostly) to the GCS on
+        the heartbeat cadence via the task_events_batch channel — the raylet
+        process has no TaskEventBuffer, so it ships its own tracing ring."""
+        if not self.ship_spans or not tracing.enabled():
+            return
+        fresh, self._spans_sent, spans_dropped = tracing.drain(self._spans_sent)
+        spans_dropped += self._spans_dropped_pending
+        self._spans_dropped_pending = 0
+        if not fresh and not spans_dropped:
+            return
+        now = time.monotonic()
+        if self._clock_offset_us is None or now >= self._clock_probe_at:
+            self._clock_probe_at = now + max(
+                1.0, get_config().tracing_clock_probe_period_s)
+            try:
+                t0 = time.time() * 1e6
+                reply = self._gcs.call("clock_probe", timeout=2)
+                t2 = time.time() * 1e6
+                self._clock_offset_us = reply["t1_us"] - (t0 + t2) / 2.0
+            except Exception:
+                logger.debug("raylet clock probe failed", exc_info=True)
+        src = self.node_id.hex()
+        payload = {
+            "events": [],
+            "dropped": 0,
+            "src": src,
+            "spans_dropped": spans_dropped,
+            "profile_events": [{**e, "_src": src} for e in fresh],
+        }
+        if self._clock_offset_us is not None:
+            payload["clock_offset_us"] = self._clock_offset_us
+        try:
+            delivered = self._gcs.try_notify("task_events_batch", payload)
+        except Exception:
+            delivered = False
+        if not delivered:
+            # spans are best-effort but their drop count is not (it is the
+            # only record they existed) — re-ride it on the next heartbeat
+            self._spans_dropped_pending += spans_dropped
 
     def _report_resources(self) -> None:
         """Debounced resource broadcast: at most one GCS notify per
@@ -1425,6 +1482,12 @@ class Raylet:
     # exit would take the driver down with it.
     allow_chaos_kill = False
 
+    # set True by node_main: a STANDALONE raylet process ships its own
+    # tracing ring (it has no worker-side TaskEventBuffer). In-process
+    # raylets must leave shipping to the driver worker's buffer — two
+    # drain cursors on one process-wide ring would double-ship every span.
+    ship_spans = False
+
     def rpc_worker_log(self, conn, req_id, payload):
         """Worker stdout/stderr lines -> GCS CH_LOGS fan-out."""
         payload = dict(payload)
@@ -1453,8 +1516,11 @@ class Raylet:
         return True
 
     def _submit(self, spec: TaskSpec, spillback_count: int) -> None:
+        qt = _QueuedTask(spec, spillback_count)
+        if spec.trace_ctx is not None:
+            qt.queued_us = tracing.now_us()
         with self._lock:
-            self._queue.append(_QueuedTask(spec, spillback_count))
+            self._queue.append(qt)
             # Deep-queue regime: a FIFO submission behind >SCAN_MAX blocked
             # tickets cannot dispatch before them, and every event that
             # frees capacity (task done, worker ready, resource update)
@@ -1577,8 +1643,22 @@ class Raylet:
                 tpu_amount = demand.get("TPU", 0.0)
                 tpu_ids = self._assign_tpus(tpu_amount)
                 handle.tpu_grant = (tpu_ids, tpu_amount)
-                handle.conn.push("execute_task", {
-                    "spec": spec, "tpu_ids": tpu_ids or []})
+                push_payload = {"spec": spec, "tpu_ids": tpu_ids or []}
+                if spec.trace_ctx is not None and qt.queued_us:
+                    # lease span: queue-arrival -> worker grant, parented
+                    # under the submitter's span; dispatch_us lets the
+                    # executor open its dispatch span where the lease ends
+                    # (push-to-run gap = worker wakeup + arg resolution)
+                    t_now = tracing.now_us()
+                    tracing.add_complete(
+                        f"lease::{spec.method_name}", "task_lease",
+                        qt.queued_us, t_now - qt.queued_us,
+                        trace_id=spec.trace_ctx[0],
+                        parent_id=spec.trace_ctx[1],
+                        task_id=spec.task_id.binary().hex(),
+                        node_id=self.node_id.hex())
+                    push_payload["dispatch_us"] = t_now
+                handle.conn.push("execute_task", push_payload)
                 dispatched_any = True
             if self._queue:
                 # Early break with an unexamined tail: the blocked head

@@ -476,6 +476,33 @@ def get_fault_injector() -> Optional[FaultInjector]:
         _fault_checked = True
         return _fault_injector
 
+# ------------------------------------------------------ rpc latency metrics
+# One central instrumentation site for EVERY request/reply RPC in the
+# system (reference: per-service gRPC latency metrics; here the single
+# client-send boundary makes one histogram cover them all). Observed on
+# the reply via a Future callback, so the send path pays one perf_counter
+# read; exported through the standard Prometheus registry and scraped by
+# the dashboard like any other series.
+_rpc_latency_hist = None
+
+
+def _observe_rpc_latency(method: str, seconds: float) -> None:
+    global _rpc_latency_hist
+    try:
+        h = _rpc_latency_hist
+        if h is None:
+            from ray_tpu.util.metrics import get_or_create
+
+            h = _rpc_latency_hist = get_or_create(
+                "histogram", "ray_tpu_rpc_latency_seconds",
+                "request/reply RPC round-trip latency by method",
+                boundaries=(0.0005, 0.002, 0.01, 0.05, 0.25, 1, 5, 30),
+                tag_keys=("method",))
+        h.observe(seconds, tags={"method": method})
+    except Exception:  # metrics must never fail an RPC
+        logger.debug("rpc latency observe failed", exc_info=True)
+
+
 _HDR = struct.Struct("!BQI")  # type, request_id, method-name length
 
 
@@ -764,6 +791,10 @@ class RpcClient:
         except Exception:
             self._pending.pop(req_id, None)
             raise
+        t0 = time.perf_counter()
+        fut.add_done_callback(
+            lambda f, m=method, t=t0: _observe_rpc_latency(
+                m, time.perf_counter() - t))
         return fut
 
     def call(self, method: str, payload: Any = None, timeout: Optional[float] = None) -> Any:

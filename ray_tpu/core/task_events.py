@@ -31,8 +31,18 @@ class TaskEventBuffer:
         self._lock = threading.Lock()
         self._events: deque = deque()
         self._dropped = 0
-        # cursor into tracing.get_events() — spans before it were shipped
+        # drain cursor into the tracing ring (sequence number, not a list
+        # index — survives ring overflow between flushes)
         self._profile_sent = 0
+        # spans the tracing ring dropped but whose count failed delivery —
+        # re-shipped with the next flush so truncation stays honest
+        self._spans_dropped_pending = 0
+        # NTP-style clock offset vs the GCS (tracing_enabled only):
+        # offset_us = t1 - (t0 + t2) / 2 from one clock_probe round-trip,
+        # re-estimated every tracing_clock_probe_period_s and shipped with
+        # each flush for merge-time cross-node alignment
+        self._clock_offset_us: Optional[float] = None
+        self._clock_probe_at = 0.0
         self._thread: Optional[threading.Thread] = None
         self._wake = threading.Event()
         self._stopped = False
@@ -75,28 +85,55 @@ class TaskEventBuffer:
             except Exception:
                 logger.debug("task event flush failed", exc_info=True)
 
+    def _probe_clock(self) -> None:
+        """One clock_probe round-trip against the GCS: the midpoint of the
+        local send/recv stamps estimates when t1 was read remotely, so
+        offset = t1 - (t0 + t2) / 2 (classic NTP). Best-effort — a down
+        link just leaves the previous estimate in place."""
+        import time as _time
+
+        try:
+            t0 = _time.time() * 1e6
+            reply = self._worker.gcs.call("clock_probe", timeout=2)
+            t2 = _time.time() * 1e6
+            self._clock_offset_us = reply["t1_us"] - (t0 + t2) / 2.0
+        except Exception:
+            logger.debug("clock probe failed", exc_info=True)
+
     def flush(self) -> None:
         """Ship everything buffered (task events, dropped count, and any
         tracing spans recorded since the last flush) in one GCS notify."""
+        import time as _time
+
+        from ray_tpu.core.config import get_config as _get_config
         from ray_tpu.util import tracing
 
         with self._lock:
             events = list(self._events)
             self._events.clear()
             dropped, self._dropped = self._dropped, 0
-            spans = tracing.get_events()
-            if self._profile_sent > len(spans):
-                self._profile_sent = 0  # tracing.clear() ran; resync
-            fresh = spans[self._profile_sent:]
-            self._profile_sent = len(spans)
-        if not events and not fresh and not dropped:
+            fresh, self._profile_sent, spans_dropped = tracing.drain(
+                self._profile_sent)
+            spans_dropped += self._spans_dropped_pending
+            self._spans_dropped_pending = 0
+        if not events and not fresh and not dropped and not spans_dropped:
             return
         src = self._worker.worker_id.binary().hex()
         payload = {
             "events": events,
             "dropped": dropped,
+            "src": src,
+            "spans_dropped": spans_dropped,
             "profile_events": [{**e, "_src": src} for e in fresh],
         }
+        if tracing.enabled():
+            now = _time.monotonic()
+            if (self._clock_offset_us is None or now >= self._clock_probe_at):
+                self._clock_probe_at = now + max(
+                    1.0, _get_config().tracing_clock_probe_period_s)
+                self._probe_clock()
+            if self._clock_offset_us is not None:
+                payload["clock_offset_us"] = self._clock_offset_us
         # try_notify reports a down link (plain notify swallows it); fakes
         # and raw clients in tests surface failure by raising instead
         gcs = self._worker.gcs
@@ -112,10 +149,12 @@ class TaskEventBuffer:
             return
         # Task events go back for the next tick (a GCS-restart window must
         # not silently lose lifecycle history); spans are best-effort, as
-        # they were under per-execution flushing.
+        # they were under per-execution flushing — but their DROP COUNT is
+        # not (it's the only record those spans existed), so it re-rides.
         with self._lock:
             self._events.extendleft(reversed(events))
             self._dropped += dropped
+            self._spans_dropped_pending += spans_dropped
             limit = max(1, get_config().task_events_max_buffer_size)
             while len(self._events) > limit:
                 self._events.popleft()

@@ -94,6 +94,12 @@ class TaskSpec:
     # bounds leaks from native libraries); 0 = unlimited
     max_calls: int = 0
 
+    # distributed tracing (util/tracing.py, gated on tracing_enabled):
+    # (trace_id, parent span_id) stamped at submit so the raylet's lease
+    # span and the executor's run/result spans join the submitter's causal
+    # tree. None when tracing is off — the spec pays no wire cost.
+    trace_ctx: Optional[Tuple[str, str]] = None
+
     def return_object_ids(self) -> List[ObjectID]:
         n = 1 if self.num_returns == -1 else self.num_returns
         return [ObjectID.for_task_return(self.task_id, i + 1) for i in range(n)]

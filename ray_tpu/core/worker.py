@@ -46,6 +46,7 @@ from ray_tpu.core.exceptions import (
 from ray_tpu.core.function_table import FunctionTableClient
 from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID, WorkerID, _TaskIDCounter
 from ray_tpu.core.task_events import TaskEventBuffer
+from ray_tpu.util import tracing
 from ray_tpu.core.object_store import attach_object
 from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.core.serialization import SerializedObject
@@ -375,6 +376,8 @@ class CoreWorker:
         self._fn_call_counts: Dict[int, int] = {}
         # chip indices granted by the raylet (get_tpu_ids surface)
         self._task_tpu_ids: Dict[TaskID, List[int]] = {}
+        # tracing: raylet dispatch stamps awaiting execution (epoch us)
+        self._task_dispatch_us: Dict[TaskID, float] = {}
         self._actor_tpu_ids: List[int] = []
         # executing+queued actor tasks excluding control-plane probes, so a
         # load reading is never inflated by the health checks that sample it
@@ -557,6 +560,7 @@ class CoreWorker:
             runtime_env=runtime_env,
             max_calls=max_calls,
         )
+        t_sub = self._stamp_trace_ctx(spec)
         refs = self._register_returns(spec)
         with self._pending_lock:
             self._pending_tasks[task_id] = [spec, max_retries]
@@ -568,6 +572,7 @@ class CoreWorker:
             except Exception:
                 logger.debug("spec bytes probe failed", exc_info=True)
         self.raylet.notify("submit_task", {"spec": spec})
+        self._record_submit_span(spec, t_sub)
         return refs
 
     def flush_profile_events(self) -> None:
@@ -585,6 +590,33 @@ class CoreWorker:
             self.task_events.record(spec, state)
         except Exception:
             logger.debug("task event record failed", exc_info=True)
+
+    def _stamp_trace_ctx(self, spec: TaskSpec) -> float:
+        """Tracing-enabled only: mint the submit-stage span id and stamp
+        (trace_id, submit span_id) into the spec BEFORE it serializes, so
+        the raylet's lease span and the executor's run/result spans parent
+        under this submission. Returns the submit-span start stamp (0.0
+        when tracing is off — the hot path pays one config read)."""
+        if not tracing.enabled():
+            return 0.0
+        ctx = tracing.current_ctx()
+        # no ambient trace -> this submission roots its own (detached: the
+        # thread's TLS stays clean so unrelated submissions don't coalesce
+        # into one giant trace)
+        trace_id = ctx[0] if ctx else tracing.new_id()
+        spec.trace_ctx = (trace_id, tracing.new_id())
+        return tracing.now_us()
+
+    def _record_submit_span(self, spec: TaskSpec, t_sub: float) -> None:
+        if spec.trace_ctx is None or not t_sub:
+            return
+        parent = tracing.current_ctx()
+        tracing.add_complete(
+            f"submit::{spec.method_name}", "task_submit",
+            t_sub, tracing.now_us() - t_sub,
+            trace_id=spec.trace_ctx[0], span_id=spec.trace_ctx[1],
+            parent_id=parent[1] if parent else "",
+            task_id=spec.task_id.binary().hex())
 
     def _register_returns(self, spec: TaskSpec) -> List[ObjectRef]:
         refs = []
@@ -2453,11 +2485,13 @@ class CoreWorker:
             caller_id=self.worker_id,
             concurrency_group=concurrency_group,
         )
+        t_sub = self._stamp_trace_ctx(spec)
         refs = self._register_returns(spec)
         with self._pending_lock:
             self._pending_tasks[task_id] = [spec, 0]
         self._emit_task_event(spec, "SUBMITTED")
         self._send_actor_task(actor_id, spec, attempts=0)
+        self._record_submit_span(spec, t_sub)
         return refs
 
     def _send_actor_task(self, actor_id: ActorID, spec: TaskSpec, attempts: int) -> None:
@@ -2814,6 +2848,11 @@ class CoreWorker:
             ids = payload.get("tpu_ids")
             if ids:
                 self._task_tpu_ids[spec.task_id] = list(ids)
+            d_us = payload.get("dispatch_us")
+            if d_us is not None and spec.trace_ctx is not None:
+                # raylet's dispatch stamp: _execute_task turns it into the
+                # dispatch-stage span (push -> execution start)
+                self._task_dispatch_us[spec.task_id] = d_us
             self._task_queue.put(spec)
         elif method == "become_actor":
             self._actor_tpu_ids = list(payload.get("tpu_ids") or [])
@@ -3120,6 +3159,25 @@ class CoreWorker:
         # chip grant for get_tpu_ids(): the task's own, else the actor's
         self._tls.tpu_ids = self._task_tpu_ids.pop(
             spec.task_id, None) or list(self._actor_tpu_ids)
+        # adopt the submitter's trace context: the execute/result spans —
+        # and any task this task submits — join the same causal tree
+        prev_ctx = tracing.current_ctx()
+        traced = spec.trace_ctx is not None and tracing.enabled()
+        if traced:
+            tracing.set_ctx(spec.trace_ctx)
+            d_us = self._task_dispatch_us.pop(spec.task_id, None)
+            if d_us is not None:
+                # dispatch stage: raylet push -> execution start (epoch-
+                # anchored stamps; same-host clocks agree, cross-node skew
+                # is corrected at merge from the clock-probe offsets)
+                tracing.add_complete(
+                    f"dispatch::{spec.method_name}", "task_dispatch",
+                    d_us, tracing.now_us() - d_us,
+                    trace_id=spec.trace_ctx[0],
+                    parent_id=spec.trace_ctx[1],
+                    task_id=spec.task_id.binary().hex())
+        else:
+            self._task_dispatch_us.pop(spec.task_id, None)
         self._emit_task_event(spec, "RUNNING")
         with self._exec_count_lock:
             self._executing_count += 1
@@ -3141,8 +3199,6 @@ class CoreWorker:
                 if spec.runtime_env:
                     self._apply_runtime_env(spec.runtime_env)
             args, kwargs = self._deserialize_args(spec.args, spec.kwargs_blob)
-            from ray_tpu.util import tracing
-
             with tracing.span(f"task::{spec.method_name}",
                               "task_execution",
                               task_id=spec.task_id.binary().hex()):
@@ -3196,6 +3252,8 @@ class CoreWorker:
             results = [("error", oid, blob) for oid in spec.return_object_ids()]
             failed = True
         finally:
+            if traced:
+                tracing.set_ctx(prev_ctx)
             if prev_task_id is None:
                 del self._tls.task_id
             else:
@@ -3207,6 +3265,7 @@ class CoreWorker:
                         and spec.method_name not in self._PROBE_METHODS):
                     self._load_count -= 1
         self._emit_task_event(spec, "FAILED" if failed else "FINISHED")
+        t_res = tracing.now_us() if traced else 0.0
         try:
             if spec.owner_address == self.address:
                 self.rpc_report_task_result(None, 0, {
@@ -3221,6 +3280,14 @@ class CoreWorker:
         except Exception:
             logger.warning("could not deliver results of %s to owner %s",
                            spec.method_name, spec.owner_address)
+        if t_res:
+            # result-deliver stage (the batched lane measures the hand-off
+            # into the owner-bound buffer; delivery itself is async)
+            tracing.add_complete(
+                f"result::{spec.method_name}", "task_result",
+                t_res, tracing.now_us() - t_res,
+                trace_id=spec.trace_ctx[0], parent_id=spec.trace_ctx[1],
+                task_id=spec.task_id.binary().hex(), failed=failed)
         if spec.task_type != TaskType.ACTOR_TASK:
             recycle = False
             if spec.max_calls > 0 and self.mode == "worker":

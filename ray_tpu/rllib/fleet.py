@@ -48,6 +48,7 @@ import ray_tpu
 from ray_tpu import serve
 from ray_tpu.core.rpc import (RpcDisconnected, fault_point,
                               get_fault_injector)
+from ray_tpu.util import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -565,8 +566,10 @@ class FleetDriver:
         """Fan one sample() per target replica through the handle (the
         router spreads them; mid-request failover covers replica kills).
         Returns the envelopes that resolved."""
-        futs = [self._sample_handle.remote()
-                for _ in range(self.cfg.num_replicas)]
+        with tracing.span("sample_round", "rl_sample",
+                          replicas=self.cfg.num_replicas):
+            futs = [self._sample_handle.remote()
+                    for _ in range(self.cfg.num_replicas)]
         out = []
         for f in futs:
             if self.stop_event.is_set():
@@ -594,11 +597,14 @@ class FleetDriver:
                 # the partitionable boundary: replicas-plane -> learner-plane
                 fault_point(INGEST_FAULT_POINT,
                             origin=REPLICA_GROUP, dest=LEARNER_GROUP)
-                res = ray_tpu.get(
-                    self._learner.ingest.remote(
-                        envelope["rollout_id"], envelope["weight_epoch"],
-                        envelope["ref"]),
-                    timeout=self.cfg.ingest_timeout_s)
+                with tracing.span("ingest", "rl_ingest",
+                                  rollout_id=envelope["rollout_id"],
+                                  weight_epoch=envelope["weight_epoch"]):
+                    res = ray_tpu.get(
+                        self._learner.ingest.remote(
+                            envelope["rollout_id"],
+                            envelope["weight_epoch"], envelope["ref"]),
+                        timeout=self.cfg.ingest_timeout_s)
             except RpcDisconnected:
                 if (self.stop_event.is_set()
                         or time.monotonic() > deadline):
@@ -662,7 +668,10 @@ class FleetDriver:
                 # the partitionable boundary: learner-plane -> replicas-plane
                 fault_point(WEIGHTS_FAULT_POINT,
                             origin=LEARNER_GROUP, dest=REPLICA_GROUP)
-                ok = serve.reconfigure(self.cfg.deployment_name, payload)
+                with tracing.span("broadcast_weights", "rl_broadcast",
+                                  epoch=int(payload["epoch"])):
+                    ok = serve.reconfigure(self.cfg.deployment_name,
+                                           payload)
                 self.broadcasts += 1
                 if require_all and not ok:
                     # a fresh fleet must not sample weightless: re-push
@@ -678,23 +687,31 @@ class FleetDriver:
 
     def train_round(self) -> Dict[str, Any]:
         """One loop iteration: sample the fleet, ingest every envelope,
-        broadcast per `broadcast_every`. Returns round metrics."""
+        broadcast per `broadcast_every`. Returns round metrics.
+
+        Each round roots its OWN trace (tracing_enabled): the sample fan-out
+        through the serve router, every learner ingest (retries included),
+        and the weight broadcast all hang off one round span — the
+        rollout->learner loop reads as a single causal tree per round."""
         t0 = time.monotonic()
-        envelopes = self.sample_round()
-        applied = 0
-        applied_env_steps = 0
-        last = None
-        for env in envelopes:
-            res = self.ingest(env)
-            if res is not None:
-                last = res
-                if res.get("applied"):
-                    applied += 1
-                    applied_env_steps += env.get("num_env_steps", 0)
-        if (last is not None and self.cfg.broadcast_every > 0
-                and last.get("applied")
-                and last["step"] % self.cfg.broadcast_every == 0):
-            self.broadcast()
+        round_ctx = (tracing.new_id(), "") if tracing.enabled() else None
+        with tracing.ctx_scope(round_ctx), \
+                tracing.span("train_round", "rl_round"):
+            envelopes = self.sample_round()
+            applied = 0
+            applied_env_steps = 0
+            last = None
+            for env in envelopes:
+                res = self.ingest(env)
+                if res is not None:
+                    last = res
+                    if res.get("applied"):
+                        applied += 1
+                        applied_env_steps += env.get("num_env_steps", 0)
+            if (last is not None and self.cfg.broadcast_every > 0
+                    and last.get("applied")
+                    and last["step"] % self.cfg.broadcast_every == 0):
+                self.broadcast()
         return {"envelopes": len(envelopes), "applied": applied,
                 "applied_env_steps": applied_env_steps,
                 "round_s": time.monotonic() - t0}

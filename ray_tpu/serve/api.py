@@ -34,6 +34,7 @@ from ray_tpu.core import rpc as _rpc
 from ray_tpu.core.exceptions import (ActorDiedError, BackPressureError,
                                      ObjectLostError, RequestTimeoutError,
                                      WorkerCrashedError)
+from ray_tpu.util import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -1099,6 +1100,17 @@ class DeploymentHandle:
     def remote(self, *args, _timeout_s: Optional[float] = None,
                _deadline_ts: Optional[float] = None, **kwargs):
         _serve_metrics()["requests"].inc(tags={"deployment": self._name})
+        # Route span: joins the caller's trace (e.g. the proxy's ingress
+        # span) or roots a fresh one. Its span id rides the request as
+        # trace_ctx so EVERY replica attempt — including failover retries —
+        # parents under this one routing decision.
+        route_ctx = None
+        t_route = 0.0
+        if tracing.enabled():
+            amb = tracing.current_ctx()
+            route_ctx = (amb[0] if amb else tracing.new_id(),
+                         tracing.new_id())
+            t_route = tracing.now_us()
         deadline_ts, timeout_s = self._resolve_deadline(
             _timeout_s, _deadline_ts)
         with self._lock:
@@ -1120,6 +1132,7 @@ class DeploymentHandle:
                   if self._idempotent else 0)
         req = _RouterRequest(self, args, kwargs, deadline_ts, timeout_s,
                              budget)
+        req.trace_ctx = route_ctx
         try:
             req._submit_to(replica, key)
         except Exception as e:
@@ -1136,6 +1149,14 @@ class DeploymentHandle:
 
                 _global_worker().fulfill_promise(req.promise, error=e)
                 raise
+        if route_ctx is not None:
+            amb = tracing.current_ctx()
+            tracing.add_complete(
+                f"route::{self._name}", "serve_route",
+                t_route, tracing.now_us() - t_route,
+                trace_id=route_ctx[0], span_id=route_ctx[1],
+                parent_id=amb[1] if amb else "",
+                deployment=self._name)
         return req.promise
 
     def _submit_stream(self, args, kwargs, deadline_ts: float):
@@ -1151,6 +1172,13 @@ class DeploymentHandle:
         budget = _serve_cfg().request_retry_budget if self._idempotent else 0
         tried: set = set()
         last_err: Optional[Exception] = None
+        route_ctx = None
+        t_route = 0.0
+        if tracing.enabled():
+            amb = tracing.current_ctx()
+            route_ctx = (amb[0] if amb else tracing.new_id(),
+                         tracing.new_id())
+            t_route = tracing.now_us()
         for attempt in range(budget + 1):
             replica, key = self._pick_replica(tried)
             if replica is None:
@@ -1160,9 +1188,10 @@ class DeploymentHandle:
             self._inc(key)
             try:
                 _rpc.fault_point(REPLICA_CALL_FAULT_POINT)
-                gen = replica.handle_request.options(
-                    num_returns="dynamic").remote(
-                        self._method, args, kwargs, deadline_ts)
+                with tracing.ctx_scope(route_ctx):
+                    gen = replica.handle_request.options(
+                        num_returns="dynamic").remote(
+                            self._method, args, kwargs, deadline_ts)
             except Exception as e:
                 self._dec(key)
                 if isinstance(e, _RETRYABLE_ERRORS) and attempt < budget:
@@ -1173,6 +1202,14 @@ class DeploymentHandle:
                 raise
             _global_worker().add_done_callback(
                 gen._gen_ref, lambda k=key: self._dec(k))
+            if route_ctx is not None:
+                amb = tracing.current_ctx()
+                tracing.add_complete(
+                    f"route::{self._name}", "serve_route",
+                    t_route, tracing.now_us() - t_route,
+                    trace_id=route_ctx[0], span_id=route_ctx[1],
+                    parent_id=amb[1] if amb else "",
+                    deployment=self._name, stream=True)
             return gen
         raise last_err  # budget spent
 
@@ -1215,7 +1252,7 @@ class _RouterRequest:
     plasma-sized result pulls) hops to the shared router pool."""
 
     __slots__ = ("h", "args", "kwargs", "deadline_ts", "retries_left",
-                 "tried", "promise", "backoff", "retried")
+                 "tried", "promise", "backoff", "retried", "trace_ctx")
 
     def __init__(self, h: DeploymentHandle, args, kwargs,
                  deadline_ts: float, timeout_s: float, budget: int):
@@ -1234,6 +1271,7 @@ class _RouterRequest:
             base_s=cfg.retry_backoff_base_ms / 1000.0,
             cap_s=cfg.retry_backoff_cap_ms / 1000.0)
         self.promise = _global_worker().create_promise()
+        self.trace_ctx = None  # (trace_id, route span id) when tracing is on
         _deadline_reaper.watch(deadline_ts, self.promise, h._name, timeout_s)
 
     def _submit_to(self, replica, key: bytes) -> None:
@@ -1241,8 +1279,11 @@ class _RouterRequest:
         h._inc(key)
         try:
             _rpc.fault_point(REPLICA_CALL_FAULT_POINT)
-            ref = replica.handle_request.remote(
-                h._method, self.args, self.kwargs, self.deadline_ts)
+            # every attempt (first submit AND pool-thread failovers) submits
+            # under the route span's context, so retries stay in-trace
+            with tracing.ctx_scope(self.trace_ctx):
+                ref = replica.handle_request.remote(
+                    h._method, self.args, self.kwargs, self.deadline_ts)
         except BaseException:
             h._dec(key)
             raise

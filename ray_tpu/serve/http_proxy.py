@@ -39,6 +39,8 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qsl, urlparse
 
+from ray_tpu.util import tracing
+
 logger = logging.getLogger(__name__)
 
 _MAX_HEADER_BYTES = 64 * 1024
@@ -283,6 +285,15 @@ class AsyncHTTPProxy:
             await writer.drain()
             return
         self._inflight += 1
+        # Ingress span roots the request's trace. The ids are minted HERE
+        # (explicitly, not via thread-local start_trace): _dispatch is a
+        # coroutine, and thread-local context must never span an await — it
+        # is adopted only inside the synchronous submit windows below.
+        ing_ctx = None
+        t_ing = 0.0
+        if tracing.enabled():
+            ing_ctx = (tracing.new_id(), tracing.new_id())
+            t_ing = tracing.now_us()
         # no requests.inc here: the handle's remote() counts it (this
         # process), exactly as the edge always has
         try:
@@ -294,7 +305,8 @@ class AsyncHTTPProxy:
                     raise _BadRequest(
                         "app-ingress deployments do not support ?stream=1")
                 await self._dispatch_stream(name, method, payload, req,
-                                            writer, deadline_ts)
+                                            writer, deadline_ts,
+                                            trace_ctx=ing_ctx)
             else:
                 if app_ingress:
                     method = "__call__"
@@ -307,13 +319,18 @@ class AsyncHTTPProxy:
                 handle = self._get_handle(name, method)
                 if getattr(handle, "_replicas", None):
                     # warm handle: submission is sample + one socket send —
-                    # cheaper than a thread hop
-                    ref = handle.remote(payload, _deadline_ts=deadline_ts)
+                    # cheaper than a thread hop (synchronous window: the
+                    # ingress ctx is safe to adopt, no await inside)
+                    with tracing.ctx_scope(ing_ctx):
+                        ref = handle.remote(payload,
+                                            _deadline_ts=deadline_ts)
                 else:
+                    def _submit():
+                        with tracing.ctx_scope(ing_ctx):
+                            return handle.remote(payload,
+                                                 _deadline_ts=deadline_ts)
                     ref = await self._loop.run_in_executor(
-                        self._pool,
-                        lambda: handle.remote(payload,
-                                              _deadline_ts=deadline_ts))
+                        self._pool, _submit)
                 # the router's deadline reaper resolves the promise AT the
                 # deadline; the edge timeout is only the backstop behind it
                 await await_ref(self._loop, ref, timeout_s + _EDGE_GRACE_S)
@@ -341,10 +358,17 @@ class AsyncHTTPProxy:
             self._inflight -= 1
             _serve_metrics()["latency"].observe(
                 time.monotonic() - t0, tags={"deployment": name})
+            if ing_ctx is not None:
+                tracing.add_complete(
+                    f"ingress::{name}", "serve_ingress",
+                    t_ing, tracing.now_us() - t_ing,
+                    trace_id=ing_ctx[0], span_id=ing_ctx[1], parent_id="",
+                    deployment=name, method=req.get("method", ""))
 
     async def _dispatch_stream(self, name: str, method: str, payload: Any,
                                req: dict, writer,
-                               deadline_ts: Optional[float] = None) -> None:
+                               deadline_ts: Optional[float] = None,
+                               trace_ctx=None) -> None:
         """Chunked-encoding relay of a streaming deployment: each object the
         replica's generator yields becomes one HTTP chunk as soon as it is
         reported — tokens reach the client while the model still decodes.
@@ -369,11 +393,13 @@ class AsyncHTTPProxy:
         # typed 503/500 via the caller
         handle = self._get_stream_handle(name, method)
         if getattr(handle, "_replicas", None):
-            gen = handle.remote(payload, _deadline_ts=deadline_ts)
+            with tracing.ctx_scope(trace_ctx):
+                gen = handle.remote(payload, _deadline_ts=deadline_ts)
         else:
-            gen = await self._loop.run_in_executor(
-                self._pool,
-                lambda: handle.remote(payload, _deadline_ts=deadline_ts))
+            def _submit():
+                with tracing.ctx_scope(trace_ctx):
+                    return handle.remote(payload, _deadline_ts=deadline_ts)
+            gen = await self._loop.run_in_executor(self._pool, _submit)
         writer.write((
             "HTTP/1.1 200 OK\r\n"
             "Content-Type: application/x-ndjson\r\n"

@@ -92,6 +92,7 @@ class _Outcomes:
     latencies_ms: List[float] = field(default_factory=list)
 
 
+from ray_tpu.util import tracing as _tracing  # noqa: E402
 from ray_tpu.util.stats import percentile as _percentile  # noqa: E402
 
 
@@ -110,7 +111,7 @@ class LoadGenerator:
     def __init__(self, handle, *, rps: float, request_timeout_s: float,
                  payload_fn=None, threads: int = 4,
                  rng: Optional[random.Random] = None,
-                 resolve_grace_s: float = 10.0):
+                 resolve_grace_s: float = 10.0, trace: bool = False):
         from ray_tpu.core.api import _global_worker
 
         self.handle = handle
@@ -120,6 +121,11 @@ class LoadGenerator:
         self.threads = threads
         self.rng = rng or random.Random(0)
         self.resolve_grace_s = resolve_grace_s
+        # trace=True: each request roots its own trace and accepted
+        # requests record their trace_id — the traced storm asserts every
+        # one of those resolves to a complete cross-process span chain
+        self.trace = trace
+        self.trace_ids: List[str] = []  # accepted requests only
         self.outcomes = _Outcomes()
         self.elapsed_s = 0.0
         self._worker = _global_worker()
@@ -157,7 +163,7 @@ class LoadGenerator:
             item = self._done_q.get()
             if item is None:
                 return
-            ref, t0, t1 = item
+            ref, t0, t1, trace_id = item
             err = None
             try:
                 ray_tpu.get(ref, timeout=5)  # terminal: instant
@@ -169,6 +175,8 @@ class LoadGenerator:
                 setattr(out, kind, getattr(out, kind) + 1)
                 if kind == "accepted":
                     out.latencies_ms.append((t1 - t0) * 1e3)
+                    if trace_id is not None:
+                        self.trace_ids.append(trace_id)
             self._outstanding.release()
 
     def _submitter(self, idx: int) -> None:
@@ -188,9 +196,12 @@ class LoadGenerator:
             with self._lock:
                 out.submitted += 1
             t0 = time.perf_counter()
+            tctx = (_tracing.new_id(), "") if self.trace else None
             try:
-                ref = self.handle.remote(self.payload_fn(idx, i),
-                                         _timeout_s=self.request_timeout_s)
+                with _tracing.ctx_scope(tctx):
+                    ref = self.handle.remote(
+                        self.payload_fn(idx, i),
+                        _timeout_s=self.request_timeout_s)
             except BackPressureError:
                 with self._lock:
                     out.shed += 1
@@ -202,8 +213,9 @@ class LoadGenerator:
                 self._outstanding.release()
                 continue
             self._worker.add_done_callback(
-                ref, lambda r=ref, t=t0: self._done_q.put(
-                    (r, t, time.perf_counter())))
+                ref, lambda r=ref, t=t0,
+                tid=(tctx[0] if tctx else None): self._done_q.put(
+                    (r, t, time.perf_counter(), tid)))
 
     def start(self) -> "LoadGenerator":
         self._collector_t = threading.Thread(target=self._collector,
@@ -251,6 +263,7 @@ def run_storm(profile: Optional[StormProfile] = None,
     written to `out_path` unless None). Raises nothing on a dirty storm —
     the caller asserts on `result["requests"]["hung"]` etc."""
     from ray_tpu.core import rpc as _rpc
+    from ray_tpu.core.config import get_config
     from ray_tpu.serve.config import get_serve_config
 
     p = profile or StormProfile()
@@ -260,6 +273,13 @@ def run_storm(profile: Optional[StormProfile] = None,
              ("max_queue_per_replica", "request_retry_budget")}
     cfg.max_queue_per_replica = p.max_queue_per_replica
     cfg.request_retry_budget = p.retry_budget
+    core_cfg = get_config()
+    saved_traces = core_cfg.tracing_max_traces
+    if _tracing.enabled():
+        # one trace per submitted request: a quick storm roots a few
+        # thousand, which brushes the default per-trace eviction cap —
+        # evicting a live trace would read as a broken chain
+        core_cfg.tracing_max_traces = max(saved_traces, 50_000)
     injector = (_rpc.install_fault_injector(p.fault_spec, p.seed)
                 if p.fault_spec else None)
     try:
@@ -271,6 +291,67 @@ def run_storm(profile: Optional[StormProfile] = None,
             _rpc.clear_fault_injector()
         for k, v in saved.items():
             setattr(cfg, k, v)
+        core_cfg.tracing_max_traces = saved_traces
+
+
+def _collect_trace_report(trace_ids: List[str],
+                          out_path: Optional[str]) -> Dict[str, Any]:
+    """Post-drain tracing verdict: pull the fleet's spans + clock offsets
+    from the GCS, validate every accepted request's chain (parent links
+    resolve, >=3 distinct processes), and write the merged chrome trace
+    next to the artifact."""
+    from ray_tpu.core.api import _global_worker
+    from ray_tpu.core.config import get_config
+    from ray_tpu.util import timeline
+
+    w = _global_worker()
+    # flush our own spans, then poll: worker processes ship theirs on the
+    # report-interval cadence, so keep re-pulling until the chain census
+    # stops improving (stragglers can be a couple of intervals behind)
+    interval_s = max(0.5, get_config().task_events_report_interval_ms / 1e3)
+    deadline = time.monotonic() + max(8.0, 6 * interval_s)
+    spans, offsets, chains = [], {}, {}
+    complete: List[str] = []
+    cross3: List[str] = []
+    while True:
+        w.task_events.flush()
+        spans = w.gcs.call("get_profile_events", {}, timeout=30)
+        offsets = w.gcs.call("get_span_offsets", {}, timeout=10)
+        chains = timeline.validate_chains(spans, trace_ids)
+        complete = [t for t, c in chains.items() if c["complete"]]
+        cross3 = [t for t in complete if chains[t]["processes"] >= 3]
+        if len(cross3) >= len(trace_ids) or time.monotonic() > deadline:
+            break
+        time.sleep(interval_s)
+    doc = timeline.merge_chrome(spans, offsets)
+    problems = timeline.validate_chrome(doc)
+    chrome_path = None
+    if out_path:
+        chrome_path = out_path + ".trace.json"
+        with open(chrome_path, "w") as f:
+            json.dump(doc, f)
+    incomplete_sample = [
+        {"trace_id": t, **{k: v for k, v in chains[t].items()
+                           if k != "missing_parents"},
+         "missing_parents": chains[t]["missing_parents"][:3]}
+        for t in trace_ids if not chains[t]["complete"]][:5]
+    return {
+        "enabled": True,
+        "accepted_traced": len(trace_ids),
+        "complete_chains": len(complete),
+        "complete_fraction": round(
+            len(complete) / max(1, len(trace_ids)), 4),
+        "chains_3plus_processes": len(cross3),
+        "cross3_fraction": round(len(cross3) / max(1, len(trace_ids)), 4),
+        "incomplete_sample": incomplete_sample,
+        "chrome_events": len(doc["traceEvents"]),
+        "chrome_valid": not problems,
+        "chrome_problems": problems[:5],
+        "chrome_path": chrome_path,
+        "clock_sources": len(offsets),
+        "max_abs_clock_offset_us": round(
+            max((abs(v) for v in offsets.values()), default=0.0), 1),
+    }
 
 
 def _run_storm_inner(p: StormProfile, rng: random.Random, injector,
@@ -279,6 +360,11 @@ def _run_storm_inner(p: StormProfile, rng: random.Random, injector,
     from ray_tpu import serve
 
     service_time_s = p.service_time_s
+    traced = _tracing.enabled()
+
+    @ray_tpu.remote
+    def _nested_echo(i):
+        return i
 
     @serve.deployment(
         name="storm_target",
@@ -291,6 +377,12 @@ def _run_storm_inner(p: StormProfile, rng: random.Random, injector,
     )
     class StormTarget:
         def __call__(self, i):
+            if traced:
+                # nested task: the replica's execution span becomes the
+                # parent of a submit->lease->dispatch->execute chain in a
+                # THIRD process (a pool worker), so every accepted
+                # request's trace crosses driver -> replica -> worker
+                ray_tpu.get(_nested_echo.remote(i), timeout=30)
             time.sleep(service_time_s)
             return i
 
@@ -306,7 +398,7 @@ def _run_storm_inner(p: StormProfile, rng: random.Random, injector,
     gen = LoadGenerator(handle, rps=p.offered_rps,
                         request_timeout_s=p.request_timeout_s,
                         threads=p.submitter_threads, rng=rng,
-                        resolve_grace_s=p.resolve_grace_s)
+                        resolve_grace_s=p.resolve_grace_s, trace=traced)
 
     def killer() -> None:
         # victims come from the HANDLE's push-refreshed replica set (local,
@@ -326,16 +418,26 @@ def _run_storm_inner(p: StormProfile, rng: random.Random, injector,
             except Exception:
                 logger.warning("storm kill pass failed", exc_info=True)
 
-    kill_t = threading.Thread(target=killer, daemon=True)
-    kill_t.start()
+    # kill_period_s <= 0 disables the kill loop entirely (the traced storm
+    # runs kill-free: a hard-killed replica takes its unflushed spans with
+    # it, which would charge span loss against chain completeness). The
+    # guard matters — stop.wait(0) returns immediately, so an unguarded
+    # thread would busy-kill replicas back to back.
+    kill_t = None
+    if p.kill_period_s > 0:
+        kill_t = threading.Thread(target=killer, daemon=True)
+        kill_t.start()
     gen.start()
     time.sleep(p.duration_s)
     stop.set()
     # Every submitted request must RESOLVE (result / typed timeout / typed
     # shed) within deadline + grace; anything left is a hung request.
     out = gen.stop_and_drain()
-    kill_t.join(timeout=p.kill_period_s + 10)
+    if kill_t is not None:
+        kill_t.join(timeout=p.kill_period_s + 10)
     elapsed = gen.elapsed_s
+    tracing_blk = (_collect_trace_report(gen.trace_ids, out_path)
+                   if traced else None)
 
     stats = serve.router_stats()
     lat = sorted(out.latencies_ms)
@@ -369,6 +471,8 @@ def _run_storm_inner(p: StormProfile, rng: random.Random, injector,
         },
         "zero_hung": out.hung == 0,
     }
+    if tracing_blk is not None:
+        result["tracing"] = tracing_blk
     serve.delete("storm_target")
     if out_path:
         with open(out_path, "w") as f:
@@ -462,6 +566,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="short CI profile (~6 s; ~10 s with --kill-head)")
     ap.add_argument("--json", default=DEFAULT_ARTIFACT,
                     help=f"artifact path (default {DEFAULT_ARTIFACT})")
+    ap.add_argument("--traced", action="store_true",
+                    help="run with distributed tracing enabled: every "
+                         "request roots a trace, the merged chrome "
+                         "timeline lands next to the artifact, and the "
+                         "run fails unless >=99%% of accepted requests "
+                         "have complete cross-process span chains. "
+                         "Disables the replica kill loop (a hard-killed "
+                         "replica loses its unflushed spans); the fault "
+                         "injector still drops submissions, so failover "
+                         "retries stay in the traces")
+    ap.add_argument("--kill-period", type=float, default=None,
+                    help="override the replica kill period in seconds; 0 "
+                         "disables the kill loop (CI's tracing stage uses "
+                         "this for an untraced kill-free baseline "
+                         "comparable to --traced)")
     ap.add_argument("--kill-head", action="store_true",
                     help="kill-and-promote the GCS head mid-storm: a warm "
                          "standby takes over via the lease/fencing-epoch "
@@ -487,6 +606,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.quick:
         kw.update(KILLHEAD_QUICK_PROFILE if args.kill_head
                   else QUICK_PROFILE)
+    if args.kill_period is not None:
+        kw["kill_period_s"] = args.kill_period
+    if args.traced:
+        from ray_tpu.core.config import get_config
+
+        # env AND the live config: worker subprocesses (replicas, pool
+        # workers) build their config from the inherited environment, so
+        # flipping only the driver's loaded config would leave every other
+        # process untraced and the chains single-process
+        os.environ["RAY_TPU_TRACING_ENABLED"] = "1"
+        get_config().tracing_enabled = True
+        kw["kill_period_s"] = 0.0  # hard-killed replicas lose their spans
     profile = StormProfile(**kw)
 
     cluster = None
@@ -541,6 +672,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"STORM FAILED: {req['hung']} hung request(s) "
               f"(seed {result['seed']})")
         failed = True
+    if args.traced:
+        tr = result.get("tracing") or {}
+        print(f"  tracing: {tr.get('complete_chains')}/"
+              f"{tr.get('accepted_traced')} complete chains "
+              f"({tr.get('cross3_fraction', 0):.1%} across >=3 processes), "
+              f"{tr.get('chrome_events')} events -> {tr.get('chrome_path')} "
+              f"(valid={tr.get('chrome_valid')}), "
+              f"max clock offset "
+              f"{tr.get('max_abs_clock_offset_us', 0) / 1e3:.2f}ms "
+              f"over {tr.get('clock_sources')} sources")
+        if tr.get("cross3_fraction", 0.0) < 0.99:
+            print(f"STORM FAILED: only {tr.get('cross3_fraction', 0):.1%} "
+                  f"of accepted requests have complete >=3-process span "
+                  f"chains (need 99%); sample: "
+                  f"{tr.get('incomplete_sample')}")
+            failed = True
+        if not tr.get("chrome_valid"):
+            print(f"STORM FAILED: merged chrome trace invalid: "
+                  f"{tr.get('chrome_problems')}")
+            failed = True
     if args.kill_head:
         failed |= _report_head_kill(killer.record, result, args)
     if failed:

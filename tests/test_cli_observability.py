@@ -399,3 +399,110 @@ def test_rllib_cli_train_and_evaluate(ray_start_regular, capsys, tmp_path):
     assert rc == 0
     ev = json.loads(out[out.index("{"):])
     assert ev["num_episodes"] == 2
+
+
+@pytest.fixture
+def traced_gcs_address(monkeypatch):
+    """Cluster with distributed tracing ON (env set pre-init so worker
+    subprocesses inherit it), yielding the GCS address for CLI calls."""
+    from ray_tpu.core.config import reset_config
+
+    monkeypatch.setenv("RAY_TPU_TRACING_ENABLED", "1")
+    reset_config()
+    ray_tpu.init(num_cpus=4, resources={"TPU": 8})
+    yield ray_tpu.get_runtime_context().gcs_address
+    ray_tpu.shutdown()
+    reset_config()
+
+
+def _run_traced_task():
+    """One traced task; waits until its full stage chain reaches the GCS
+    and returns (task_id_hex, trace_id)."""
+    from ray_tpu.core.api import _global_worker
+    from ray_tpu.util import timeline
+
+    @ray_tpu.remote
+    def cli_traced_probe():
+        return 1
+
+    ref = cli_traced_probe.remote()
+    assert ray_tpu.get(ref, timeout=60) == 1
+    task_id = ref.task_id().binary().hex()
+    w = _global_worker()
+    deadline = time.monotonic() + 20
+    reply = {}
+    while time.monotonic() < deadline:
+        w.task_events.flush()
+        reply = w.gcs.call("get_trace", {"task_id": task_id}, timeout=10)
+        cats = {s.get("cat") for s in reply.get("spans") or []}
+        if set(timeline.STAGE_ORDER) <= cats:
+            break
+        time.sleep(0.3)
+    assert reply.get("trace_id"), "trace never reached the GCS"
+    return task_id, reply["trace_id"]
+
+
+def test_cli_trace_prints_critical_path(traced_gcs_address, capsys):
+    """`ray_tpu trace <task_id>`: per-stage segments in causal order plus
+    the fleet-wide p50/p99 per stage from gcs_stats."""
+    task_id, _ = _run_traced_task()
+    rc, out = _cli(capsys, "trace", task_id, "--address",
+                   traced_gcs_address)
+    assert rc == 0, out
+    assert f"task {task_id}" in out and "submit -> result-deliver" in out
+    pos = [out.index(s) for s in ("task_submit", "task_lease",
+                                  "task_dispatch", "task_execution",
+                                  "task_result")]
+    assert pos == sorted(pos), out  # stages print in causal order
+    assert "fleet stage latency" in out
+
+
+def test_cli_trace_unknown_task_fails(traced_gcs_address, capsys):
+    rc = cli_main(["trace", "00" * 12, "--address", traced_gcs_address])
+    capsys.readouterr()
+    assert rc == 1
+
+
+def test_cli_timeline_trace_list_and_single_trace(
+        traced_gcs_address, capsys, tmp_path):
+    from ray_tpu.util import timeline
+
+    task_id, trace_id = _run_traced_task()
+    rc, out = _cli(capsys, "timeline", "--trace", "list",
+                   "--address", traced_gcs_address)
+    assert rc == 0 and trace_id in out
+
+    out_path = str(tmp_path / "one_trace.json")
+    rc, out = _cli(capsys, "timeline", "--trace", trace_id,
+                   "--address", traced_gcs_address, "--output", out_path)
+    assert rc == 0 and out_path in out
+    with open(out_path) as f:
+        doc = json.load(f)
+    assert timeline.validate_chrome(doc) == []
+    spans = doc["traceEvents"]
+    assert spans and all(s.get("trace_id") == trace_id for s in spans)
+    assert {s.get("cat") for s in spans} >= set(timeline.STAGE_ORDER)
+
+    # --trace without --address is a usage error, not a silent local dump
+    assert cli_main(["timeline", "--trace", trace_id,
+                     "--output", out_path]) == 2
+    capsys.readouterr()
+
+
+def test_cli_timeline_fleet_merge_is_clock_aligned(
+        traced_gcs_address, capsys, tmp_path):
+    """The no-flag path: local ring + GCS-held worker spans merge into one
+    monotone chrome document (per-source offsets applied)."""
+    from ray_tpu.util import timeline
+
+    _run_traced_task()
+    out_path = str(tmp_path / "fleet.json")
+    rc, out = _cli(capsys, "timeline", "--address", traced_gcs_address,
+                   "--output", out_path)
+    assert rc == 0
+    with open(out_path) as f:
+        doc = json.load(f)
+    assert timeline.validate_chrome(doc) == []
+    # spans from >=2 processes made it into one document
+    assert len({e.get("_src") or f"pid:{e.get('pid')}"
+                for e in doc["traceEvents"]}) >= 2
