@@ -16,6 +16,7 @@
 #   ci/run_ci.sh --trainstorm # RL fleet chaos (rollout->learner loop) only
 #   ci/run_ci.sh --memstorm   # store storm (storage failure domain) only
 #   ci/run_ci.sh --tracing    # traced serve storm (cluster timeline) only
+#   ci/run_ci.sh --jobstorm   # job storm (job failure domain) only
 #
 # Stages:
 #   1. native      : arena + scheduler + token-loader compiled whole-program
@@ -91,13 +92,20 @@
 #                    ts, finite durs), post-alignment clock skew < 10 ms,
 #                    and the traced p50 must stay inside a loose overhead
 #                    budget vs the baseline.
+#  14. jobstorm    : job storm (quick profile): N concurrent driver
+#                    processes (nested task trees, named + detached
+#                    actors, large pinned puts), a seeded subset
+#                    SIGKILLed mid-flight. Fails on any job not reaped
+#                    within the bound, a dead detached actor, a hung
+#                    call, an untyped cross-job get, or any leaked
+#                    worker / object-table entry / shm segment.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STAGE="${1:-all}"
 
 run_native() {
-  echo "=== [1/13] native modules under ASan/UBSan ==="
+  echo "=== [1/14] native modules under ASan/UBSan ==="
   mkdir -p build
   g++ -std=c++17 -O1 -g -fsanitize=address,undefined \
       -fno-omit-frame-pointer -o build/sanitize_native \
@@ -109,7 +117,7 @@ run_native() {
 }
 
 run_fast() {
-  echo "=== [2/13] fast test tier ==="
+  echo "=== [2/14] fast test tier ==="
   python -m pytest tests/ -q
   # core-primitives smoke: the submission AND completion hot paths
   # (function table, event batching, batched result delivery, put/get)
@@ -136,7 +144,7 @@ EOF
 }
 
 run_stress() {
-  echo "=== [3/13] actor ordering stress x20 ==="
+  echo "=== [3/14] actor ordering stress x20 ==="
   for i in $(seq 1 20); do
     python -m pytest tests/test_actor_ordering_stress.py -q -x \
       || { echo "ordering stress failed on iteration $i"; exit 1; }
@@ -144,7 +152,7 @@ run_stress() {
 }
 
 run_chaos() {
-  echo "=== [4/13] control-plane HA chaos suite ==="
+  echo "=== [4/14] control-plane HA chaos suite ==="
   # Deterministic fault injection: pin + print the seed so a red run
   # replays the same chaos schedule (override by exporting the variable;
   # timing-dependent counters can still drift between runs).
@@ -161,7 +169,7 @@ run_chaos() {
 }
 
 run_serve_storm() {
-  echo "=== [5/13] serve traffic-storm chaos ==="
+  echo "=== [5/14] serve traffic-storm chaos ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -177,7 +185,7 @@ run_serve_storm() {
 }
 
 run_burst() {
-  echo "=== [6/13] warm-pool elasticity burst ==="
+  echo "=== [6/14] warm-pool elasticity burst ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "burst seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -202,7 +210,7 @@ run_burst() {
 }
 
 run_head_failover() {
-  echo "=== [7/13] standby-head kill-and-promote storm ==="
+  echo "=== [7/14] standby-head kill-and-promote storm ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "fault injection seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -221,7 +229,7 @@ run_head_failover() {
 }
 
 run_node_chaos() {
-  echo "=== [8/13] multi-node kill storm (node failure domain) ==="
+  echo "=== [8/14] multi-node kill storm (node failure domain) ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "node storm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -241,7 +249,7 @@ run_node_chaos() {
 }
 
 run_partition_storm() {
-  echo "=== [9/13] partition-heal storm (partition failure domain) ==="
+  echo "=== [9/14] partition-heal storm (partition failure domain) ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "partition storm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -263,7 +271,7 @@ run_partition_storm() {
 }
 
 run_servebench() {
-  echo "=== [10/13] serving perf smoke (servebench quick) ==="
+  echo "=== [10/14] serving perf smoke (servebench quick) ==="
   # Quick profile of python -m ray_tpu.models.servebench: fused-decode
   # tokens/s + the 1/4/8 slot sweep table, w8a16 logits-parity row,
   # batched bucketed prefill, and p50/p99 request latency under the storm
@@ -277,7 +285,7 @@ run_servebench() {
 }
 
 run_trainstorm() {
-  echo "=== [11/13] RL fleet chaos (trainstorm quick) ==="
+  echo "=== [11/14] RL fleet chaos (trainstorm quick) ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "trainstorm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -308,7 +316,7 @@ EOF
 }
 
 run_memstorm() {
-  echo "=== [12/13] store storm (storage failure domain, memstorm quick) ==="
+  echo "=== [12/14] store storm (storage failure domain, memstorm quick) ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "memstorm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -343,7 +351,7 @@ EOF
 }
 
 run_tracing() {
-  echo "=== [13/13] cluster timeline: traced serve storm ==="
+  echo "=== [13/14] cluster timeline: traced serve storm ==="
   : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
   export RAY_TPU_FAULT_INJECTION_SEED
   echo "tracing seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
@@ -402,6 +410,55 @@ EOF
   rm -f "$base_json" "$traced_json" "$traced_json.trace.json"
 }
 
+run_jobstorm() {
+  echo "=== [14/14] job storm (job failure domain, jobstorm quick) ==="
+  : "${RAY_TPU_FAULT_INJECTION_SEED:=20260804}"
+  export RAY_TPU_FAULT_INJECTION_SEED
+  echo "jobstorm seed: ${RAY_TPU_FAULT_INJECTION_SEED}"
+  # --quick: 4 concurrent driver processes (nested task trees, named +
+  # detached counter actors, 1 MiB pinned puts); 2 are SIGKILLed
+  # mid-flight on a seeded staggered schedule. The harness exits nonzero
+  # if any killed job is not DEAD + fully reaped within the bound, a
+  # detached actor fails to answer a fresh driver with its pre-kill
+  # state, a cross-job get of a reaped object is not the typed
+  # OwnerDiedError, any survivor hangs or starves, or any worker
+  # process / object-table entry / shm segment leaks.
+  js_json="$(mktemp /tmp/ray_tpu_jobstorm_ci.XXXXXX.json)"
+  timeout -k 10 360 env JAX_PLATFORMS=cpu python -m ray_tpu.core.jobstorm \
+    --quick --seed "${RAY_TPU_FAULT_INJECTION_SEED}" --json "$js_json" \
+    || { echo "job storm failed (seed ${RAY_TPU_FAULT_INJECTION_SEED})"
+         exit 1; }
+  JS_JSON="$js_json" python - <<'EOF'
+import json, os
+art = json.load(open(os.environ["JS_JSON"]))
+need = {"ok", "zero_hung", "zero_leaks", "detached_survived",
+        "counters", "phases", "violations"}
+missing = need - set(art)
+assert not missing, f"jobstorm artifact missing rows: {missing}"
+assert art["ok"] and art["zero_hung"] and art["zero_leaks"] \
+    and art["detached_survived"], \
+    f"jobstorm contract violated: {art['violations']}"
+c = art["counters"]
+for axis in ("jobs_reaped", "actors_killed", "detached_spared",
+             "objects_dropped", "bytes_dropped"):
+    assert c.get(axis, 0) > 0, f"jobstorm reap axis never fired: {axis}"
+st = art["phases"]["storm"]
+assert st["leaked_workers"] == 0 and st["leaked_objects"] == 0
+assert art["phases"]["teardown"]["leaked_shm_segments"] == 0
+assert art["phases"]["cross_job_get"]["typed_owner_died"] > 0
+print(f"jobstorm artifact rows ok: reaped={c['jobs_reaped']} "
+      f"actors_killed={c['actors_killed']} "
+      f"detached_spared={c['detached_spared']} "
+      f"workers_killed={c['workers_killed']} "
+      f"objects_dropped={c['objects_dropped']} "
+      f"({c['bytes_dropped']} B) "
+      f"detached_answered={art['phases']['detached']['answered']}"
+      f"/{art['phases']['detached']['expected']} "
+      f"leaks=0w/0o/0shm")
+EOF
+  rm -f "$js_json"
+}
+
 case "$STAGE" in
   --native)     run_native ;;
   --fast)       run_fast ;;
@@ -416,12 +473,13 @@ case "$STAGE" in
   --trainstorm) run_trainstorm ;;
   --memstorm)   run_memstorm ;;
   --tracing)    run_tracing ;;
+  --jobstorm)   run_jobstorm ;;
   all)        run_native; run_fast; run_stress; run_chaos; run_serve_storm
               run_burst; run_head_failover; run_node_chaos
               run_partition_storm; run_servebench; run_trainstorm
-              run_memstorm; run_tracing ;;
+              run_memstorm; run_tracing; run_jobstorm ;;
   *) echo "unknown stage: $STAGE" \
-     "(use --native|--fast|--stress|--chaos|--storm|--burst|--failover|--node-chaos|--partition|--servebench|--trainstorm|--memstorm|--tracing)" >&2
+     "(use --native|--fast|--stress|--chaos|--storm|--burst|--failover|--node-chaos|--partition|--servebench|--trainstorm|--memstorm|--tracing|--jobstorm)" >&2
      exit 2 ;;
 esac
 echo "CI green"

@@ -103,6 +103,11 @@ def main(argv=None) -> int:
     p_mem = sub.add_parser("memory", help="object store usage per node")
     p_mem.add_argument("--address", required=True)
 
+    p_jobs = sub.add_parser(
+        "jobs", help="per-job state: status, live/detached actors, "
+        "pending tasks, owned bytes, fate-sharing reap counters")
+    p_jobs.add_argument("--address", required=True)
+
     p_logs = sub.add_parser("logs", help="recent worker stdout/stderr")
     p_logs.add_argument("--address", required=True)
     p_logs.add_argument("--lines", type=int, default=200)
@@ -363,7 +368,7 @@ def main(argv=None) -> int:
         return 0
 
     if args.cmd in ("memory", "stack", "healthcheck", "global-gc",
-                    "kill-random-node", "logs", "profile"):
+                    "kill-random-node", "logs", "profile", "jobs"):
         # raw GCS/raylet RPC — no driver registration needed
         from ray_tpu.core import rpc as _rpc
 
@@ -417,6 +422,33 @@ def main(argv=None) -> int:
                     for line in entry.get("lines", []):
                         print(f"(pid={entry.get('pid')}, "
                               f"{entry.get('stream')}) {line}")
+                return 0
+            if args.cmd == "jobs":
+                st = gcs.call("gcs_stats", timeout=10)
+                jobs_blk = st.get("jobs", [])
+                # live per-driver numbers (pending tasks, owned bytes)
+                # come from each RUNNING driver's own owner_stats RPC —
+                # ownership lives in the driver, not the GCS
+                for j in jobs_blk:
+                    if j.get("status") != "RUNNING" \
+                            or not j.get("driver_address"):
+                        continue
+                    try:
+                        c = _rpc.connect_with_retry(j["driver_address"],
+                                                    timeout=3)
+                        try:
+                            own = c.call("owner_stats", timeout=5)
+                        finally:
+                            c.close()
+                        j["pending_tasks"] = own.get("pending_tasks")
+                        j["owned_objects"] = own.get("owned_objects")
+                        j["owned_bytes"] = own.get("owned_bytes")
+                    except Exception as e:
+                        j["owner_stats_error"] = str(e)
+                print(json.dumps(
+                    {"jobs": jobs_blk,
+                     "job_failure": st.get("job_failure", {})},
+                    indent=2, default=str))
                 return 0
             if args.cmd == "memory":
                 out = []

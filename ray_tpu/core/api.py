@@ -297,9 +297,21 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
     _global_worker().kill_actor(actor.actor_id, no_restart)
 
 
-def cancel(ref: ObjectRef, *, force: bool = False) -> None:
-    # Round-1: cooperative cancellation is not yet wired; parity stub.
-    logger.warning("cancel() is not yet supported; task will run to completion")
+def cancel(ref: ObjectRef, *, force: bool = False,
+           recursive: bool = False) -> None:
+    """Cancel the task that produces `ref` (reference `ray.cancel`).
+
+    Best-effort on the work, hard guarantee on the ref: once the owner
+    claims the cancel, `get(ref)` resolves to `TaskCancelledError` — never
+    hangs — whether the task was still queued (raylet dequeue), running
+    (cooperative exception injection at the next bytecode boundary), or a
+    queued actor call (purged from the actor's mailbox). A task that
+    already completed keeps its value. `force=True` escalates a running
+    task to SIGKILL of its worker (non-retryable); `recursive=True` walks
+    each owner's child-task table (parent_task_id lineage) so the whole
+    tree dies leaf-ward with no orphaned grandchildren."""
+    w = _global_worker()
+    w.cancel(ref, force=force, recursive=recursive)
 
 
 # ------------------------------------------------------------------ cluster

@@ -281,6 +281,26 @@ class Config:
     # interval; full jitter)
     result_retry_backoff_cap_ms: int = 2000
 
+    # --- job failure domain (cancellation + driver-death fate-sharing) ---
+    # RAY_TPU_JOB_REAP_DETECTION_BOUND_S: ceiling from driver death to the
+    # GCS *initiating* the fleet reap. Conn-close detection is immediate;
+    # this bounds the backstop paths (health-loop probe of a RUNNING job
+    # whose driver link is gone, and post-failover probe of snapshot-
+    # restored jobs whose conn-close hooks died with the old head).
+    job_reap_detection_bound_s: float = 3.0
+    # RAY_TPU_JOB_REAP_PACING_MS: sleep between per-target reap steps
+    # (per-raylet purge notify, per-actor kill) so reaping a large job is a
+    # paced drain, not a thundering herd against surviving tenants.
+    job_reap_pacing_ms: int = 10
+    # owner-side failsafe: after cancel() is sent, if no downstream ack
+    # (dequeue notify, cooperative error, kill report) resolved the ref
+    # within this window, the owner resolves it to TaskCancelledError
+    # itself — a cancelled ref may never hang on a lost notify
+    task_cancel_resolution_timeout_s: float = 10.0
+    # force=True: cooperative interrupt is pushed first (lets a recursive
+    # cancel fan out to children), SIGKILL follows after this grace
+    task_cancel_force_grace_ms: int = 200
+
     # --- logging / session ---
     session_dir_root: str = "/tmp/ray_tpu"
     log_to_driver: bool = True

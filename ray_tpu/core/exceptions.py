@@ -61,8 +61,30 @@ class OutOfMemoryError(WorkerCrashedError):
     worker_killing_policy.h:34 + OutOfMemoryError in ray.exceptions)."""
 
 
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled — by `ray_tpu.cancel()` (directly, or as part
+    of a `recursive=True` tree walk) or by the job failure domain reaping a
+    dead driver's work. A cancelled ref ALWAYS resolves to this error: the
+    owner stamps it whether the task was still queued (raylet dequeue), was
+    interrupted mid-execution (cooperative exception injection, or SIGKILL
+    under force=True), or completed in the race window after cancel() was
+    called (the late value is dropped so the outcome is deterministic).
+    Never retried. Matched BY TYPE by callers, the workflow engine and the
+    job storm; don't match the message."""
+
+
 class ObjectLostError(RayTpuError):
     """An object was lost (e.g. node died) and could not be reconstructed."""
+
+
+class OwnerDiedError(ObjectLostError):
+    """The object's owner process is dead, so the value can never be
+    produced or re-resolved: the owner holds the authoritative location
+    and lineage for its objects (ownership model), and the job failure
+    domain drops a dead job's primary copies during the reap. Surfaced by
+    cross-job `get()` of a reaped job's object. A subclass of
+    ObjectLostError so existing lost-object handling still applies;
+    matched BY TYPE by the job storm — don't match the message."""
 
 
 class ObjectStoreFullError(RayTpuError):

@@ -55,6 +55,13 @@ class FunctionTableClient:
         self.cache_hits = 0
         self.cache_misses = 0
 
+    def _job_id_bytes(self) -> Optional[bytes]:
+        # The GCS job-ownership index tolerates None (unit-test fakes and
+        # pre-connect workers have no job id); a missing attribute must not
+        # demote the export to the inline-pickle fallback.
+        jid = getattr(self._worker, "job_id", None)
+        return jid.binary() if jid is not None else None
+
     # ------------------------------------------------------------ submitter
     def export(self, obj: Any) -> Tuple[Optional[bytes], Optional[bytes]]:
         """Export a callable/class for a spec. Returns (function_id, None)
@@ -98,7 +105,9 @@ class FunctionTableClient:
             if fid in self._exported_ids:
                 return
         self._worker.gcs.call(
-            "function_put", {"function_id": fid, "blob": blob}, timeout=30)
+            "function_put", {"function_id": fid, "blob": blob,
+                             "job_id": self._job_id_bytes()},
+            timeout=30)
         with self._lock:
             self._exported_ids.add(fid)
 
@@ -111,7 +120,8 @@ class FunctionTableClient:
         for fid, blob in entries:
             try:
                 raw_client.call("function_put",
-                                {"function_id": fid, "blob": blob},
+                                {"function_id": fid, "blob": blob,
+                                 "job_id": self._job_id_bytes()},
                                 timeout=30)
             except Exception:
                 # Un-mark the export: leaving it in _exported_ids would make

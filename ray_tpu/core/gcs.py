@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import os
 import logging
+import socket
 import threading
 import time
 from collections import OrderedDict
@@ -87,6 +88,21 @@ def _node_metrics() -> dict:
             "counter", "ray_tpu_stale_incarnation_rejections_total",
             "messages rejected for carrying a superseded node/actor "
             "incarnation", tag_keys=("site",)),
+    }
+
+
+def _job_metrics() -> dict:
+    """Job failure-domain metric handles: driver-death fate-sharing reaps
+    declared by the GCS (conn-close fast path, probe backstop, or
+    post-failover snapshot probe)."""
+    from ray_tpu.util.metrics import get_or_create
+
+    return {
+        "reaps": get_or_create(
+            "counter", "ray_tpu_job_reaps_total",
+            "dead jobs reaped (driver-death fate-sharing): non-detached "
+            "actors killed, tasks cancelled, leases and demand released, "
+            "owned objects dropped, function exports freed"),
     }
 
 
@@ -295,6 +311,27 @@ class GcsServer:
 
         # jobs
         self._jobs: Dict[bytes, dict] = {}
+        # --- job failure domain (driver-death fate-sharing) ---
+        # live driver conn IDENTITY per job: the conn-close hook only reaps
+        # if ITS conn is still the registered one — a reconnecting driver
+        # re-registers on a new conn first, and the old conn's late close
+        # must not reap the live job
+        self._job_conns: Dict[bytes, int] = {}
+        # probe backstop: RUNNING jobs with no live conn (close hook lost,
+        # or restored from a snapshot after failover) get their
+        # driver_address probed once this monotonic deadline passes
+        self._job_probe_after: Dict[bytes, float] = {}
+        # snapshot-restored jobs flipped RUNNING->FAILED that still need a
+        # probe-then-reap (a surviving driver re-registers and escapes)
+        self._restored_unreaped: Dict[bytes, None] = {}
+        # function exports by owning job: an export is freed at reap only
+        # when the dead job was its LAST owner (shared content-addressed
+        # blobs survive)
+        self._function_jobs: Dict[bytes, set] = {}
+        self._job_reap_stats: Dict[str, int] = {
+            "jobs_reaped": 0, "actors_killed": 0, "detached_spared": 0,
+            "queued_cancelled": 0, "workers_killed": 0,
+            "objects_dropped": 0, "bytes_dropped": 0, "functions_freed": 0}
 
         # task events: ring buffer of recent task lifecycle records
         # (reference GcsTaskManager + per-worker TaskEventBuffer,
@@ -560,10 +597,15 @@ class GcsServer:
                 for jid, job in data.get("jobs", {}).items():
                     job = dict(job)
                     if job.get("status") == "RUNNING":
-                        # its driver died with the old head; nothing will
-                        # ever mark it finished
+                        # its driver may have died with the old head;
+                        # nothing will ever mark it finished. But a
+                        # SURVIVING driver re-registers (replay) and
+                        # revives the entry — so flip it FAILED now and
+                        # only REAP after the health loop's probe finds
+                        # its driver_address actually dead.
                         job["status"] = "FAILED"
                         job.setdefault("end_time", time.time())
+                        self._restored_unreaped[jid] = None
                     self._jobs[jid] = job
                 # Actors come back as awaiting-re-registration: their budget
                 # and identity restore from the snapshot, liveness only from
@@ -1490,6 +1532,10 @@ class GcsServer:
             # still-provisional snapshot-restored nodes get re-dialed (with
             # the fencing epoch) until they adopt us or the reaper wins
             self._maybe_reannounce_restored()
+            # driver-death backstop: RUNNING jobs with no live conn and
+            # snapshot-restored unreaped jobs get probed within
+            # job_reap_detection_bound_s
+            self._maybe_probe_dead_drivers(time.monotonic())
 
     _RESTART_RETRY_INTERVAL_S = 1.0
 
@@ -1857,6 +1903,12 @@ class GcsServer:
         a GCS restart, two submitters racing) is a no-op."""
         with self._lock:
             self._function_puts += 1
+            jid = payload.get("job_id")
+            if jid is not None:
+                # job ownership index: the fate-sharing reap frees an
+                # export only when the dead job was its LAST owner
+                self._function_jobs.setdefault(
+                    payload["function_id"], set()).add(jid)
             if payload["function_id"] not in self._functions:
                 self._functions[payload["function_id"]] = payload["blob"]
                 self._function_bytes += len(payload["blob"])
@@ -1870,6 +1922,7 @@ class GcsServer:
                 while self._function_bytes > budget and len(self._functions) > 1:
                     old_id = next(iter(self._functions))
                     self._function_bytes -= len(self._functions.pop(old_id))
+                    self._function_jobs.pop(old_id, None)
                     self._function_evictions += 1
                     logger.warning(
                         "function table over %d bytes; evicted oldest "
@@ -1972,6 +2025,43 @@ class GcsServer:
                     joins[-1]["join_to_first_warm_lease_s"] if joins
                     else None,
             }
+            # job failure domain: per-job live-actor roll-up + fate-sharing
+            # reap counters (the gcs_stats face of ray_tpu_job_reaps_total;
+            # `ray_tpu jobs` renders this block)
+            live_actors: Dict[bytes, int] = {}
+            detached_actors: Dict[bytes, int] = {}
+            for aid, spec in self._actor_specs.items():
+                info = self._actors.get(aid)
+                if info is None or info.state == ActorState.DEAD:
+                    continue
+                sj = getattr(spec, "job_id", None)
+                sjb = sj.binary() if hasattr(sj, "binary") else sj
+                if sjb is None:
+                    continue
+                # live_actors counts EVERY non-dead actor of the job;
+                # detached_actors is the subset a reap would spare, so
+                # live - detached == what fate-sharing still owes the reaper
+                live_actors[sjb] = live_actors.get(sjb, 0) + 1
+                if getattr(spec, "lifetime", "non_detached") == "detached":
+                    detached_actors[sjb] = detached_actors.get(sjb, 0) + 1
+            jobs_blk = []
+            for jid, j in self._jobs.items():
+                jobs_blk.append({
+                    "job_id": jid.hex() if isinstance(jid, bytes) else str(jid),
+                    "status": j.get("status"),
+                    "driver_address": j.get("driver_address", ""),
+                    "start_time": j.get("start_time"),
+                    "end_time": j.get("end_time"),
+                    "death_cause": j.get("death_cause"),
+                    "live_actors": live_actors.get(jid, 0),
+                    "detached_actors": detached_actors.get(jid, 0),
+                    "reap": j.get("reap"),
+                })
+            job_failure = dict(self._job_reap_stats)
+            job_failure["jobs_tracked"] = len(self._jobs)
+            job_failure["jobs_running"] = sum(
+                1 for j in self._jobs.values()
+                if j.get("status") == "RUNNING")
         return {
             "address": self._server.address,
             "session_id": self.session_id,
@@ -1986,6 +2076,8 @@ class GcsServer:
             "fencing_rejections": self._fencing_rejections,
             "broadcast": bcast,
             "node_failure": node_failure,
+            "job_failure": job_failure,
+            "jobs": jobs_blk,
             "storage": storage,
             "tracing": tracing_blk,
             "promotion": dict(self.promotion) if self.promotion else None,
@@ -1993,14 +2085,33 @@ class GcsServer:
 
     # ---------------------------------------------------------------- jobs
     def rpc_register_job(self, conn, req_id, payload):
+        job_id = payload["job_id"]
         with self._lock:
             self._dirty = True
-            self._jobs[payload["job_id"]] = {
-                "job_id": payload["job_id"],
-                "driver_address": payload.get("driver_address", ""),
-                "start_time": time.time(),
-                "status": "RUNNING",
-            }
+            existing = self._jobs.get(job_id)
+            if existing is not None:
+                # re-registration: a driver reconnecting after a head
+                # failover (its job may have been flipped FAILED at
+                # snapshot restore) or after a conn blip. Revive it —
+                # liveness comes from the driver itself, not the table.
+                existing["status"] = "RUNNING"
+                existing.pop("end_time", None)
+                existing["driver_address"] = payload.get(
+                    "driver_address", existing.get("driver_address", ""))
+            else:
+                self._jobs[job_id] = {
+                    "job_id": job_id,
+                    "driver_address": payload.get("driver_address", ""),
+                    "start_time": time.time(),
+                    "status": "RUNNING",
+                }
+            # adopt THIS conn as the driver's identity; any older conn's
+            # close hook is superseded and must not reap
+            self._job_conns[job_id] = id(conn)
+            self._job_probe_after.pop(job_id, None)
+            self._restored_unreaped.pop(job_id, None)
+        conn.on_close.append(
+            lambda c, jid=job_id: self._on_driver_conn_close(jid, id(c)))
         return True
 
     def rpc_mark_job_finished(self, conn, req_id, payload):
@@ -2010,11 +2121,223 @@ class GcsServer:
                 j["status"] = payload.get("status", "SUCCEEDED")
                 j["end_time"] = time.time()
                 self._dirty = True
+                # clean exit: the later conn close finds status != RUNNING
+                # and does nothing — finished jobs are NOT reaped (their
+                # detached AND non-detached actors keep today's semantics)
+                self._job_conns.pop(payload["job_id"], None)
         return True
 
     def rpc_get_jobs(self, conn, req_id, payload):
         with self._lock:
             return list(self._jobs.values())
+
+    # ----------------------------- driver-death fate-sharing (job reap)
+    def _on_driver_conn_close(self, job_id: bytes, conn_id: int) -> None:
+        with self._lock:
+            if self._job_conns.get(job_id) != conn_id:
+                return  # superseded by a reconnect: not the live driver
+            self._job_conns.pop(job_id, None)
+            j = self._jobs.get(job_id)
+            if j is None or j.get("status") != "RUNNING":
+                return  # clean exit already marked finished
+            addr = j.get("driver_address", "")
+        # Conn loss is not proof of death (a blip severs the socket while
+        # the driver lives and reconnects). Probe the driver's own RPC
+        # server: refused -> the process is gone, reap now; accepting ->
+        # arm the health-loop backstop and let re-registration cancel it.
+        if self._driver_alive(addr):
+            with self._lock:
+                self._job_probe_after[job_id] = (
+                    time.monotonic()
+                    + get_config().job_reap_detection_bound_s)
+            return
+        # reap OFF the RPC loop: it fans out calls to every raylet
+        threading.Thread(
+            target=self._fail_and_reap_job,
+            args=(job_id, "driver connection closed"),
+            name="gcs-job-reap", daemon=True).start()
+
+    @staticmethod
+    def _driver_alive(address: str) -> bool:
+        """Cheap liveness probe of the driver's worker RPC server: a bare
+        TCP connect. A dead process's port refuses; a live driver's server
+        accepts even while its GCS conn is severed."""
+        if not address:
+            return False
+        host, _, port = address.rpartition(":")
+        try:
+            s = socket.create_connection((host, int(port)), timeout=1.0)
+            s.close()
+            return True
+        except (OSError, ValueError):
+            return False
+
+    def _maybe_probe_dead_drivers(self, now: float) -> None:
+        """Health-loop backstop: RUNNING jobs with no live driver conn
+        (close hook lost with an old head, blip-severed socket) and
+        snapshot-restored jobs flipped FAILED get their driver probed
+        within job_reap_detection_bound_s; dead ones are reaped."""
+        bound = get_config().job_reap_detection_bound_s
+        due = []
+        with self._lock:
+            for jid, j in self._jobs.items():
+                running = j.get("status") == "RUNNING"
+                restored = jid in self._restored_unreaped
+                if not (running or restored):
+                    continue
+                if running and jid in self._job_conns:
+                    continue  # live conn: the close hook covers it
+                after = self._job_probe_after.get(jid)
+                if after is None:
+                    self._job_probe_after[jid] = now + bound
+                elif now >= after:
+                    due.append((jid, j.get("driver_address", "")))
+        for jid, addr in due:
+            if self._driver_alive(addr):
+                # alive but not (re-)registered yet — replay in progress
+                # or a long blip; keep probing, never reap a live driver
+                with self._lock:
+                    self._job_probe_after[jid] = now + bound
+                continue
+            self._fail_and_reap_job(jid, "driver unreachable")
+
+    def _fail_and_reap_job(self, job_id: bytes, cause: str) -> None:
+        with self._lock:
+            j = self._jobs.get(job_id)
+            if j is None:
+                return
+            if j.get("status") != "RUNNING" \
+                    and job_id not in self._restored_unreaped:
+                return
+            self._restored_unreaped.pop(job_id, None)
+            self._job_probe_after.pop(job_id, None)
+            self._job_conns.pop(job_id, None)
+            j["status"] = "DEAD"
+            j.setdefault("end_time", time.time())
+            j["death_cause"] = cause
+            self._dirty = True
+        logger.warning("job %s driver died (%s); reaping its actors, "
+                       "tasks, leases and objects", job_id.hex()[:8], cause)
+        self._reap_job(job_id, cause)
+
+    def _reap_job(self, job_id: bytes, cause: str) -> None:
+        """Fate-sharing sweep for a dead job: kill its non-detached actors
+        (detached ones are GCS-owned and survive), call reap_job on every
+        alive raylet (queued-task purge, worker kills, lease/demand
+        release, owned-object drop), and free function exports the job was
+        the last owner of. Counters land in gcs_stats.job_failure and
+        ray_tpu_job_reaps_total."""
+        pacing = get_config().job_reap_pacing_ms / 1000.0
+        with self._lock:
+            doomed, spared = [], 0
+            for aid, spec in list(self._actor_specs.items()):
+                sj = getattr(spec, "job_id", None)
+                sjb = sj.binary() if hasattr(sj, "binary") else sj
+                if sjb != job_id:
+                    continue
+                if getattr(spec, "lifetime", "non_detached") == "detached":
+                    spared += 1
+                    continue
+                info = self._actors.get(aid)
+                if info is None or info.state == ActorState.DEAD:
+                    continue
+                doomed.append(aid)
+            node_ids = [nid for nid, n in self._nodes.items()
+                        if n.get("alive")]
+        for aid in doomed:
+            self._kill_actor_for_reap(aid, cause)
+            if pacing:
+                time.sleep(pacing)
+        totals = {"queued_cancelled": 0, "workers_killed": 0,
+                  "objects_dropped": 0, "bytes_dropped": 0}
+        for nid in node_ids:
+            client = self._raylet_client(nid)
+            if client is None:
+                continue
+            try:
+                r = client.call("reap_job", {"job_id": job_id}, timeout=10)
+            except (OSError, TimeoutError, rpc.RpcCallError,
+                    rpc.RpcDisconnected) as e:
+                logger.info("reap_job on raylet %s failed: %s",
+                            nid.hex()[:8], e)
+                continue
+            for k in totals:
+                totals[k] += (r or {}).get(k, 0)
+            if pacing:
+                time.sleep(pacing)
+        freed = 0
+        with self._lock:
+            # exports still referenced by a SURVIVING actor's creation spec
+            # (a spared detached actor, another job's actor) must outlive
+            # the job: a later restart resolves its class through them
+            keep_fids = set()
+            for aid, spec in self._actor_specs.items():
+                info = self._actors.get(aid)
+                if info is None or info.state == ActorState.DEAD:
+                    continue
+                fid = getattr(spec, "class_fn_id", None)
+                if fid is not None:
+                    keep_fids.add(fid)
+            for fid, jobs in list(self._function_jobs.items()):
+                jobs.discard(job_id)
+                if jobs or fid in keep_fids:
+                    continue
+                self._function_jobs.pop(fid, None)
+                blob = self._functions.pop(fid, None)
+                if blob is not None:
+                    self._function_bytes -= len(blob)
+                    freed += 1
+                    self._dirty = True
+            st = self._job_reap_stats
+            st["jobs_reaped"] += 1
+            st["actors_killed"] += len(doomed)
+            st["detached_spared"] += spared
+            st["functions_freed"] += freed
+            for k, v in totals.items():
+                st[k] += v
+            j = self._jobs.get(job_id)
+            if j is not None:
+                j["reap"] = {"actors_killed": len(doomed),
+                             "detached_spared": spared,
+                             "functions_freed": freed, **totals}
+                self._dirty = True
+        try:
+            _job_metrics()["reaps"].inc()
+        except Exception:
+            pass
+        logger.warning(
+            "job %s reaped: %d actors killed (%d detached spared), %d "
+            "queued tasks cancelled, %d workers killed, %d objects "
+            "(%d bytes) dropped, %d function exports freed",
+            job_id.hex()[:8], len(doomed), spared,
+            totals["queued_cancelled"], totals["workers_killed"],
+            totals["objects_dropped"], totals["bytes_dropped"], freed)
+
+    def _kill_actor_for_reap(self, actor_id: ActorID, cause: str) -> None:
+        """rpc_kill_actor's no-restart path, with an owner-died death
+        cause: exhaust the budget, notify the hosting raylet, publish
+        DEAD so in-flight callers fail typed instead of hanging."""
+        death_cause = f"owner job died: {cause}"
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None or info.state == ActorState.DEAD:
+                return
+            info.max_restarts = info.num_restarts  # exhaust budget
+            info.state = ActorState.DEAD
+            info.death_cause = death_cause
+            node_id = info.node_id
+            info.address = ""
+            self._awaiting_rereg.pop(actor_id, None)
+            self._dirty = True
+            client = self._raylet_clients.get(node_id) if node_id else None
+        if client is not None:
+            try:
+                client.notify("kill_actor_worker", {"actor_id": actor_id})
+            except OSError as e:
+                logger.debug("reap kill_actor notify to dead raylet: %s", e)
+        self._publish(CH_ACTORS, {"actor_id": actor_id, "state": "DEAD",
+                                  "address": "",
+                                  "death_cause": death_cause})
 
     # ------------------------------------------------------------ task events
     def _ingest_task_event(self, payload) -> None:

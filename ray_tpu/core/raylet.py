@@ -232,6 +232,22 @@ class Raylet:
         # carries reason="oom" so exhausted retries surface OutOfMemoryError
         self._oom_killed: set = set()
         self.oom_kills_total = 0  # monotonic; read by memstorm/tests
+        # workers we SIGKILLed for a force-cancel or a job reap: their death
+        # notification carries reason="cancelled" so the owner (if any is
+        # left) resolves the typed error with no retry
+        self._cancel_killed: set = set()
+        # primary copy -> owning job (stamped at obj_create): a job reap
+        # deletes the dead job's objects by this index; entries die with
+        # the object (delete/reap) and are pruned against the store on reap
+        self._obj_jobs: Dict[ObjectID, bytes] = {}
+        # recently reaped jobs: a reaped worker's death must not dial the
+        # dead driver (the owner-notify paths skip these)
+        self._reaped_jobs: Dict[bytes, float] = {}
+        # cumulative reap counters, returned per reap + summed by the GCS
+        self.job_reap_stats = {
+            "jobs": 0, "queued_cancelled": 0, "workers_killed": 0,
+            "actor_specs_dropped": 0, "objects_dropped": 0,
+            "bytes_dropped": 0}
         # Raylets have no TaskEventBuffer (that is a worker-side object), so
         # lease spans ship on the heartbeat cadence via the same
         # task_events_batch channel: drain cursor + carry-over drop count +
@@ -1135,12 +1151,19 @@ class Raylet:
             return
         was_oom = wid in self._oom_killed
         self._oom_killed.discard(wid)
+        was_cancel = wid in self._cancel_killed
+        self._cancel_killed.discard(wid)
         if handle.tpu_grant is not None:
             self._release_tpus(*handle.tpu_grant)
             handle.tpu_grant = None
         if spec is not None:
             self._release_resources(spec)
-            self._notify_owner_worker_died(spec, reason="oom" if was_oom else "")
+            if not self._job_reaped(spec.job_id):
+                # reaped jobs skip the notify: the owner IS the dead driver
+                # (or one of its killed workers) — dialing it buys nothing
+                reason = ("cancelled" if was_cancel
+                          else "oom" if was_oom else "")
+                self._notify_owner_worker_died(spec, reason=reason)
         # Batched-result loss failover: tasks completed in the last few
         # flush intervals may have died with their results still in the
         # worker's ResultBuffer (task_done precedes result delivery under
@@ -1205,6 +1228,170 @@ class Raylet:
                          {"task_id": spec.task_id, "reason": reason})
         except Exception:
             logger.warning("could not notify owner of dead worker for task %s", spec.task_id)
+
+    # ------------------------------------------------- cancellation / reap
+    def _job_reaped(self, job_id) -> bool:
+        key = job_id.binary() if hasattr(job_id, "binary") else job_id
+        with self._lock:
+            return key in self._reaped_jobs
+
+    def rpc_cancel_task(self, conn, req_id, payload):
+        """Owner-side cancel reaching the task's node of record. Queued:
+        dequeue + typed ack to the owner (no children can exist — the task
+        never ran). Running: push the cooperative interrupt to the hosting
+        worker (which fans out any recursive child cancels as their owner);
+        force=True SIGKILLs after a short grace so the interrupt gets a
+        chance to propagate first. Not here at all: forward once along the
+        owner-recorded spill hop, else stay silent — the owner's failsafe
+        owns resolution for acks lost in transit."""
+        task_id: TaskID = payload["task_id"]
+        force = bool(payload.get("force"))
+        with self._lock:
+            qt = next((q for q in self._queue
+                       if q.spec.task_id == task_id), None)
+            if qt is not None:
+                self._queue.remove(qt)
+        if qt is not None:
+            try:
+                self._peer(qt.spec.owner_address).notify("task_cancelled", {
+                    "task_id": task_id,
+                    "detail": (f"task {qt.spec.method_name} was cancelled "
+                               f"while queued")})
+            except Exception:
+                logger.debug("task_cancelled ack lost", exc_info=True)
+            return True
+        with self._lock:
+            target = next((h for h in self._workers.values()
+                           if h.current_task is not None
+                           and h.current_task.task_id == task_id), None)
+        if target is None:
+            hint = payload.get("spilled_node_id")
+            if hint is not None and hint != self.node_id.binary():
+                v = self._cluster_view.get(hint.hex())
+                if v is not None:
+                    fwd = dict(payload)
+                    fwd.pop("spilled_node_id", None)
+                    try:
+                        self._peer(v["address"]).notify("cancel_task", fwd)
+                    except Exception:
+                        logger.debug("cancel forward to %s lost",
+                                     hint.hex()[:8], exc_info=True)
+            return True
+        try:
+            target.conn.push("cancel_task", {
+                "task_id": task_id, "force": force,
+                "recursive": bool(payload.get("recursive"))})
+        except Exception:
+            logger.debug("cancel push to worker %d lost", target.pid,
+                         exc_info=True)
+        if force:
+            t = threading.Timer(
+                get_config().task_cancel_force_grace_ms / 1000.0,
+                self._force_kill_cancelled, args=(task_id,))
+            t.daemon = True
+            t.start()
+        return True
+
+    def _force_kill_cancelled(self, task_id: TaskID) -> None:
+        """force=True escalation: the cooperative grace expired and a
+        worker is STILL on the task — SIGKILL it. The disconnect path then
+        reports reason="cancelled" and the owner resolves typed,
+        non-retryable (it zeroed the retry budget at cancel)."""
+        with self._lock:
+            target = next((h for h in self._workers.values()
+                           if h.current_task is not None
+                           and h.current_task.task_id == task_id), None)
+            if target is None:
+                return  # interrupt landed (or task finished) in the grace
+            self._cancel_killed.add(target.worker_id)
+        logger.info("force-cancel: killing worker %d still running task "
+                    "after grace", target.pid)
+        try:
+            if target.proc is not None:
+                target.proc.kill()
+            else:
+                os.kill(target.pid, 9)
+        except OSError:
+            self._cancel_killed.discard(target.worker_id)
+
+    def rpc_reap_job(self, conn, req_id, payload):
+        """GCS push: a job died (driver SIGKILL/OOM/preemption) — purge
+        every trace of it from this node: queued tasks (no owner ack; the
+        owner IS the corpse), running task workers (SIGKILL, marked so the
+        disconnect path skips the dead-owner notify), pending actor specs,
+        and the job's primary object copies. Actor WORKERS are killed by
+        the GCS's per-actor kill_actor_worker pushes riding the same reap —
+        not here — so a detached actor's worker is never touched. Returns
+        this node's reap counters for the GCS rollup."""
+        job_id: bytes = payload["job_id"]
+        pace = max(0.0, get_config().job_reap_pacing_ms / 1000.0)
+        now = time.monotonic()
+        with self._lock:
+            self._reaped_jobs[job_id] = now
+            for k, ts in list(self._reaped_jobs.items()):
+                if now - ts > 600.0:
+                    del self._reaped_jobs[k]
+            doomed_q = [qt for qt in self._queue
+                        if qt.spec.job_id.binary() == job_id]
+            for qt in doomed_q:
+                self._queue.remove(qt)
+            doomed_specs = [
+                s for s in self._pending_actor_specs
+                if getattr(s, "job_id", None) is not None
+                and s.job_id.binary() == job_id]
+            for s in doomed_specs:
+                self._pending_actor_specs.remove(s)
+            victims = [h for h in self._workers.values()
+                       if h.actor_id is None
+                       and h.current_task is not None
+                       and h.current_task.job_id.binary() == job_id]
+            for h in victims:
+                self._cancel_killed.add(h.worker_id)
+            doomed_objs = [oid for oid, jid in self._obj_jobs.items()
+                           if jid == job_id]
+            for oid in doomed_objs:
+                self._obj_jobs.pop(oid, None)
+        for h in victims:
+            try:
+                if h.proc is not None:
+                    h.proc.kill()
+                else:
+                    os.kill(h.pid, 9)
+            except OSError:
+                pass  # exited on its own between pick and kill
+            if pace:
+                time.sleep(pace)
+        bytes_dropped = 0
+        for oid in doomed_objs:
+            loc = self.store.lookup(oid)
+            if loc is not None:
+                bytes_dropped += loc[1]
+            self.store.delete(oid)
+            self._resolve_pulls(oid, "owner job reaped")
+        # spawn demand queued for the purged backlog would fork workers
+        # into a vacuum; serve re-reads live backlog, this just drops the
+        # stale figures ahead of it
+        self._worker_pool.shed_demand()
+        counters = {
+            "queued_cancelled": len(doomed_q),
+            "workers_killed": len(victims),
+            "actor_specs_dropped": len(doomed_specs),
+            "objects_dropped": len(doomed_objs),
+            "bytes_dropped": bytes_dropped,
+        }
+        with self._lock:
+            self.job_reap_stats["jobs"] += 1
+            for k, v in counters.items():
+                self.job_reap_stats[k] += v
+        if any(counters.values()):
+            logger.info(
+                "reaped job %s: %d queued tasks, %d workers, %d pending "
+                "actors, %d objects (%d bytes)", job_id.hex()[:8],
+                counters["queued_cancelled"], counters["workers_killed"],
+                counters["actor_specs_dropped"], counters["objects_dropped"],
+                bytes_dropped)
+        self._schedule()
+        return counters
 
     # ---------------------------------------------------------- memory guard
     def _memory_monitor_loop(self) -> None:
@@ -2203,6 +2390,12 @@ class Raylet:
             shm = self.store.create(object_id, size, info=info)
             name = shm.name
             shm.close()
+            jid = payload.get("job_id")
+            if jid is not None:
+                # job attribution of the primary copy: a dead job's reap
+                # deletes its objects by this index
+                with self._lock:
+                    self._obj_jobs[object_id] = jid
             return {"ok": True, "name": name,
                     "recycled": info.get("recycled", False)}
         except FileExistsError:
@@ -2301,6 +2494,8 @@ class Raylet:
         return self.store.lookup(payload["object_id"])
 
     def rpc_obj_delete(self, conn, req_id, payload):
+        with self._lock:
+            self._obj_jobs.pop(payload["object_id"], None)
         self.store.delete(payload["object_id"])
         # a pull parked on the (now unreachable) seal must not hang
         self._resolve_pulls(payload["object_id"], "object deleted")

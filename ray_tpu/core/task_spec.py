@@ -69,6 +69,14 @@ class TaskSpec:
     owner_address: str = ""
     owner_worker_id: Optional[WorkerID] = None
 
+    # Lineage for recursive cancellation: the task the SUBMITTER was
+    # executing when it submitted this one (None for driver-root submits).
+    # Each owner only knows its own children — a recursive cancel walks the
+    # tree hop by hop: cancel(A) reaches A's executor, which cancels its
+    # pending tasks whose parent_task_id == A, and so on leaf-ward
+    # (cf. reference TaskSpec parent_task_id / CancelTask recursive=True).
+    parent_task_id: Optional[TaskID] = None
+
     # Actor fields
     actor_id: Optional[ActorID] = None
     actor_creation_spec: Optional["ActorCreationSpec"] = None
@@ -114,6 +122,10 @@ class ActorCreationSpec:
     max_task_retries: int
     max_concurrency: int
     lifetime: str                  # "non_detached" | "detached"
+    # Owning job (stamped by the creating worker): the fate-sharing reap
+    # kills a dead job's non-detached actors by this field; detached actors
+    # are GCS-owned and ignore it. None only for specs predating the stamp.
+    job_id: Optional[JobID] = None
     # cloudpickled class — None when the class rides the function table
     class_blob: Optional[bytes] = None
     # export-once id of the class pickle (same fast lane as

@@ -40,6 +40,8 @@ from ray_tpu.core.exceptions import (
     GetTimeoutError,
     ObjectLostError,
     ObjectStoreFullError,
+    OwnerDiedError,
+    TaskCancelledError,
     TaskError,
     WorkerCrashedError,
 )
@@ -335,6 +337,21 @@ class CoreWorker:
         # yet, so only a node absent across two spaced checks fails over.
         self._absent_nodes: set = set()
 
+        # --- cancellation (job failure domain) ---
+        # Owner side: ids cancel() claimed while the task was still pending.
+        # Makes double-cancel idempotent, suppresses every retry path, and
+        # demotes a LATE success report to the typed error so a cancelled
+        # ref resolves deterministically. Guarded by _pending_lock.
+        self._cancelled_tasks: Dict[TaskID, float] = {}
+        # Executor side: ids cancelled before/while queued in THIS process
+        # (the actor-mailbox purge — _execute_task raises instead of
+        # running them) + the thread currently executing each task (the
+        # cooperative-interrupt injection target). Own lock: cancel pushes
+        # arrive on RPC reader threads while exec threads mutate the map.
+        self._cancel_lock = threading.Lock()
+        self._cancelled_exec: set = set()
+        self._exec_thread_ids: Dict[TaskID, int] = {}
+
         # actor state (when this worker hosts an actor)
         self.actor_id: Optional[ActorID] = None
         self._actor_instance: Any = None
@@ -559,6 +576,7 @@ class CoreWorker:
             owner_worker_id=self.worker_id,
             runtime_env=runtime_env,
             max_calls=max_calls,
+            parent_task_id=self._parent_for_submit(),
         )
         t_sub = self._stamp_trace_ctx(spec)
         refs = self._register_returns(spec)
@@ -862,8 +880,11 @@ class CoreWorker:
         deadline = time.monotonic() + cfg.put_full_timeout_s
         attempt = 0
         while True:
+            # job_id rides along so the raylet can attribute the primary
+            # copy: a dead job's reap deletes its objects by this stamp
             r = self.raylet.call("obj_create",
-                                 {"object_id": oid, "size": size})
+                                 {"object_id": oid, "size": size,
+                                  "job_id": self.job_id.binary()})
             if r.get("ok"):
                 break
             if not r.get("full"):
@@ -1028,8 +1049,11 @@ class CoreWorker:
             info = self.peer(ref.owner_address).call(
                 "get_object_info", {"object_id": ref.id, "wait": True},
                 timeout=timeout)
-        except rpc.RpcDisconnected:
-            raise ObjectLostError(
+        except (rpc.RpcDisconnected, OSError):
+            # conn severed mid-call OR connect refused outright — either
+            # way the ownership record is gone with the process (cross-job
+            # get of a reaped job's object lands here)
+            raise OwnerDiedError(
                 f"owner {ref.owner_address} of object {ref.id} died") from None
         except TimeoutError:
             raise GetTimeoutError(f"get() timed out waiting for {ref.id}") from None
@@ -1717,7 +1741,9 @@ class CoreWorker:
         # worker-death notification can't double-spend the budget.
         with self._pending_lock:
             pend = self._pending_tasks.get(task_id)
+            cancelled = task_id in self._cancelled_tasks
             retry = (pend is not None and pend[0].retry_exceptions and pend[1] > 0
+                     and not cancelled
                      and any(e[0] == "error" for e in results))
             if retry:
                 pend[1] -= 1
@@ -1726,6 +1752,17 @@ class CoreWorker:
                 self._pending_tasks.pop(task_id, None)
                 self._fence_resends.pop(task_id, None)
             self._task_locations.pop(task_id, None)
+        if cancelled:
+            if pend is None:
+                # the ref already resolved to TaskCancelledError (dequeue
+                # ack, kill report, or failsafe): a straggling report must
+                # not overwrite the typed terminal state with a value
+                return
+            # the task outran the cancel (completed in the race window):
+            # the outcome is still deterministic — demote to the typed error
+            blob = serialization.dumps(TaskCancelledError(
+                f"task {pend[0].method_name} was cancelled"))
+            results = [("error", e[1], blob) for e in results]
         if retry:
             delay = get_config().task_retry_delay_ms / 1000.0
             spec = pend[0]
@@ -1949,6 +1986,19 @@ class CoreWorker:
                 "queued": self._task_queue.qsize(),
                 "load": self._load_count}
 
+    def rpc_owner_stats(self, conn, req_id, payload):
+        """Live ownership footprint of this process (`ray_tpu jobs` dials
+        each RUNNING job's driver for the per-job live numbers the GCS
+        doesn't track centrally)."""
+        with self._pending_lock:
+            pending = len(self._pending_tasks)
+        with self._obj_lock:
+            owned = len(self._objects)
+            owned_bytes = sum((st.size or 0)
+                              for st in self._objects.values())
+        return {"job_id": self.job_id.binary(), "pending_tasks": pending,
+                "owned_objects": owned, "owned_bytes": owned_bytes}
+
     def rpc_task_spilled(self, conn, req_id, payload):
         """Raylet push: our task was spilled to another node. Recording the
         target is what lets node-level failure reach the owner — when that
@@ -2060,7 +2110,14 @@ class CoreWorker:
                            retries_left)
             self._resubmit_later(spec, get_config().task_retry_delay_ms / 1000.0)
             return True
-        if payload.get("reason") == "oom":
+        if payload.get("reason") == "cancelled":
+            # force=True escalation: the raylet SIGKILLed the worker on our
+            # cancel — non-retryable by construction (the cancel zeroed the
+            # budget), resolved typed
+            err_blob = serialization.dumps(TaskCancelledError(
+                f"task {spec.method_name} was force-cancelled "
+                f"(worker killed)"))
+        elif payload.get("reason") == "oom":
             from ray_tpu.core.exceptions import OutOfMemoryError
 
             err_blob = serialization.dumps(OutOfMemoryError(
@@ -2451,6 +2508,9 @@ class CoreWorker:
             from ray_tpu.runtime_env import upload_py_modules
 
             spec.runtime_env = upload_py_modules(spec.runtime_env, self.gcs)
+        # owning job: the fate-sharing reap kills non-detached actors of a
+        # dead job by this stamp (detached actors are GCS-owned and exempt)
+        spec.job_id = self.job_id
         r = self.gcs.call("register_actor", {
             "spec": spec, "owner_address": self.address, "class_name": class_name})
         if isinstance(r, dict) and r.get("error"):
@@ -2484,6 +2544,7 @@ class CoreWorker:
             sequence_number=seq,
             caller_id=self.worker_id,
             concurrency_group=concurrency_group,
+            parent_task_id=self._parent_for_submit(),
         )
         t_sub = self._stamp_trace_ctx(spec)
         refs = self._register_returns(spec)
@@ -2612,6 +2673,13 @@ class CoreWorker:
         with self._pending_lock:
             self._pending_tasks.pop(spec.task_id, None)
             self._task_locations.pop(spec.task_id, None)
+            if (spec.task_id in self._cancelled_tasks
+                    and not isinstance(err, TaskCancelledError)):
+                # once cancel() claimed the task, every failure path
+                # resolves typed — an actor-death or timeout racing the
+                # cancel must not change the contract
+                err = TaskCancelledError(
+                    f"task {spec.method_name} was cancelled ({err})")
         self._fence_resends.pop(spec.task_id, None)
         blob = serialization.dumps(err)
         for oid in spec.return_object_ids():
@@ -2624,6 +2692,168 @@ class CoreWorker:
             self._notify_info_waiters(oid)
         self._finish_dynamic(spec.task_id, [("error", None, blob)])
         self._unpin_after_task(spec)
+
+    # --------------------------------------------------------------- cancel
+    def _parent_for_submit(self) -> Optional[TaskID]:
+        """Lineage stamp for recursive cancellation: the task THIS thread
+        was executing when it submitted (None for driver-root submits)."""
+        cur = self._current_task_id
+        return None if cur == self._root_task_id else cur
+
+    def cancel(self, ref: ObjectRef, *, force: bool = False,
+               recursive: bool = False) -> None:
+        """Cancel the task producing `ref`. Best-effort on the work, hard
+        guarantee on the ref: once claimed here, the ref resolves to
+        TaskCancelledError — via raylet dequeue (still queued), cooperative
+        interrupt (running; force=True escalates to SIGKILL through the
+        worker-died path), actor-mailbox purge, or the local failsafe if
+        every downstream ack is lost. A task that already completed keeps
+        its value (reference semantics). recursive=True walks the lineage
+        (parent_task_id) hop by hop so the whole tree dies leaf-ward."""
+        self.cancel_task(ref.id.task_id(), force=force, recursive=recursive)
+
+    def cancel_task(self, task_id: TaskID, *, force: bool = False,
+                    recursive: bool = False) -> None:
+        now = time.monotonic()
+        with self._pending_lock:
+            pend = self._pending_tasks.get(task_id)
+            already = task_id in self._cancelled_tasks
+            if pend is None:
+                return  # completed (value stands) or never ours: no-op
+            self._cancelled_tasks[task_id] = now
+            # opportunistic prune: the guard entries only matter while a
+            # straggler report can still arrive
+            if len(self._cancelled_tasks) > 64:
+                for tid, ts in list(self._cancelled_tasks.items()):
+                    if now - ts > 600.0 and tid not in self._pending_tasks:
+                        del self._cancelled_tasks[tid]
+            pend[1] = 0  # a cancelled task is never retried
+            spec = pend[0]
+            location = self._task_locations.get(task_id)
+        if already:
+            return  # double-cancel: the first claim owns resolution
+        self._emit_task_event(spec, "CANCELLED")
+        payload = {"task_id": task_id, "force": force,
+                   "recursive": recursive, "owner_address": self.address}
+        try:
+            if spec.task_type == TaskType.ACTOR_TASK:
+                # the call sits in the target actor's mailbox (queued) or on
+                # one of its exec threads (running): cancel at the actor
+                addr = self._actor_addresses.get(spec.actor_id)
+                if addr is not None:
+                    self.peer(addr, connect_timeout_s=min(
+                        5.0, get_config().rpc_connect_timeout_s)).notify(
+                            "cancel_task", payload)
+                else:
+                    # still parked on actor resolution: nothing downstream
+                    # holds it — resolve right here
+                    self._fail_cancelled(spec)
+                    return
+            else:
+                if location is not None:
+                    # spilled: our raylet forwards to the node holding it
+                    payload["spilled_node_id"] = location
+                self.raylet.notify("cancel_task", payload)
+        except Exception:
+            logger.debug("cancel notify for %s lost", task_id, exc_info=True)
+        # Failsafe: a cancelled ref may NEVER hang. If no downstream ack
+        # (dequeue notify, cooperative error report, kill report) resolves
+        # the ref within the window, resolve it typed locally.
+        t = threading.Timer(get_config().task_cancel_resolution_timeout_s,
+                            self._cancel_failsafe, args=(task_id,))
+        t.daemon = True
+        t.start()
+
+    def _cancel_failsafe(self, task_id: TaskID) -> None:
+        with self._pending_lock:
+            pend = self._pending_tasks.get(task_id)
+        if pend is None:
+            return
+        logger.warning(
+            "cancel of %s got no downstream resolution within %.1fs; "
+            "resolving locally", pend[0].method_name,
+            get_config().task_cancel_resolution_timeout_s)
+        self._fail_cancelled(pend[0], "cancelled (no executor ack)")
+
+    def _fail_cancelled(self, spec: TaskSpec, detail: str = "") -> None:
+        self._fail_task(spec, TaskCancelledError(
+            detail or f"task {spec.method_name} was cancelled"))
+
+    def rpc_task_cancelled(self, conn, req_id, payload):
+        """Raylet ack: the task was dequeued (or purged in a job reap)
+        before running — resolve its refs to the typed error."""
+        task_id: TaskID = payload["task_id"]
+        with self._pending_lock:
+            pend = self._pending_tasks.get(task_id)
+            self._cancelled_tasks.setdefault(task_id, time.monotonic())
+        if pend is not None:
+            self._fail_cancelled(pend[0], payload.get("detail") or "")
+        return True
+
+    def rpc_cancel_task(self, conn, req_id, payload):
+        """Executor-side cancel (pushed by an owner at the hosting actor's
+        address, or relayed by our raylet for a plain task running here)."""
+        self._handle_exec_cancel(payload["task_id"],
+                                 force=bool(payload.get("force")),
+                                 recursive=bool(payload.get("recursive")),
+                                 owner_address=payload.get("owner_address"))
+        return True
+
+    def _handle_exec_cancel(self, task_id: TaskID, *, force: bool,
+                            recursive: bool,
+                            owner_address: Optional[str] = None) -> None:
+        """This PROCESS hosts the task (queued in a mailbox/exec queue, or
+        running on an exec thread): cancel it, children first."""
+        if recursive:
+            # tasks WE submitted while executing task_id are our pending
+            # entries stamped with it as parent — full owner-side cancel
+            # for each (they may be queued here, remote, or actor calls)
+            with self._pending_lock:
+                kids = [tid for tid, (spec, _r) in self._pending_tasks.items()
+                        if spec.parent_task_id == task_id]
+            for kid in kids:
+                try:
+                    self.cancel_task(kid, force=force, recursive=True)
+                except Exception:
+                    logger.debug("recursive cancel of child %s failed",
+                                 kid, exc_info=True)
+        with self._cancel_lock:
+            self._cancelled_exec.add(task_id)
+            thread_ident = self._exec_thread_ids.get(task_id)
+        if thread_ident is not None:
+            self._inject_cancel(task_id, thread_ident)
+        elif owner_address:
+            # Mailbox purge: the call is parked in this process's exec
+            # queue (possibly behind a long-running method) and nothing
+            # reports for it until it would have been dequeued — resolve
+            # the owner's ref NOW. The eventual precancelled dequeue ships
+            # a duplicate typed error the owner drops as a straggler.
+            try:
+                self.peer(owner_address, connect_timeout_s=min(
+                    5.0, get_config().rpc_connect_timeout_s)).notify(
+                        "task_cancelled",
+                        {"task_id": task_id,
+                         "detail": "cancelled while queued (mailbox purge)"})
+            except Exception:
+                logger.debug("mailbox-purge ack to %s lost", owner_address,
+                             exc_info=True)
+
+    def _inject_cancel(self, task_id: TaskID, thread_ident: int) -> None:
+        """Cooperative interruption of a RUNNING task: raise
+        TaskCancelledError inside the executing thread at its next bytecode
+        boundary (a task parked in a long C call only observes it on
+        return — force=True exists for those). The exec loop also guards
+        against an injection landing after the task finished."""
+        import ctypes
+
+        res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(thread_ident),
+            ctypes.py_object(TaskCancelledError))
+        if res > 1:
+            # invalid state: undo so an unrelated thread isn't poisoned
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(thread_ident), None)
+        logger.info("injected cancel into thread running task %s", task_id)
 
     def _log_print_queue(self) -> "queue.Queue":
         q = getattr(self, "_log_queue", None)
@@ -2858,6 +3088,10 @@ class CoreWorker:
             self._actor_tpu_ids = list(payload.get("tpu_ids") or [])
             self._become_actor(payload["spec"],
                                payload.get("incarnation"))
+        elif method == "cancel_task":
+            self._handle_exec_cancel(payload["task_id"],
+                                     force=bool(payload.get("force")),
+                                     recursive=bool(payload.get("recursive")))
         elif method == "global_gc":
             import gc
 
@@ -3146,7 +3380,16 @@ class CoreWorker:
                 spec = q.get(timeout=0.2)
             except queue.Empty:
                 continue
-            self._execute_task(spec)
+            except TaskCancelledError:
+                # an interrupt injected in the window after its task
+                # finished lands here: the thread must survive it
+                continue
+            try:
+                self._execute_task(spec)
+            except TaskCancelledError:
+                # injection raced the task's finally block; the task's own
+                # except path already reported — keep the thread alive
+                continue
 
     def _execute_task(self, spec: TaskSpec) -> None:
         """Run one task and route results to its owner
@@ -3181,9 +3424,19 @@ class CoreWorker:
         self._emit_task_event(spec, "RUNNING")
         with self._exec_count_lock:
             self._executing_count += 1
+        # cancellation: a task purged while queued (actor mailbox, exec
+        # queue) reports typed WITHOUT running; a task that starts registers
+        # its thread so a later cancel can inject the interrupt into it
+        with self._cancel_lock:
+            precancelled = spec.task_id in self._cancelled_exec
+            if not precancelled:
+                self._exec_thread_ids[spec.task_id] = threading.get_ident()
         failed = False
         results = []
         try:
+            if precancelled:
+                raise TaskCancelledError(
+                    f"task {spec.method_name} was cancelled before execution")
             if spec.task_type == TaskType.ACTOR_TASK:
                 if spec.method_name == "__ray_terminate__":
                     self.result_buffer.stop()
@@ -3244,6 +3497,13 @@ class CoreWorker:
             # mirroring put()'s container pins.
             for oid, v in zip(spec.return_object_ids(), values):
                 results.append(self._build_result_entry(oid, v))
+        except TaskCancelledError as e:
+            # ships the typed error DIRECTLY (not wrapped in TaskError):
+            # the owner's ref must resolve to TaskCancelledError by type
+            blob = serialization.dumps(TaskCancelledError(
+                str(e) or f"task {spec.method_name} was cancelled"))
+            results = [("error", oid, blob) for oid in spec.return_object_ids()]
+            failed = True
         except Exception as e:
             from ray_tpu.core.exceptions import ActorError
             cls = ActorError if spec.task_type == TaskType.ACTOR_TASK else TaskError
@@ -3252,6 +3512,9 @@ class CoreWorker:
             results = [("error", oid, blob) for oid in spec.return_object_ids()]
             failed = True
         finally:
+            with self._cancel_lock:
+                self._exec_thread_ids.pop(spec.task_id, None)
+                self._cancelled_exec.discard(spec.task_id)
             if traced:
                 tracing.set_ctx(prev_ctx)
             if prev_task_id is None:

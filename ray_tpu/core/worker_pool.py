@@ -467,6 +467,17 @@ class WorkerPool:
                     entry[1] = runtime_env
             self._cv.notify()
 
+    def shed_demand(self) -> int:
+        """Job reap: drop every queued spawn figure. The purged backlog may
+        have been the demand behind them, and serving a stale figure forks
+        workers into a vacuum. Safe for surviving jobs: serve re-reads the
+        LIVE backlog before spawning anyway, and every submit/schedule pass
+        re-arms its own demand. Returns the number of entries dropped."""
+        with self._cv:
+            n = len(self._pending)
+            self._pending.clear()
+        return n
+
     def prewarm(self, hot_envs) -> None:
         """Warm node onboarding: boot fork templates for the fleet's hot
         runtime-env keys (shipped in the register_node reply) so this

@@ -74,7 +74,8 @@ def router_stats() -> Dict[str, int]:
     """Snapshot of this process's router outcome counters: `retries`
     (re-routed attempts), `failovers` (requests that succeeded only after
     a retry), `shed` (admission-control rejections), `timeouts` (promises
-    failed by the deadline reaper)."""
+    failed by the deadline reaper), `client_cancels` (in-flight replica
+    attempts cancelled because the client disconnected)."""
     with _router_stats_lock:
         return dict(_router_stats)
 
@@ -177,18 +178,70 @@ class _DeadlineReaper:
                 promise = ObjectRef(oid)
                 w = _global_worker()
                 state, _ = w.peek_local(promise)
-                if state == "pending" and w.fulfill_promise(
-                        promise, error=RequestTimeoutError(
-                            f"request to {name} exceeded its "
-                            f"{timeout_s:.1f}s deadline")):
+                timed_out = state == "pending" and w.fulfill_promise(
+                    promise, error=RequestTimeoutError(
+                        f"request to {name} exceeded its "
+                        f"{timeout_s:.1f}s deadline"))
+                if timed_out:
                     _bump_router_stat("timeouts")
                     _serve_metrics()["timeouts"].inc(
                         tags={"deployment": name})
+                # registry cleanup ALWAYS happens here (bounded lifetime:
+                # one expire entry per request); on a real timeout also
+                # CANCEL the in-flight replica attempt through the
+                # runtime's task cancellation — nobody will read the
+                # result, so the replica should stop computing it
+                with _inflight_lock:
+                    req = _inflight_requests.pop(oid, None)
+                if timed_out and req is not None \
+                        and req.current_ref is not None:
+                    try:
+                        w.cancel(req.current_ref)
+                    except Exception:
+                        logger.debug("post-deadline replica cancel failed",
+                                     exc_info=True)
             except Exception:
                 logger.exception("deadline reaper entry failed")
 
 
 _deadline_reaper = _DeadlineReaper()
+
+# promise.id -> live _RouterRequest: lets the deadline reaper and the HTTP
+# edge's disconnect path CANCEL the replica attempt behind an abandoned
+# request (rides the runtime's real task cancellation). Entries are popped
+# at fulfillment, at cancel, or — worst case — by the request's own
+# deadline-reaper expire entry, so the registry lifetime is bounded by the
+# request timeout.
+_inflight_requests: Dict[bytes, "_RouterRequest"] = {}
+_inflight_lock = threading.Lock()
+
+
+def cancel_inflight(promise_ref) -> bool:
+    """Best-effort cancellation of the replica attempt behind a router
+    promise (client disconnected / caller abandoned the request): the
+    in-flight `handle_request` task is cancelled through `ray_tpu.cancel`
+    — cooperative interruption on the replica — and the promise resolves
+    to the typed TaskCancelledError so any residual waiter unblocks.
+    Returns False when the request already completed."""
+    from ray_tpu.core.api import _global_worker
+    from ray_tpu.core.exceptions import TaskCancelledError
+
+    with _inflight_lock:
+        req = _inflight_requests.pop(promise_ref.id, None)
+    if req is None:
+        return False
+    w = _global_worker()
+    cancelled = w.fulfill_promise(
+        req.promise, error=TaskCancelledError(
+            "serve request cancelled (client disconnected)"))
+    if req.current_ref is not None:
+        try:
+            w.cancel(req.current_ref)
+        except Exception:
+            logger.debug("inflight replica cancel failed", exc_info=True)
+    if cancelled:
+        _bump_router_stat("client_cancels")
+    return cancelled
 
 # errors that mean "this replica (or the link to it) died mid-request" —
 # the request itself is intact and an idempotent one may be re-routed
@@ -1148,6 +1201,7 @@ class DeploymentHandle:
                 from ray_tpu.core.api import _global_worker
 
                 _global_worker().fulfill_promise(req.promise, error=e)
+                req._deregister()
                 raise
         if route_ctx is not None:
             amb = tracing.current_ctx()
@@ -1252,7 +1306,8 @@ class _RouterRequest:
     plasma-sized result pulls) hops to the shared router pool."""
 
     __slots__ = ("h", "args", "kwargs", "deadline_ts", "retries_left",
-                 "tried", "promise", "backoff", "retried", "trace_ctx")
+                 "tried", "promise", "backoff", "retried", "trace_ctx",
+                 "current_ref")
 
     def __init__(self, h: DeploymentHandle, args, kwargs,
                  deadline_ts: float, timeout_s: float, budget: int):
@@ -1272,7 +1327,16 @@ class _RouterRequest:
             cap_s=cfg.retry_backoff_cap_ms / 1000.0)
         self.promise = _global_worker().create_promise()
         self.trace_ctx = None  # (trace_id, route span id) when tracing is on
+        self.current_ref = None  # latest replica attempt (cancellation target)
+        with _inflight_lock:
+            _inflight_requests[self.promise.id] = self
         _deadline_reaper.watch(deadline_ts, self.promise, h._name, timeout_s)
+
+    def _deregister(self) -> None:
+        """Request resolved: drop it from the cancellation registry (the
+        reaper's expire entry remains the backstop cleanup)."""
+        with _inflight_lock:
+            _inflight_requests.pop(self.promise.id, None)
 
     def _submit_to(self, replica, key: bytes) -> None:
         h = self.h
@@ -1287,6 +1351,7 @@ class _RouterRequest:
         except BaseException:
             h._dec(key)
             raise
+        self.current_ref = ref  # cancellation target for disconnect/expiry
         from ray_tpu.core.api import _global_worker
 
         _global_worker().add_done_callback(
@@ -1309,6 +1374,7 @@ class _RouterRequest:
             if (w.fulfill_promise_blob(self.promise, blob, is_error=False)
                     and self.retried):
                 _bump_router_stat("failovers")
+            self._deregister()
             return
         if state == "plasma":
             _router_pool().submit(self._relay_plasma, ref)
@@ -1327,6 +1393,7 @@ class _RouterRequest:
             _router_pool().submit(self._failover, err)
             return
         w.fulfill_promise_blob(self.promise, blob, is_error=True)
+        self._deregister()
 
     def _relay_plasma(self, ref) -> None:
         """Pool: pull a plasma-sized result and resolve the promise.
@@ -1342,10 +1409,12 @@ class _RouterRequest:
                 ref, timeout=max(1.0, self.deadline_ts - time.time() + 5.0))
         except Exception as e:
             _global_worker().fulfill_promise(self.promise, error=e)
+            self._deregister()
             return
         if (_global_worker().fulfill_promise(self.promise, value=value)
                 and self.retried):
             _bump_router_stat("failovers")
+        self._deregister()
 
     def _failover(self, err: BaseException, ready: bool = False) -> None:
         """Pool: budget/deadline-bounded re-route onto a surviving replica.
@@ -1362,6 +1431,7 @@ class _RouterRequest:
             return  # the deadline reaper resolves the promise (typed)
         if self.retries_left <= 0:
             _global_worker().fulfill_promise(self.promise, error=err)
+            self._deregister()
             return
         if not ready:
             remaining = self.deadline_ts - time.time()

@@ -333,12 +333,23 @@ class AsyncHTTPProxy:
                         self._pool, _submit)
                 # the router's deadline reaper resolves the promise AT the
                 # deadline; the edge timeout is only the backstop behind it
-                await await_ref(self._loop, ref, timeout_s + _EDGE_GRACE_S)
-                out = await fetch_value(self._loop, self._pool, ref,
-                                        timeout_s + _EDGE_GRACE_S)
-                body, ctype = self._encode_result(out)
-                writer.write(self._response(200, body, ctype, req["close"]))
-                await writer.drain()
+                try:
+                    await await_ref(self._loop, ref,
+                                    timeout_s + _EDGE_GRACE_S)
+                    out = await fetch_value(self._loop, self._pool, ref,
+                                            timeout_s + _EDGE_GRACE_S)
+                    body, ctype = self._encode_result(out)
+                    writer.write(self._response(200, body, ctype,
+                                                req["close"]))
+                    await writer.drain()
+                except (ConnectionError, asyncio.CancelledError):
+                    # client went away while the request was in flight:
+                    # cancel the replica attempt through the router so the
+                    # replica stops computing a result nobody will read
+                    from ray_tpu.serve.api import cancel_inflight
+
+                    cancel_inflight(ref)
+                    raise
         except _BadRequest as e:
             writer.write(self._response(
                 400, json.dumps({"error": str(e)}).encode(),

@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional
 import cloudpickle
 
 import ray_tpu
+from ray_tpu.core.exceptions import TaskCancelledError
 
 _DEFAULT_STORAGE = os.path.join(
     os.environ.get("RAY_TPU_WORKFLOW_STORAGE",
@@ -62,8 +63,13 @@ class WorkflowStep:
         return f"{self.name}-{h.hexdigest()}"
 
 
-class WorkflowCancelledError(RuntimeError):
-    """The workflow was cancelled (workflow.cancel) mid-execution."""
+class WorkflowCancelledError(TaskCancelledError, RuntimeError):
+    """The workflow was cancelled (workflow.cancel) mid-execution.
+
+    A subclass of the runtime's typed TaskCancelledError: callers that
+    match cancellation BY TYPE (the job storm, generic task supervisors)
+    catch workflow cancellation the same way; RuntimeError is kept as a
+    base for pre-existing handlers."""
 
 
 class EventStep(WorkflowStep):
@@ -241,13 +247,13 @@ def _execute(root: WorkflowStep, storage: _Storage):
     while root_id not in results:
         if storage.get_meta().get("status") == "CANCELED":
             # drain ALREADY-FINISHED in-flight steps so their results
-            # persist for a later resume (steps still running on workers
-            # run to completion — task preemption is not part of the
-            # cancel contract — but nothing new launches)
+            # persist for a later resume, then CANCEL the rest through the
+            # runtime's real cancel (their refs resolve to the typed
+            # TaskCancelledError instead of running to completion)
             if inflight:
-                done, _ = ray_tpu.wait(list(inflight),
-                                       num_returns=len(inflight),
-                                       timeout=5.0)
+                done, running = ray_tpu.wait(list(inflight),
+                                             num_returns=len(inflight),
+                                             timeout=5.0)
                 for ref in done:
                     sid = inflight.pop(ref)
                     try:
@@ -256,6 +262,11 @@ def _execute(root: WorkflowStep, storage: _Storage):
                         continue
                     storage.save(sid, value)
                     results[sid] = value
+                for ref in running:
+                    try:
+                        ray_tpu.cancel(ref)
+                    except Exception:
+                        pass  # best-effort: the step re-runs on resume()
             raise WorkflowCancelledError(
                 f"workflow cancelled with {len(results)}/{len(nodes)} "
                 f"steps complete")
@@ -309,8 +320,11 @@ def _run_to_completion(st: _Storage, root: WorkflowStep):
         out = _execute(root, st)
         st.set_meta(status="SUCCEEDED", end_time=time.time())
         return out
-    except WorkflowCancelledError:
-        raise  # status already CANCELED; do not overwrite with FAILED
+    except WorkflowCancelledError as e:
+        # status already CANCELED (don't overwrite with FAILED) — but
+        # RECORD the typed error so get_status/list_all surface why
+        st.set_meta(error=str(e), end_time=time.time())
+        raise
     except Exception as e:
         st.set_meta(status="FAILED", error=str(e), end_time=time.time())
         raise

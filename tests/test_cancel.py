@@ -1,0 +1,149 @@
+"""Cancellation matrix (ISSUE 20 acceptance): `ray_tpu.cancel` across
+pending / running / actor-call targets × plain / force / recursive modes.
+The contract under test is the one api.cancel documents — best-effort on
+the work, HARD guarantee on the ref: once cancelled, `get(ref)` resolves
+to the typed `TaskCancelledError`, promptly and never by hanging; a task
+that already completed keeps its value; double-cancel is idempotent.
+
+Timing notes baked into the task shapes:
+  - cooperative cancel lands at a bytecode boundary, so interruptible
+    sleepers must LOOP over short `time.sleep` calls — a single
+    `time.sleep(30)` is one C call the interpreter can't interrupt, and
+    only `force=True` (SIGKILL of the worker) resolves it promptly;
+  - every `get` below carries a timeout well under the 10s owner-side
+    resolution failsafe, so a pass proves the *direct* ack path worked,
+    not the failsafe timer.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.exceptions import TaskCancelledError
+
+
+@ray_tpu.remote
+def _loop_sleep(total=30.0):
+    # interruptible: cooperative cancel lands between the short C calls
+    for _ in range(int(total / 0.05)):
+        time.sleep(0.05)
+    return "done"
+
+
+@ray_tpu.remote
+def _c_sleep(total=30.0):
+    time.sleep(total)  # single C call: only force=True kills this promptly
+    return "done"
+
+
+@ray_tpu.remote
+def _quick(x):
+    return x * 2
+
+
+# near-zero CPU so a blocked parent never starves its own children
+@ray_tpu.remote(num_cpus=0.05)
+def _parent_tree(n):
+    refs = [_loop_sleep.remote() for _ in range(n)]
+    return ray_tpu.get(refs, timeout=120.0)
+
+
+@ray_tpu.remote
+class _Sleeper:
+    def nap(self, total=30.0):
+        for _ in range(int(total / 0.05)):
+            time.sleep(0.05)
+        return "woke"
+
+    def ping(self):
+        return "pong"
+
+
+def test_cancel_pending_task_dequeued(ray_start_regular):
+    # saturate the node's 4 CPUs so the victim stays queued at the raylet
+    blockers = [_loop_sleep.remote() for _ in range(4)]
+    time.sleep(0.5)
+    victim = _loop_sleep.remote()
+    ray_tpu.cancel(victim)
+    t0 = time.monotonic()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(victim, timeout=8.0)
+    assert time.monotonic() - t0 < 5.0, "pending cancel should be immediate"
+    for b in blockers:
+        ray_tpu.cancel(b, force=True)
+    for b in blockers:
+        with pytest.raises(TaskCancelledError):
+            ray_tpu.get(b, timeout=8.0)
+
+
+def test_cancel_running_task_cooperative(ray_start_regular):
+    ref = _loop_sleep.remote()
+    time.sleep(1.0)  # let it start executing
+    ray_tpu.cancel(ref)
+    t0 = time.monotonic()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=8.0)
+    assert time.monotonic() - t0 < 5.0, "cooperative injection, not failsafe"
+
+
+def test_cancel_running_task_force_kills_worker(ray_start_regular):
+    ref = _c_sleep.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(ref, force=True)
+    t0 = time.monotonic()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=8.0)
+    assert time.monotonic() - t0 < 5.0
+    # the node recovers a worker slot: fresh work still runs
+    assert ray_tpu.get(_quick.remote(21), timeout=30.0) == 42
+
+
+def test_cancel_recursive_kills_child_tree(ray_start_regular):
+    parent = _parent_tree.remote(3)
+    time.sleep(1.5)  # children running/queued under the parent
+    ray_tpu.cancel(parent, recursive=True)
+    t0 = time.monotonic()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(parent, timeout=10.0)
+    assert time.monotonic() - t0 < 8.0
+    # children really died with the parent: all 4 CPUs are free again,
+    # promptly — leaked 30s sleepers would stall this wave
+    vals = ray_tpu.get([_quick.remote(i) for i in range(4)], timeout=15.0)
+    assert vals == [0, 2, 4, 6]
+
+
+def test_cancel_actor_call_queued_and_running(ray_start_regular):
+    a = _Sleeper.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30.0) == "pong"
+    running = a.nap.remote()
+    time.sleep(0.7)
+    queued = a.nap.remote()  # parked behind `running` in the mailbox
+    ray_tpu.cancel(queued)   # mailbox purge
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(queued, timeout=8.0)
+    ray_tpu.cancel(running)  # cooperative injection into the exec thread
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(running, timeout=8.0)
+    # the actor itself survives both cancels
+    assert ray_tpu.get(a.ping.remote(), timeout=30.0) == "pong"
+
+
+def test_double_cancel_idempotent(ray_start_regular):
+    ref = _loop_sleep.remote()
+    time.sleep(0.5)
+    ray_tpu.cancel(ref)
+    ray_tpu.cancel(ref)  # second claim: silent no-op, first owns resolution
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=8.0)
+    ray_tpu.cancel(ref)  # cancel-after-resolution: still a no-op
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=8.0)
+
+
+def test_cancel_completed_task_keeps_value(ray_start_regular):
+    ref = _quick.remote(21)
+    assert ray_tpu.get(ref, timeout=30.0) == 42
+    ray_tpu.cancel(ref)
+    ray_tpu.cancel(ref, force=True)
+    assert ray_tpu.get(ref, timeout=5.0) == 42

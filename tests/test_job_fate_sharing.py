@@ -1,0 +1,151 @@
+"""Driver-death fate-sharing (ISSUE 20 acceptance): SIGKILL a driver
+PROCESS and assert the control plane reaps exactly its job — non-detached
+actors die, the detached one survives and answers a different driver,
+cross-job `get()` of a reaped object surfaces the typed `OwnerDiedError`,
+and the reap still happens when the GCS itself is restarted concurrently
+(the snapshot-restore `restored-unreaped` probe path).
+
+The victim driver is `python -m ray_tpu.core.jobstorm --victim` — the same
+importable workload the job storm uses (named + detached counter actors,
+a pinned 1 MiB put, nested task trees), spawned here via the storm's own
+subprocess helpers.
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import rpc
+from ray_tpu.core.exceptions import OwnerDiedError
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.jobstorm import (JobStormProfile, _spawn_driver, _tagged,
+                                   _wait_line)
+from ray_tpu.core.object_ref import ObjectRef
+
+# long enough that the victim is mid-flight when killed, bounded so an
+# orphaned process can't outlive the test run by much
+_PROFILE = JobStormProfile(driver_duration_s=60.0, put_mb=1.0, tree_depth=1,
+                           get_timeout_s=30.0)
+
+
+def _ready_victim(gcs_address, idx=0):
+    rec = _spawn_driver(_PROFILE, gcs_address, idx, detached=True)
+    assert _wait_line(rec, "VICTIM_READY", timeout=90.0) is not None, \
+        "victim driver never reached steady state"
+    rec["job_hex"] = _tagged(rec, "JOB")[0][1].split()[1]
+    _, oid_hex, owner = _tagged(rec, "PUT")[0][1].split()
+    rec["put"] = (oid_hex, owner)
+    return rec
+
+
+def _poll_reaped(gcs_client, job_hex, bound_s):
+    deadline = time.monotonic() + bound_s
+    entry = None
+    while time.monotonic() < deadline:
+        st = gcs_client.call("gcs_stats", timeout=10)
+        entry = next((j for j in st.get("jobs", [])
+                      if j["job_id"] == job_hex), None)
+        if entry and entry.get("status") == "DEAD" and entry.get("reap"):
+            return entry
+        time.sleep(0.1)
+    raise AssertionError(
+        f"job {job_hex} not reaped within {bound_s}s (last entry: {entry})")
+
+
+def test_driver_kill_reaps_job_but_detached_survives(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=4)
+    cluster.connect()
+    try:
+        rec = _ready_victim(cluster.gcs_address, idx=0)
+        try:
+            os.kill(rec["proc"].pid, signal.SIGKILL)
+            c = rpc.connect_with_retry(cluster.gcs_address, timeout=10)
+            entry = _poll_reaped(c, rec["job_hex"], bound_s=10.0)
+
+            # the job's reap record is complete and the kill was typed
+            assert entry["reap"]["actors_killed"] >= 1
+            assert entry["reap"]["detached_spared"] >= 1
+            assert entry.get("death_cause")  # e.g. "driver connection closed"
+            # every still-live actor of the dead job is a detached one
+            assert entry["live_actors"] == entry["detached_actors"] >= 1
+
+            # non-detached named actor died with its owner...
+            with pytest.raises(ValueError):
+                ray_tpu.get_actor("storm-cnt-0")
+            # ...the detached one answers ANOTHER driver (this process),
+            # pre-kill state intact (the victim bumped it once at startup)
+            h = ray_tpu.get_actor("storm-det-0")
+            v = ray_tpu.get(h.value.remote(), timeout=30.0)
+            assert v >= 1
+            assert ray_tpu.get(h.bump.remote(), timeout=30.0) == v + 1
+
+            # cross-job get of the corpse's pinned put: typed, not a hang
+            oid_hex, owner = rec["put"]
+            ref = ObjectRef(ObjectID(bytes.fromhex(oid_hex)),
+                            owner_address=owner)
+            with pytest.raises(OwnerDiedError):
+                ray_tpu.get(ref, timeout=10.0)
+        finally:
+            if rec["proc"].poll() is None:
+                rec["proc"].kill()
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_reap_survives_concurrent_head_failover():
+    from ray_tpu.core.cluster import Cluster
+    from ray_tpu.core.config import get_config
+
+    cluster = Cluster(snapshot_uri="memory://fate-failover")
+    rec = None
+    try:
+        cluster.add_node(num_cpus=4)
+        rec = _ready_victim(cluster.gcs_address, idx=0)
+        # driver dies and the head restarts before the reap settles: the
+        # restored snapshot still lists the job RUNNING, so the new head
+        # must walk the restored-unreaped probe path (driver_address dial
+        # fails -> reap), not wait for a conn-close that already happened
+        os.kill(rec["proc"].pid, signal.SIGKILL)
+        cluster.restart_gcs()
+
+        c = rpc.connect_with_retry(cluster.gcs_address, timeout=15)
+        bound = get_config().job_reap_detection_bound_s + 12.0
+        entry = _poll_reaped(c, rec["job_hex"], bound_s=bound)
+        assert entry["reap"]["detached_spared"] >= 1
+
+        # the detached actor rode out BOTH the owner death and the head
+        # failover: a fresh driver still resolves and drives it by name
+        cluster.connect()
+        h = ray_tpu.get_actor("storm-det-0")
+        assert ray_tpu.get(h.bump.remote(), timeout=30.0) >= 2
+    finally:
+        if rec is not None and rec["proc"].poll() is None:
+            rec["proc"].kill()
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_jobstorm_quick_contract_holds(tmp_path):
+    """Full job-storm smoke on the CI profile (mirrors test_memstorm): the
+    artifact under tmp_path, never over the tracked JOBSTORM_r20.json —
+    that file is only regenerated by an explicit module run."""
+    from ray_tpu.core.jobstorm import QUICK_PROFILE, run_jobstorm
+
+    seed = int(os.environ.get("RAY_TPU_FAULT_INJECTION_SEED", "20260807"))
+    profile = JobStormProfile(**dict(QUICK_PROFILE, seed=seed))
+    result = run_jobstorm(profile, out_path=str(tmp_path / "JOBSTORM.json"))
+    assert result["ok"], result["violations"]
+    assert result["zero_hung"] and result["zero_leaks"]
+    assert result["detached_survived"]
+    c = result["counters"]
+    assert c["jobs_reaped"] == profile.n_kill
+    assert c["actors_killed"] >= 1 and c["detached_spared"] >= 1
+    assert c["objects_dropped"] >= profile.n_kill  # the pinned puts died too
